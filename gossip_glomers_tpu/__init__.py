@@ -1,0 +1,25 @@
+"""gossip_glomers_tpu — a TPU-native distributed-systems framework.
+
+A from-scratch reimplementation of the capabilities of the Gossip Glomers
+reference solutions (Go + Maelstrom) as a TPU-first framework:
+
+- ``protocol``  — Maelstrom wire format: message envelope, body schemas,
+  RPC error vocabulary (Layer 3 of the reference).
+- ``runtime``   — a Maelstrom-compatible per-process node runtime speaking
+  line-delimited JSON over stdio, plus seq/lin KV clients (Layer 1).
+- ``models``    — the five challenge node programs (echo, unique-ids,
+  broadcast, counter, kafka) written as *pure* handlers
+  ``(state, msg) -> (state, effects)`` shared by every backend (Layer 2).
+- ``harness``   — an in-repo Maelstrom equivalent: deterministic simulated
+  network with latency/partition fault injection, seq-kv/lin-kv service
+  nodes, workload generators and correctness checkers (Layer 0).
+- ``ops`` / ``parallel`` / ``sim`` — the ``tpu_sim`` backend: every node is
+  a row of a device-sharded state array; gossip fan-out, CRDT merges and
+  offset allocation become batched JAX kernels (`shard_map` over a
+  `jax.sharding.Mesh`, XLA collectives over ICI).
+
+Reference: dshebib/gossip-glomers-distributed-systems (studied, not copied);
+citations throughout use ``<file>:<line>`` relative to that repo.
+"""
+
+__version__ = "0.1.0"

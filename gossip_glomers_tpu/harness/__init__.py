@@ -1,0 +1,34 @@
+"""In-repo Maelstrom-equivalent test harness (Layer 0 parity).
+
+The reference repo has zero in-repo tests; its whole test strategy is
+black-box workload testing under the external Maelstrom harness (survey
+§4).  This package *is* that harness, natively: a deterministic
+virtual-clock event simulator that
+
+- spawns N node runtimes running the exact same challenge programs as the
+  stdio binaries,
+- routes every message with configurable latency and seeded jitter,
+- injects faults (network partitions as time-varying drop rules),
+- provides the ``seq-kv`` / ``lin-kv`` service nodes,
+- generates per-challenge workloads and checks correctness
+  (echo equality, ID uniqueness, broadcast convergence, g-counter sums,
+  kafka offset/poll/commit contracts),
+- accounts messages per operation and op latencies (the reference README's
+  headline stats are exactly these checker outputs).
+
+Everything is seeded: the same (workload, seed) pair replays the identical
+message timeline.
+"""
+
+from .network import Client, SimNodeRuntime, VirtualNetwork
+from .services import KVService
+from .faults import PartitionSchedule, random_partitions
+
+__all__ = [
+    "VirtualNetwork",
+    "SimNodeRuntime",
+    "Client",
+    "KVService",
+    "PartitionSchedule",
+    "random_partitions",
+]

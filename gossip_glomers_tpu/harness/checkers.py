@@ -1,0 +1,104 @@
+"""Correctness checkers (Layer 0 parity with Maelstrom's per-workload
+checkers, survey §4).
+
+Each checker returns ``(ok, details)``.  They deliberately encode the
+reference's *actual* semantics, including the weak ones — e.g. the
+counter's read serves a cached value, kafka's committed offsets are
+local-cache-only — so parity runs check real behavior, not an idealized
+contract (survey §7 "hard parts", last bullet).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any
+
+
+def check_echo(pairs: list[tuple[dict, dict]]) -> tuple[bool, dict]:
+    """Every reply must be the request body with type rewritten to
+    echo_ok (reference behavior: echo/main.go:12-20)."""
+    bad = []
+    for req, rep in pairs:
+        want = dict(req)
+        want["type"] = "echo_ok"
+        got = {k: v for k, v in rep.items()
+               if k not in ("in_reply_to", "msg_id")}
+        if got != want:
+            bad.append((req, rep))
+    return not bad, {"n_ops": len(pairs), "mismatches": bad[:5]}
+
+
+def check_unique_ids(ids: list[str]) -> tuple[bool, dict]:
+    """Global uniqueness across every acked generate op."""
+    dupes = [i for i, c in Counter(ids).items() if c > 1]
+    return not dupes, {"n_ids": len(ids), "n_unique": len(set(ids)),
+                       "duplicates": dupes[:5]}
+
+
+def check_broadcast_convergence(
+        final_reads: dict[str, list[int]],
+        sent_values: set[int]) -> tuple[bool, dict]:
+    """Every value from an acked broadcast op must appear in every node's
+    final read (eventual consistency after quiescence)."""
+    missing = {}
+    for node, msgs in final_reads.items():
+        got = set(msgs)
+        lack = sent_values - got
+        extra = got - sent_values
+        if lack or extra:
+            missing[node] = {"missing": sorted(lack)[:10],
+                             "extra": sorted(extra)[:10]}
+    return not missing, {"n_values": len(sent_values),
+                         "n_nodes": len(final_reads),
+                         "divergent_nodes": missing}
+
+
+def check_counter(final_reads: dict[str, int],
+                  expected_sum: int) -> tuple[bool, dict]:
+    """After quiescence every node's read must equal the sum of acked
+    adds (g-counter contract)."""
+    wrong = {n: v for n, v in final_reads.items() if v != expected_sum}
+    return not wrong, {"expected": expected_sum, "reads": final_reads,
+                       "wrong": wrong}
+
+
+def check_kafka(send_acks: list[tuple[str, int, int]],
+                polls: list[dict[str, list[list[int]]]],
+                committed: dict[str, int]) -> tuple[bool, dict]:
+    """Kafka contract per the reference's guarantees:
+
+    - offsets in ``send_ok`` are unique per key (lin-kv allocation,
+      logmap.go:255-285);
+    - poll results are sorted by offset with no duplicate offsets, and
+      each (key, offset) maps to the message acked at that offset;
+    - committed offsets never exceed the max allocated offset per key.
+    """
+    problems: list[str] = []
+    by_key: dict[str, dict[int, int]] = {}
+    for key, offset, msg in send_acks:
+        slot = by_key.setdefault(key, {})
+        if offset in slot and slot[offset] != msg:
+            problems.append(f"dup offset {key}:{offset}")
+        slot[offset] = msg
+
+    for poll in polls:
+        for key, pairs in poll.items():
+            offs = [o for o, _m in pairs]
+            if offs != sorted(offs):
+                problems.append(f"unsorted poll for {key}: {offs[:8]}")
+            if len(offs) != len(set(offs)):
+                problems.append(f"dup offsets in poll for {key}")
+            for o, m in pairs:
+                want = by_key.get(key, {}).get(o)
+                if want is not None and want != m:
+                    problems.append(
+                        f"poll {key}@{o} = {m}, acked send was {want}")
+
+    for key, coff in committed.items():
+        max_off = max(by_key.get(key, {0: 0}))
+        if coff > max_off:
+            problems.append(f"committed {key}@{coff} > max alloc {max_off}")
+
+    return not problems, {"n_sends": len(send_acks),
+                          "n_keys": len(by_key),
+                          "problems": problems[:10]}

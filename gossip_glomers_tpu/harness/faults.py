@@ -1,0 +1,76 @@
+"""Fault injection: seeded, time-varying network partition schedules.
+
+The reference's fault tolerance is exercised by Maelstrom's nemesis
+(randomized partitions, reference README.md:18); here faults are explicit
+data — a list of (start, end, reachability) windows compiled into a
+``drop_fn`` for the virtual network.  Seeded schedules replay exactly,
+which is what lets convergence tests assert hard outcomes under faults.
+
+This is also the semantic model the tpu_sim backend uses: a partition is a
+time-varying boolean adjacency mask (survey §5 "fault injection = masked
+adjacency updates").
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PartitionWindow:
+    start: float
+    end: float
+    groups: list[list[str]]  # components; cross-component traffic drops
+
+    def blocks(self, src: str, dest: str) -> bool:
+        gsrc = gdst = None
+        for i, g in enumerate(self.groups):
+            if src in g:
+                gsrc = i
+            if dest in g:
+                gdst = i
+        if gsrc is None or gdst is None:
+            return False  # endpoints outside the partition spec pass
+        return gsrc != gdst
+
+
+@dataclass
+class PartitionSchedule:
+    windows: list[PartitionWindow] = field(default_factory=list)
+
+    def drop_fn(self):
+        windows = self.windows
+
+        def drop(src: str, dest: str, now: float) -> bool:
+            for w in windows:
+                if w.start <= now < w.end and w.blocks(src, dest):
+                    return True
+            return False
+
+        return drop
+
+
+def random_partitions(node_ids: list[str], *, t_end: float,
+                      period: float = 5.0, duration: float = 2.5,
+                      seed: int = 0,
+                      include: list[str] | None = None) -> PartitionSchedule:
+    """Randomized majority/minority partitions, one per ``period``, each
+    lasting ``duration`` — the shape of Maelstrom's default partition
+    nemesis.  ``include`` adds extra endpoints (e.g. ``seq-kv``) to the
+    majority side so service reachability is partitioned too.
+    """
+    rng = random.Random(seed)
+    windows = []
+    t = period / 2
+    while t < t_end:
+        ids = list(node_ids)
+        rng.shuffle(ids)
+        cut = rng.randrange(1, len(ids))
+        minority, majority = ids[:cut], ids[cut:]
+        if include:
+            majority = majority + list(include)
+        windows.append(PartitionWindow(t, t + duration,
+                                       [minority, majority]))
+        t += period
+    return PartitionSchedule(windows)

@@ -1,0 +1,215 @@
+"""Deterministic virtual-clock network simulator.
+
+Plays the role Maelstrom's simulated network plays for the reference
+(survey §1 Layer 0): every message between nodes goes through this router,
+which can add latency, jitter and partitions — all driven by one seeded
+RNG, so runs are exactly reproducible.
+
+Time is virtual: an event heap keyed by (time, seq).  Node runtimes are
+single-threaded and event-driven, which makes the whole cluster
+deterministic — the property the survey calls out as the hard part of
+matching an asynchronous Go implementation (survey §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+import zlib
+from collections import Counter
+from typing import Any, Callable
+
+from ..protocol import Message
+from ..runtime.node import NodeCore
+from ..utils.config import NetConfig
+
+# drop_fn(src, dest, now) -> True when the link is currently cut
+DropFn = Callable[[str, str, float], bool]
+
+
+class Ledger:
+    """Message accountant (the source of the msgs-per-op stat, reference
+    README.md:17)."""
+
+    def __init__(self) -> None:
+        self.total = 0
+        self.by_type: Counter = Counter()
+        self.server_to_server = 0
+        self.dropped = 0
+        self.client_ops = 0
+        self.op_latencies: list[float] = []
+
+
+class SimNodeRuntime(NodeCore):
+    """NodeCore on the virtual clock; handlers run synchronously inside
+    network events."""
+
+    def __init__(self, network: "VirtualNetwork", node_id: str) -> None:
+        super().__init__()
+        self.network = network
+        self._preassigned_id = node_id
+        # stable per-node seed (str.__hash__ is salted per process)
+        self.rng = random.Random(
+            (network.cfg.seed << 32) ^ zlib.crc32(node_id.encode()))
+        self.log_lines: list[str] = []
+
+    def _transmit(self, msg: Message) -> None:
+        self.network.submit(msg)
+
+    def schedule(self, delay: float, fn: Callable[[], None]) -> None:
+        self.network.schedule(delay, fn)
+
+    def now(self) -> float:
+        return self.network.now
+
+    def log(self, text: str) -> None:
+        self.log_lines.append(text)
+
+    def on_unhandled(self, msg) -> None:
+        # Under the deterministic harness an unhandled message is a
+        # workload/program bug — fail loudly instead of killing a process.
+        raise RuntimeError(
+            f"{self._preassigned_id}: no handler for {msg.type!r} "
+            f"(from {msg.src})")
+
+
+class Client:
+    """A workload client endpoint (Maelstrom's ``c1``, ``c2``, ... nodes).
+
+    Issues RPCs into the cluster and records op latency into the ledger.
+    """
+
+    def __init__(self, network: "VirtualNetwork", client_id: str) -> None:
+        self.network = network
+        self.id = client_id
+        self._next_msg_id = 0
+        self._pending: dict[int, tuple[float, Callable]] = {}
+
+    def rpc(self, dest: str, body: dict,
+            cb: Callable[[Message], None] | None = None) -> None:
+        self._next_msg_id += 1
+        msg_id = self._next_msg_id
+        out = dict(body)
+        out["msg_id"] = msg_id
+        self._pending[msg_id] = (self.network.now, cb or (lambda m: None))
+        self.network.ledger.client_ops += 1
+        self.network.submit(Message(self.id, dest, out))
+
+    def deliver(self, msg: Message) -> None:
+        irt = msg.in_reply_to
+        if irt is None or irt not in self._pending:
+            return
+        start, cb = self._pending.pop(irt)
+        self.network.ledger.op_latencies.append(self.network.now - start)
+        cb(msg)
+
+    @property
+    def outstanding(self) -> int:
+        return len(self._pending)
+
+
+class VirtualNetwork:
+    """The simulated cluster: nodes + services + clients + event loop."""
+
+    def __init__(self, cfg: NetConfig | None = None) -> None:
+        self.cfg = cfg or NetConfig()
+        self.rng = random.Random(self.cfg.seed)
+        self.now = 0.0
+        self._heap: list[tuple[float, int, Callable[[], None]]] = []
+        self._seq = 0
+        self.nodes: dict[str, SimNodeRuntime] = {}
+        self.services: dict[str, Any] = {}
+        self.clients: dict[str, Client] = {}
+        self.ledger = Ledger()
+        self.drop_fn: DropFn | None = None
+        self.trace: list[tuple[float, Message]] | None = None
+
+    # -- construction -----------------------------------------------------
+
+    def spawn(self, node_id: str, program) -> SimNodeRuntime:
+        """Create a node runtime and install a challenge program on it
+        (the analogue of Maelstrom exec'ing one more copy of the binary)."""
+        node = SimNodeRuntime(self, node_id)
+        program.install(node)
+        self.nodes[node_id] = node
+        return node
+
+    def add_service(self, service) -> None:
+        self.services[service.id] = service
+
+    def client(self, client_id: str = "c1") -> Client:
+        if client_id not in self.clients:
+            self.clients[client_id] = Client(self, client_id)
+        return self.clients[client_id]
+
+    def init_cluster(self) -> None:
+        """Send ``init`` to every node (Maelstrom does this first, from a
+        control client), then drain the init exchanges."""
+        node_ids = sorted(self.nodes)
+        ctl = self.client("c0")
+        for nid in node_ids:
+            ctl.rpc(nid, {"type": "init", "node_id": nid,
+                          "node_ids": node_ids})
+        self.run_for(0.0)
+
+    def set_topology(self, topology: dict[str, list[str]]) -> None:
+        """Send the harness-supplied ``topology`` map to every node
+        (Maelstrom's broadcast workload does this after init)."""
+        ctl = self.client("c0")
+        for nid in self.nodes:
+            ctl.rpc(nid, {"type": "topology", "topology": topology})
+        self.run_for(0.0)
+
+    # -- event loop -------------------------------------------------------
+
+    def schedule(self, delay: float, fn: Callable[[], None]) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (self.now + max(delay, 0.0),
+                                    self._seq, fn))
+
+    def submit(self, msg: Message) -> None:
+        """Route one message: account it, apply partitions, apply latency,
+        deliver."""
+        self.ledger.total += 1
+        self.ledger.by_type[msg.type] += 1
+        src_is_server = msg.src in self.nodes
+        dest_is_server = msg.dest in self.nodes or msg.dest in self.services
+        if src_is_server and dest_is_server:
+            self.ledger.server_to_server += 1
+        if self.drop_fn is not None and self.drop_fn(msg.src, msg.dest,
+                                                     self.now):
+            self.ledger.dropped += 1
+            return
+        delay = self.cfg.latency
+        if self.cfg.latency_jitter:
+            delay += self.rng.uniform(0, self.cfg.latency_jitter)
+        if self.trace is not None:
+            self.trace.append((self.now, msg))
+        self.schedule(delay, lambda: self._deliver(msg))
+
+    def _deliver(self, msg: Message) -> None:
+        target = (self.nodes.get(msg.dest) or self.services.get(msg.dest)
+                  or self.clients.get(msg.dest))
+        if target is None:
+            return
+        target.deliver(msg)
+
+    def run_for(self, duration: float, max_events: int = 10_000_000) -> None:
+        """Advance virtual time by ``duration``, processing every event due
+        in the window (events scheduled exactly at the deadline included)."""
+        deadline = self.now + duration
+        processed = 0
+        while self._heap and self._heap[0][0] <= deadline:
+            t, _seq, fn = heapq.heappop(self._heap)
+            self.now = max(self.now, t)
+            fn()
+            processed += 1
+            if processed >= max_events:
+                raise RuntimeError("event budget exceeded; runaway timer?")
+        self.now = deadline
+
+    def run_until_quiet(self, max_time: float = 60.0) -> None:
+        """Run until ``max_time`` (programs reschedule periodic timers
+        forever, so the event heap never truly drains)."""
+        while self._heap and self.now < max_time:
+            self.run_for(min(1.0, max_time - self.now))

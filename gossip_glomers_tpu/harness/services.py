@@ -1,0 +1,92 @@
+"""Harness-provided service nodes: ``seq-kv`` / ``lin-kv`` / ``lww-kv``.
+
+Maelstrom supplies these as special network endpoints with precise
+consistency contracts (survey §4 "fake backends"; consumed by the
+reference at counter/main.go:21 and kafka/main.go:17).  Protocol per op:
+
+    read  {key}                      → read_ok{value} | error 20
+    write {key, value}               → write_ok
+    cas   {key, from, to,
+           create_if_not_exists}     → cas_ok | error 20 | error 22
+
+Implementation note: we apply ops linearizably in delivery order.  That is
+the exact lin-kv contract, and a legal (strongest) implementation of
+seq-kv — sequential consistency permits but does not require stale reads.
+An optional ``stale_read_prob`` knob makes seq-kv exercise clients'
+stale-read handling the way Maelstrom's real seq-kv can.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any
+
+from ..protocol import (KEY_DOES_NOT_EXIST, PRECONDITION_FAILED, Message,
+                        RPCError)
+
+
+class KVService:
+    def __init__(self, network, service_id: str = "seq-kv",
+                 stale_read_prob: float = 0.0) -> None:
+        self.network = network
+        self.id = service_id
+        self.store: dict[str, Any] = {}
+        self.history: list[tuple[float, str, str, Any]] = []  # (t, op, key, arg)
+        self.stale_read_prob = stale_read_prob
+        self._stale: dict[str, Any] = {}
+        self._rng = random.Random(network.cfg.seed ^ 0x5EC4)
+
+    def _reply(self, req: Message, body: dict) -> None:
+        out = dict(body)
+        if req.msg_id is not None:
+            out["in_reply_to"] = req.msg_id
+        self.network.submit(Message(self.id, req.src, out))
+
+    def deliver(self, msg: Message) -> None:
+        body = msg.body
+        op = msg.type
+        key = str(body.get("key"))
+        if op == "read":
+            if key not in self.store:
+                self._reply(msg, RPCError(
+                    KEY_DOES_NOT_EXIST, f"key {key} not found").to_body())
+                return
+            value = self.store[key]
+            if (self.stale_read_prob and key in self._stale
+                    and self._rng.random() < self.stale_read_prob):
+                value = self._stale[key]
+            self._reply(msg, {"type": "read_ok", "value": value})
+        elif op == "write":
+            self._record_stale(key)
+            self.store[key] = body.get("value")
+            self.history.append((self.network.now, "write", key,
+                                 body.get("value")))
+            self._reply(msg, {"type": "write_ok"})
+        elif op == "cas":
+            frm, to = body.get("from"), body.get("to")
+            create = bool(body.get("create_if_not_exists", False))
+            if key not in self.store:
+                if create:
+                    self.store[key] = to
+                    self.history.append((self.network.now, "cas-create",
+                                         key, to))
+                    self._reply(msg, {"type": "cas_ok"})
+                else:
+                    self._reply(msg, RPCError(
+                        KEY_DOES_NOT_EXIST,
+                        f"key {key} not found").to_body())
+            elif self.store[key] == frm:
+                self._record_stale(key)
+                self.store[key] = to
+                self.history.append((self.network.now, "cas", key, to))
+                self._reply(msg, {"type": "cas_ok"})
+            else:
+                self._reply(msg, RPCError(
+                    PRECONDITION_FAILED,
+                    f"expected {frm!r}, had {self.store[key]!r}").to_body())
+        else:
+            pass  # unknown service op: drop
+
+    def _record_stale(self, key: str) -> None:
+        if self.stale_read_prob and key in self.store:
+            self._stale[key] = self.store[key]

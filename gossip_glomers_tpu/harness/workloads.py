@@ -1,0 +1,284 @@
+"""Workload generators: drive each challenge end-to-end on the virtual
+network and check the result (Layer 0 parity, survey §4).
+
+Each ``run_*`` function builds a cluster of the real challenge programs,
+generates client operations on the virtual clock, optionally injects
+faults, runs the matching checker, and returns a ``WorkloadResult`` with
+the message ledger (msgs-per-op, latencies) — the same outputs Maelstrom's
+checkers publish, which is where the reference README's headline numbers
+come from (README.md:16-18).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..models import (BroadcastProgram, CounterProgram, EchoProgram,
+                      KafkaProgram, UniqueIdsProgram)
+from ..parallel import grid as grid_topology
+from ..parallel import to_name_map, tree as tree_topology
+from ..protocol import Message
+from ..utils.config import NetConfig
+from . import checkers
+from .faults import PartitionSchedule
+from .network import VirtualNetwork
+from .services import KVService
+
+
+@dataclass
+class WorkloadResult:
+    ok: bool
+    details: dict = field(default_factory=dict)
+    stats: dict = field(default_factory=dict)
+
+    def __bool__(self) -> bool:
+        return self.ok
+
+
+def _stats(net: VirtualNetwork, n_ops: int) -> dict:
+    # msgs_per_op denominator is *workload* ops (n_ops), not all client
+    # RPCs — init/topology/final-read control traffic is excluded.  The
+    # reference README's "<20 msgs/op" (README.md:17) divides by every
+    # client op including reads, so it is not directly comparable.
+    lat = net.ledger.op_latencies
+    return {
+        "total_msgs": net.ledger.total,
+        "server_msgs": net.ledger.server_to_server,
+        "dropped_msgs": net.ledger.dropped,
+        "client_ops": net.ledger.client_ops,
+        "msgs_per_op": (net.ledger.server_to_server / n_ops
+                        if n_ops else 0.0),
+        "latency_max": max(lat) if lat else 0.0,
+        "latency_mean": sum(lat) / len(lat) if lat else 0.0,
+        "virtual_time": net.now,
+        "by_type": dict(net.ledger.by_type),
+    }
+
+
+def _make_net(n_nodes: int, program_cls, *, net_cfg: NetConfig | None = None,
+              services: tuple[str, ...] = (),
+              partitions: PartitionSchedule | None = None,
+              program_kwargs: dict | None = None) -> VirtualNetwork:
+    net = VirtualNetwork(net_cfg or NetConfig())
+    for i in range(n_nodes):
+        net.spawn(f"n{i}", program_cls(**(program_kwargs or {})))
+    for svc in services:
+        net.add_service(KVService(net, svc))
+    if partitions is not None:
+        net.drop_fn = partitions.drop_fn()
+    net.init_cluster()
+    return net
+
+
+# -- echo ---------------------------------------------------------------
+
+
+def run_echo(n_ops: int = 20, seed: int = 0) -> WorkloadResult:
+    net = _make_net(1, EchoProgram, net_cfg=NetConfig(seed=seed))
+    client = net.client("c1")
+    pairs: list[tuple[dict, dict]] = []
+    for i in range(n_ops):
+        req = {"type": "echo", "echo": f"please echo {i}"}
+        client.rpc("n0", dict(req),
+                   lambda rep, req=req: pairs.append((req, rep.body)))
+        net.run_for(0.01)
+    net.run_for(1.0)
+    ok, details = checkers.check_echo(pairs)
+    ok = ok and len(pairs) == n_ops
+    return WorkloadResult(ok, details, _stats(net, n_ops))
+
+
+# -- unique ids ---------------------------------------------------------
+
+
+def run_unique_ids(n_nodes: int = 3, n_ops: int = 200,
+                   seed: int = 0) -> WorkloadResult:
+    net = _make_net(n_nodes, UniqueIdsProgram, net_cfg=NetConfig(seed=seed))
+    client = net.client("c1")
+    ids: list[str] = []
+    for i in range(n_ops):
+        client.rpc(f"n{i % n_nodes}", {"type": "generate"},
+                   lambda rep: ids.append(rep.body.get("id")))
+        net.run_for(0.001)
+    net.run_for(1.0)
+    ok, details = checkers.check_unique_ids(ids)
+    ok = ok and len(ids) == n_ops
+    return WorkloadResult(ok, details, _stats(net, n_ops))
+
+
+# -- broadcast ----------------------------------------------------------
+
+
+def run_broadcast(n_nodes: int = 25, topology: str = "tree",
+                  n_values: int = 40, rate: float = 10.0,
+                  quiescence: float = 12.0, latency: float = 0.0,
+                  partitions: PartitionSchedule | None = None,
+                  seed: int = 0) -> WorkloadResult:
+    """Maelstrom 3a-3e shape: init, topology, broadcast ops at ``rate``
+    ops/s to round-robin nodes, quiescence, then a final read of every
+    node (BASELINE.json configs 1-2)."""
+    cfg = NetConfig(latency=latency, seed=seed)
+    net = _make_net(n_nodes, BroadcastProgram, net_cfg=cfg,
+                    partitions=partitions)
+    adj = (tree_topology(n_nodes) if topology == "tree"
+           else grid_topology(n_nodes))
+    net.set_topology(to_name_map(adj))
+
+    client = net.client("c1")
+    acked: list[int] = []
+    op_latencies: list[float] = []
+    for v in range(n_values):
+        t0 = net.now
+
+        def on_ack(rep: Message, v=v, t0=t0) -> None:
+            if rep.type == "broadcast_ok":
+                acked.append(v)
+                op_latencies.append(net.now - t0)
+
+        client.rpc(f"n{v % n_nodes}", {"type": "broadcast", "message": v},
+                   on_ack)
+        net.run_for(1.0 / rate)
+
+    server_msgs_before_reads = net.ledger.server_to_server
+    net.run_for(quiescence)
+    server_msgs = net.ledger.server_to_server
+
+    reader = net.client("c2")
+    final_reads: dict[str, list[int]] = {}
+    for i in range(n_nodes):
+        reader.rpc(f"n{i}", {"type": "read"},
+                   lambda rep, i=i: final_reads.setdefault(
+                       f"n{i}", list(rep.body.get("messages", []))))
+    net.run_for(2.0 * (latency + 0.1))
+
+    ok, details = checkers.check_broadcast_convergence(
+        final_reads, set(acked))
+    ok = ok and len(acked) == n_values and len(final_reads) == n_nodes
+    details["n_acked"] = len(acked)
+    stats = _stats(net, n_values)
+    stats["msgs_per_op"] = server_msgs / max(len(acked), 1)
+    stats["server_msgs_at_quiescence"] = server_msgs_before_reads
+    stats["broadcast_latency_max"] = max(op_latencies, default=0.0)
+    stats["broadcast_latency_mean"] = (sum(op_latencies) / len(op_latencies)
+                                       if op_latencies else 0.0)
+    return WorkloadResult(ok, details, stats)
+
+
+# -- counter ------------------------------------------------------------
+
+
+def run_counter(n_nodes: int = 3, n_ops: int = 60, rate: float = 10.0,
+                quiescence: float = 8.0,
+                partitions: PartitionSchedule | None = None,
+                seed: int = 0) -> WorkloadResult:
+    """g-counter (BASELINE.json config 3): adds at random nodes, then a
+    read-after-quiescence sum check on every node."""
+    net = _make_net(n_nodes, CounterProgram, net_cfg=NetConfig(seed=seed),
+                    services=("seq-kv",), partitions=partitions)
+    client = net.client("c1")
+    acked_deltas: list[int] = []
+    rng = net.rng
+    for i in range(n_ops):
+        delta = rng.randrange(1, 10)
+
+        def on_ack(rep: Message, delta=delta) -> None:
+            if rep.type == "add_ok":
+                acked_deltas.append(delta)
+
+        client.rpc(f"n{rng.randrange(n_nodes)}",
+                   {"type": "add", "delta": delta}, on_ack)
+        net.run_for(1.0 / rate)
+
+    net.run_for(quiescence)
+
+    reader = net.client("c2")
+    final_reads: dict[str, int] = {}
+    for i in range(n_nodes):
+        reader.rpc(f"n{i}", {"type": "read"},
+                   lambda rep, i=i: final_reads.setdefault(
+                       f"n{i}", rep.body.get("value")))
+    net.run_for(1.0)
+
+    ok, details = checkers.check_counter(final_reads, sum(acked_deltas))
+    ok = ok and len(acked_deltas) == n_ops
+    details["n_acked"] = len(acked_deltas)
+    return WorkloadResult(ok, details, _stats(net, n_ops))
+
+
+# -- kafka --------------------------------------------------------------
+
+
+def run_kafka(n_nodes: int = 2, n_keys: int = 4, n_ops: int = 120,
+              rate: float = 20.0, seed: int = 0) -> WorkloadResult:
+    """Kafka workload (Maelstrom 5a-5c shape): interleaved send / poll /
+    commit_offsets / list_committed_offsets against random nodes."""
+    net = _make_net(n_nodes, KafkaProgram, net_cfg=NetConfig(seed=seed),
+                    services=("lin-kv",))
+    client = net.client("c1")
+    rng = net.rng
+    send_acks: list[tuple[str, int, int]] = []
+    polls: list[dict[str, list[list[int]]]] = []
+    committed_reads: list[dict[str, int]] = []
+    next_msg = [0]
+    poll_cursor: dict[str, int] = {}
+
+    def do_send() -> None:
+        key = f"k{rng.randrange(n_keys)}"
+        value = next_msg[0]
+        next_msg[0] += 1
+
+        def on_ack(rep: Message) -> None:
+            if rep.type == "send_ok":
+                send_acks.append((key, rep.body["offset"], value))
+
+        client.rpc(f"n{rng.randrange(n_nodes)}",
+                   {"type": "send", "key": key, "msg": value}, on_ack)
+
+    def do_poll() -> None:
+        offsets = {f"k{k}": poll_cursor.get(f"k{k}", 0)
+                   for k in range(n_keys)}
+
+        def on_poll(rep: Message) -> None:
+            if rep.type == "poll_ok":
+                msgs = rep.body.get("msgs", {})
+                polls.append(msgs)
+                for key, pairs in msgs.items():
+                    if pairs:
+                        poll_cursor[key] = max(poll_cursor.get(key, 0),
+                                               pairs[-1][0])
+
+        client.rpc(f"n{rng.randrange(n_nodes)}",
+                   {"type": "poll", "offsets": offsets}, on_poll)
+
+    def do_commit() -> None:
+        if not poll_cursor:
+            return
+        client.rpc(f"n{rng.randrange(n_nodes)}",
+                   {"type": "commit_offsets",
+                    "offsets": dict(poll_cursor)}, lambda rep: None)
+
+    def do_list() -> None:
+        client.rpc(f"n{rng.randrange(n_nodes)}",
+                   {"type": "list_committed_offsets",
+                    "keys": [f"k{k}" for k in range(n_keys)]},
+                   lambda rep: committed_reads.append(
+                       rep.body.get("offsets", {})))
+
+    actions = [do_send, do_send, do_send, do_poll, do_commit, do_list]
+    for i in range(n_ops):
+        actions[rng.randrange(len(actions))]()
+        net.run_for(1.0 / rate)
+    net.run_for(5.0)
+
+    # final poll on every node from offset 0 to check replication agreement
+    for i in range(n_nodes):
+        client.rpc(f"n{i}", {"type": "poll",
+                             "offsets": {f"k{k}": 0
+                                         for k in range(n_keys)}},
+                   lambda rep: polls.append(rep.body.get("msgs", {})))
+    net.run_for(2.0)
+
+    committed = committed_reads[-1] if committed_reads else {}
+    ok, details = checkers.check_kafka(send_acks, polls, committed)
+    return WorkloadResult(ok, details, _stats(net, n_ops))

@@ -1,0 +1,36 @@
+"""The five challenge node programs (Layer 2 parity).
+
+Each program is a class with ``install(node)``: it registers handlers and
+timers on any runtime implementing the ``NodeCore`` surface (``handle``,
+``reply``, ``send``, ``rpc``, ``schedule``, ``rng``, ``id``,
+``get_node_ids``).  Programs are event-driven — no blocking calls — so the
+same program runs on the threaded stdio runtime (under the real Maelstrom
+harness) and on the deterministic virtual-clock harness in-repo.
+
+The batched/vectorized equivalents used by the ``tpu_sim`` backend live in
+``gossip_glomers_tpu.sim``; these scalar programs are the semantic ground
+truth they are checked against.
+"""
+
+from .broadcast import BroadcastProgram
+from .counter import CounterProgram
+from .echo import EchoProgram
+from .kafka import KafkaProgram
+from .unique_ids import UniqueIdsProgram
+
+PROGRAMS = {
+    "echo": EchoProgram,
+    "unique-ids": UniqueIdsProgram,
+    "broadcast": BroadcastProgram,
+    "counter": CounterProgram,
+    "kafka": KafkaProgram,
+}
+
+__all__ = [
+    "EchoProgram",
+    "UniqueIdsProgram",
+    "BroadcastProgram",
+    "CounterProgram",
+    "KafkaProgram",
+    "PROGRAMS",
+]

@@ -1,0 +1,99 @@
+"""Challenge 3: eventually-consistent fault-tolerant broadcast.
+
+Reference: broadcast/main.go + broadcast/broadcast.go.  Two mechanisms:
+
+1. **Eager gossip** (broadcast.go:59-79): on a new ``broadcast`` value,
+   mark it received and re-send it to every neighbor except the sender
+   (fan-out helper :50-57); duplicates are acked but not re-flooded.
+2. **Periodic push-pull anti-entropy** (main.go:42-51, broadcast.go:81-122):
+   every 2 s + uniform(0,1 s) jitter, RPC a ``read`` to each neighbor; on
+   the reply, flood values the peer has that we lack to our *other*
+   neighbors, send the peer the values we have that it lacks, then merge.
+   This is the partition-repair path.
+
+The reference guards its set with a RWMutex (broadcast.go:13-16); here
+handlers are single-threaded per node under the harness (and per-message
+threads under stdio touch only GIL-atomic set/dict ops), so the state is a
+plain set.
+"""
+
+from __future__ import annotations
+
+from ..protocol import Message
+from ..utils.config import BroadcastConfig
+
+
+class BroadcastProgram:
+    def __init__(self, config: BroadcastConfig | None = None) -> None:
+        self.cfg = config or BroadcastConfig()
+        self.received: set[int] = set()
+        self.neighbors: list[str] = []
+
+    def install(self, node) -> None:
+        cfg = self.cfg
+
+        def rebroadcast_all_except(excluded: str, value: int) -> None:
+            # reference: rebroadcastAllExcept, broadcast.go:50-57
+            for peer in self.neighbors:
+                if peer != excluded:
+                    node.send(peer, {"type": "broadcast", "message": value})
+
+        def handle_topology(msg: Message) -> None:
+            # reference: HandleTopology, broadcast.go:36-48 — store only
+            # this node's neighbor list from the harness-supplied map.
+            topology = msg.body.get("topology", {}) or {}
+            self.neighbors = list(topology.get(node.id(), []))
+            node.reply(msg, {"type": "topology_ok"})
+
+        def handle_broadcast(msg: Message) -> None:
+            # reference: HandleBroadcast, broadcast.go:59-79
+            value = msg.body["message"]
+            if value in self.received:
+                node.reply(msg, {"type": "broadcast_ok"})
+                return
+            self.received.add(value)
+            rebroadcast_all_except(msg.src, value)
+            node.reply(msg, {"type": "broadcast_ok"})
+
+        def handle_read(msg: Message) -> None:
+            # reference: HandleRead, broadcast.go:124-132
+            node.reply(msg, {"type": "read_ok",
+                             "messages": sorted(self.received)})
+
+        def sync_round() -> None:
+            # reference: SyncBroadcast, broadcast.go:81-122 — push-pull
+            # anti-entropy against every neighbor.
+            def on_peer_read(reply: Message) -> None:
+                if reply.type == "error":
+                    return  # timed-out RPC; next round retries
+                peer = reply.src
+                peer_msgs = list(reply.body.get("messages", []))
+                mine = set(self.received)
+                peer_set = set(peer_msgs)
+                for value in peer_msgs:
+                    if value not in mine:
+                        rebroadcast_all_except(peer, value)
+                for value in mine:
+                    if value not in peer_set:
+                        node.send(peer, {"type": "broadcast",
+                                         "message": value})
+                self.received |= peer_set
+
+            for peer in self.neighbors:
+                node.rpc(peer, {"type": "read"}, on_peer_read,
+                         timeout=cfg.sync_interval)
+            schedule_sync()
+
+        def schedule_sync() -> None:
+            # reference: 2 s + rand(0, 1 s) jitter, main.go:45-48
+            delay = cfg.sync_interval + node.rng.uniform(0, cfg.sync_jitter)
+            node.schedule(delay, sync_round)
+
+        def handle_init(msg: Message) -> None:
+            schedule_sync()
+
+        node.handle("init", handle_init)
+        node.handle("topology", handle_topology)
+        node.handle("broadcast", handle_broadcast)
+        node.handle("read", handle_read)
+        node.handle("broadcast_ok", lambda msg: None)

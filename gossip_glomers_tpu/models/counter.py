@@ -1,0 +1,131 @@
+"""Challenge 4: distributed grow-only counter over a seq-consistent KV.
+
+Reference: counter/main.go + counter/add.go.  The counter is materialized
+in a single shared ``seq-kv`` key ``"value"`` (add.go:13).  Semantics kept
+from the reference:
+
+- ``add`` is acked **before** durability: the delta is buffered locally and
+  flushed later (add.go:33-41).
+- A flush loop accumulates buffered deltas and pushes them with a
+  read-then-CAS; on ``precondition-failed`` it retries after a 25-75 ms
+  jittered backoff, otherwise it sleeps 200 ms between flushes
+  (add.go:43-65).
+- ``readKV`` refreshes the local cache; a missing key is initialized via
+  CAS-with-create (add.go:97-118).
+- An independent poll loop refreshes the cache every 700 ms with a 500 ms
+  timeout (main.go:50-62), and ``read`` serves the **cached** value only
+  (add.go:29-31) — deliberately weak, read-your-KV-eventually semantics.
+
+Shape difference: the reference serializes deltas through an unbuffered
+channel into a dedicated goroutine, which also delays ``add_ok`` while a
+flush is in flight; here the buffer is a plain integer and acks are
+immediate.  Both ack-before-durability designs satisfy the same g-counter
+contract (final read equals the sum of acked adds after quiescence).
+"""
+
+from __future__ import annotations
+
+from ..protocol import KEY_DOES_NOT_EXIST, PRECONDITION_FAILED, Message
+from ..runtime.kv import AsyncKV, SEQ_KV
+from ..utils.config import CounterConfig
+
+
+class CounterProgram:
+    def __init__(self, config: CounterConfig | None = None) -> None:
+        self.cfg = config or CounterConfig()
+        self.val = 0          # local cache of the KV value (flushed state)
+        self.pending = 0      # acked but unflushed deltas
+        self.flushing = False
+
+    def install(self, node) -> None:
+        cfg = self.cfg
+        kv = AsyncKV(node, SEQ_KV, timeout=cfg.kv_op_timeout)
+
+        def handle_read(msg: Message) -> None:
+            # reference: HandleRead serves the local cache, add.go:29-31
+            node.reply(msg, {"type": "read_ok", "value": self.val})
+
+        def handle_add(msg: Message) -> None:
+            # reference: HandleAdd, add.go:33-41 — ack precedes durability.
+            # The lock replaces the reference's channel serialization of
+            # deltas (add.go:39) on the threaded stdio runtime.
+            with node.state_lock:
+                self.pending += int(msg.body.get("delta", 0))
+            node.reply(msg, {"type": "add_ok"})
+
+        # -- flush state machine (reference: kvUpdater + updateKV,
+        #    add.go:43-95) --------------------------------------------------
+
+        def flush_tick() -> None:
+            if self.pending > 0 and not self.flushing:
+                self.flushing = True
+                start_update(self.pending)
+            else:
+                node.schedule(cfg.flush_interval, flush_tick)
+
+        def start_update(delta: int) -> None:
+            # updateKV: refresh cache, then CAS val -> val+delta
+            def after_read(ok: bool) -> None:
+                if not ok:
+                    finish(False, delta)
+                    return
+                kv.cas(cfg.kv_key, self.val, self.val + delta,
+                       lambda _v, err: after_cas(err, delta),
+                       create_if_not_exists=False)
+
+            read_kv(after_read)
+
+        def after_cas(err, delta: int) -> None:
+            if err is None:
+                with node.state_lock:
+                    self.val += delta
+                    self.pending -= delta
+                finish(True, delta)
+            elif err.code == PRECONDITION_FAILED:
+                # contention: jittered short retry, add.go:56-58
+                node.log(str(err))
+                node.schedule(node.rng.uniform(cfg.retry_min, cfg.retry_max),
+                              lambda: start_update(self.pending))
+            else:
+                node.log(str(err))
+                finish(False, delta)
+
+        def finish(_succeeded: bool, _delta: int) -> None:
+            self.flushing = False
+            node.schedule(cfg.flush_interval, flush_tick)
+
+        def read_kv(cont, timeout: float | None = None) -> None:
+            # reference: readKV, add.go:97-118
+            def on_read(value, err) -> None:
+                if err is None:
+                    self.val = int(value)
+                    cont(True)
+                elif err.code == KEY_DOES_NOT_EXIST:
+                    # initialize the key, keeping the cache as-is
+                    kv.cas(cfg.kv_key, self.val, self.val,
+                           lambda _v, _e: cont(True),
+                           create_if_not_exists=True)
+                else:
+                    node.log(str(err))
+                    cont(False)
+
+            kv.read(cfg.kv_key, on_read, timeout=timeout)
+
+        # -- background poll (reference: counter/main.go:50-62) -------------
+
+        def poll_tick() -> None:
+            read_kv(lambda _ok: node.schedule(cfg.poll_interval, poll_tick),
+                    timeout=cfg.poll_timeout)
+
+        def handle_init(msg: Message) -> None:
+            # reference gates both goroutines on init via nodeReady
+            # (main.go:25-28, :42-48)
+            node.schedule(cfg.flush_interval, flush_tick)
+            node.schedule(cfg.poll_interval, poll_tick)
+
+        node.handle("init", handle_init)
+        # reference registers a no-op topology handler with no reply
+        # (counter/main.go:30-32)
+        node.handle("topology", lambda msg: None)
+        node.handle("read", handle_read)
+        node.handle("add", handle_add)

@@ -1,0 +1,22 @@
+"""Challenge 1: echo — single-node smoke test.
+
+Reference: echo/main.go:10-24.  Replies to ``echo`` with the request body
+echoed back and ``type`` rewritten to ``echo_ok``.
+"""
+
+from __future__ import annotations
+
+from ..protocol import Message
+
+
+class EchoProgram:
+    def __init__(self, config=None) -> None:
+        pass
+
+    def install(self, node) -> None:
+        def handle_echo(msg: Message) -> None:
+            body = dict(msg.body)
+            body["type"] = "echo_ok"
+            node.reply(msg, body)
+
+        node.handle("echo", handle_echo)

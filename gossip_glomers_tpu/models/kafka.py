@@ -1,0 +1,277 @@
+"""Challenge 5: Kafka-style replicated append-only log.
+
+Reference: kafka/main.go + kafka/log.go + kafka/logmap.go.  "Kafka with
+acks=0" (reference README.md:22-24): centralized linearizable offset
+allocation from ``lin-kv`` plus fire-and-forget full-mesh replication.
+
+Semantics kept from the reference:
+
+- ``send``: allocate the next offset for the key via a read/CAS loop
+  against lin-kv (missing key → offset 1; retry on CAS-mismatch code 22;
+  at most 10 tries — logmap.go:255-285), append locally, fire
+  ``replicate_msg`` to every other node with no ack (log.go:159-175),
+  reply ``send_ok{offset}``.
+- ``replicate_msg`` receivers insert in offset order, idempotently on
+  duplicate offsets, and bump a per-key high-water mark
+  (logmap.go:302-322).
+- ``poll``: served from the local log only (log.go:79-110).
+- ``commit_offsets``: monotonic-max into lin-kv via a read/write/CAS dance
+  with retries (logmap.go:134-198), skipping keys whose local committed
+  offset is already >= the request (logmap.go:247-253).
+- ``list_committed_offsets``: local cache only — deliberately not synced
+  (log.go:131-156).
+
+Reference quirks reproduced on purpose (they are observable behavior):
+
+- The local append after allocation sets the per-key ``commit`` high-water
+  mark to the new offset unconditionally (logmap.go:298), while the
+  replicate path takes a max (logmap.go:309-311).
+- The commit-offsets retry loop treats error code **21**
+  (key-already-exists) as the retriable CAS conflict (logmap.go:46-52)
+  even though the allocator's loop retries on **22** (logmap.go:275);
+  timeouts retry in both.
+
+One deliberate divergence: the reference's post-allocation local append is
+a blind ``append`` (logmap.go:297), which can break the sorted-offsets
+invariant if a peer's higher-offset ``replicate_msg`` lands first; we use
+the same sorted-insert as the replicate path.  Observable behavior under
+the reference's own checkers is identical.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Callable
+
+from ..protocol import (KEY_ALREADY_EXISTS, KEY_DOES_NOT_EXIST,
+                        PRECONDITION_FAILED, TIMEOUT, Message, RPCError)
+from ..runtime.kv import AsyncKV, LIN_KV
+from ..utils.config import KafkaConfig
+
+
+class _KeyLog:
+    """Per-key sorted log + committed-offset HWM (reference: keyData,
+    logmap.go:35-39)."""
+
+    __slots__ = ("offsets", "msgs", "commit")
+
+    def __init__(self) -> None:
+        self.offsets: list[int] = []
+        self.msgs: list[int] = []
+        self.commit = 0
+
+    def insert(self, offset: int, msg: int) -> None:
+        idx = bisect.bisect_left(self.offsets, offset)
+        if idx < len(self.offsets) and self.offsets[idx] == offset:
+            return  # idempotent on duplicate offset (logmap.go:315-317)
+        self.offsets.insert(idx, offset)
+        self.msgs.insert(idx, msg)
+
+    def from_offset(self, offset: int) -> list[list[int]]:
+        # first entry with offset >= requested (logmap.go:109-116)
+        idx = bisect.bisect_left(self.offsets, offset)
+        return [[o, m] for o, m in zip(self.offsets[idx:], self.msgs[idx:])]
+
+
+class KafkaProgram:
+    def __init__(self, config: KafkaConfig | None = None) -> None:
+        self.cfg = config or KafkaConfig()
+        self.logs: dict[str, _KeyLog] = {}
+
+    def _key(self, k: str) -> _KeyLog:
+        if k not in self.logs:
+            self.logs[k] = _KeyLog()
+        return self.logs[k]
+
+    def install(self, node) -> None:
+        cfg = self.cfg
+        kv = AsyncKV(node, LIN_KV, timeout=cfg.kv_timeout)
+
+        # -- offset allocation (reference: getNextOffsetKV,
+        #    logmap.go:255-285) --------------------------------------------
+
+        def alloc_offset(key: str,
+                         cont: Callable[[int | None], None]) -> None:
+            tries = [0]
+
+            def attempt() -> None:
+                if tries[0] >= cfg.kv_retries:
+                    cont(None)  # max retries exceeded
+                    return
+                tries[0] += 1
+
+                def on_read(value, err) -> None:
+                    if err is not None:
+                        if err.code == KEY_DOES_NOT_EXIST:
+                            current = cfg.default_offset
+                        else:
+                            cont(None)
+                            return
+                    else:
+                        current = int(value)
+                    kv.cas(key, current, current + cfg.offset_inc,
+                           lambda _v, cas_err: on_cas(cas_err, current),
+                           create_if_not_exists=True,
+                           timeout=cfg.cas_timeout)
+
+                def on_cas(cas_err, current: int) -> None:
+                    if cas_err is None:
+                        cont(current)
+                    elif cas_err.code == PRECONDITION_FAILED:
+                        attempt()  # CAS lost the race; retry
+                    else:
+                        cont(None)
+
+                kv.read(key, on_read, timeout=cfg.cas_timeout)
+
+            attempt()
+
+        # -- send + replication (reference: HandleSend log.go:59-77,
+        #    sendReplicateMsg log.go:159-175) -------------------------------
+
+        def handle_send(msg: Message) -> None:
+            key = str(msg.body["key"])
+            value = msg.body["msg"]
+
+            def on_offset(offset: int | None) -> None:
+                if offset is None:
+                    node.reply(msg, RPCError(
+                        TIMEOUT, "offset allocation failed").to_body())
+                    return
+                with node.state_lock:  # per-key RWMutex role, logmap.go:35
+                    kd = self._key(key)
+                    kd.insert(offset, value)
+                    kd.commit = offset  # unconditional HWM, logmap.go:298
+                for peer in node.get_node_ids():
+                    if peer != node.id():
+                        node.send(peer, {"type": "replicate_msg",
+                                         "key": key, "msg": value,
+                                         "offset": offset})
+                node.reply(msg, {"type": "send_ok", "offset": offset})
+
+            alloc_offset(key, on_offset)
+
+        def handle_replicate(msg: Message) -> None:
+            # reference: HandleReplicateMsg log.go:177-192 → AppendMsgLocal
+            # logmap.go:302-322; no reply (fire-and-forget).
+            key = str(msg.body["key"])
+            offset = int(msg.body["offset"])
+            with node.state_lock:
+                kd = self._key(key)
+                if offset > kd.commit:
+                    kd.commit = offset
+                kd.insert(offset, msg.body["msg"])
+
+        # -- poll (reference: HandlePoll log.go:79-110) ---------------------
+
+        def handle_poll(msg: Message) -> None:
+            req = msg.body.get("offsets", {}) or {}
+            out = {}
+            with node.state_lock:
+                for key, offset in req.items():
+                    kd = self.logs.get(str(key))
+                    out[key] = kd.from_offset(int(offset)) if kd else []
+            node.reply(msg, {"type": "poll_ok", "msgs": out})
+
+        # -- commit offsets (reference: HandleCommitOffsets log.go:112-129
+        #    → CommitOffset/setKVOffset/trySetKVOffset logmap.go:134-253) ---
+
+        def try_set_kv_offset(key: str, offset: int,
+                              cont: Callable[[int | None, RPCError | None],
+                                             None]) -> None:
+            def on_read(value, err) -> None:
+                if err is not None:
+                    if err.code == KEY_DOES_NOT_EXIST:
+                        kv.write(key, offset, on_write,
+                                 timeout=cfg.cas_timeout)
+                    else:
+                        cont(None, err)
+                    return
+                read_offset = int(value)
+                if read_offset >= offset:
+                    cont(read_offset, None)
+                    return
+                kv.cas(key, read_offset, offset,
+                       lambda _v, cas_err: cont(offset, None)
+                       if cas_err is None else cont(None, cas_err),
+                       create_if_not_exists=True, timeout=cfg.cas_timeout)
+
+            def on_write(_value, err) -> None:
+                if err is None:
+                    cont(offset, None)
+                elif err.code == KEY_ALREADY_EXISTS:
+                    # lost the create race; re-run the whole dance
+                    # (logmap.go:143-149)
+                    try_set_kv_offset(key, offset, cont)
+                else:
+                    cont(None, err)
+
+            kv.read(key, on_read, timeout=cfg.cas_timeout)
+
+        def set_kv_offset(key: str, offset: int,
+                          cont: Callable[[int | None], None]) -> None:
+            tries = [0]
+
+            def attempt() -> None:
+                tries[0] += 1
+
+                def done(new_offset, err) -> None:
+                    if err is None:
+                        cont(new_offset)
+                        return
+                    # retriable: code 21 (reference quirk, logmap.go:46-52)
+                    # or timeout (logmap.go:177-181)
+                    if (err.code in (KEY_ALREADY_EXISTS, TIMEOUT)
+                            and tries[0] < cfg.kv_retries):
+                        attempt()
+                    else:
+                        cont(None)
+
+                try_set_kv_offset(key, offset, done)
+
+            attempt()
+
+        def handle_commit_offsets(msg: Message) -> None:
+            items = list((msg.body.get("offsets", {}) or {}).items())
+
+            def step(i: int) -> None:
+                if i >= len(items):
+                    node.reply(msg, {"type": "commit_offsets_ok"})
+                    return
+                key, offset = str(items[i][0]), int(items[i][1])
+                kd = self.logs.get(key)
+                # skip if local committed offset already >= request
+                # (logmap.go:247-253)
+                if kd is not None and kd.commit != 0 and kd.commit >= offset:
+                    step(i + 1)
+                    return
+
+                def done(new_offset) -> None:
+                    if new_offset is not None:
+                        self._key(key).commit = new_offset
+                    step(i + 1)
+
+                set_kv_offset(key, offset, done)
+
+            step(0)
+
+        # -- list committed offsets (reference: log.go:131-156; local cache
+        #    only, sync variant deliberately absent) ------------------------
+
+        def handle_list_committed(msg: Message) -> None:
+            out = {}
+            for key in msg.body.get("keys", []) or []:
+                kd = self.logs.get(str(key))
+                if kd is not None and kd.commit != 0:
+                    out[key] = kd.commit
+            node.reply(msg, {"type": "list_committed_offsets_ok",
+                             "offsets": out})
+
+        node.handle("send", handle_send)
+        node.handle("poll", handle_poll)
+        node.handle("commit_offsets", handle_commit_offsets)
+        node.handle("list_committed_offsets", handle_list_committed)
+        node.handle("replicate_msg", handle_replicate)
+        # reference registers a no-op topology handler with no reply
+        # (kafka/main.go:29-31)
+        node.handle("topology", lambda msg: None)

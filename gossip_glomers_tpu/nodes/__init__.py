@@ -1,0 +1,16 @@
+"""Executable node entrypoints, drop-in Maelstrom binaries.
+
+Run as e.g. ``python -m gossip_glomers_tpu.nodes.broadcast`` — each module
+plays the role of the reference's compiled Go binary (e.g.
+``broadcast/maelstrom-broadcast``): Maelstrom (or the in-repo harness's
+subprocess mode) spawns N copies and speaks line-JSON over stdio.
+"""
+
+from ..models import PROGRAMS
+from ..runtime import StdioNode
+
+
+def run_program(name: str) -> None:
+    node = StdioNode()
+    PROGRAMS[name]().install(node)
+    node.run()

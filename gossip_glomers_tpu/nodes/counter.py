@@ -1,0 +1,6 @@
+"""Maelstrom-executable node: counter challenge."""
+
+from . import run_program
+
+if __name__ == "__main__":
+    run_program("counter")

@@ -1,0 +1,6 @@
+"""Maelstrom-executable node: echo challenge."""
+
+from . import run_program
+
+if __name__ == "__main__":
+    run_program("echo")

@@ -1,0 +1,6 @@
+"""Maelstrom-executable node: unique-ids challenge."""
+
+from . import run_program
+
+if __name__ == "__main__":
+    run_program("unique-ids")

@@ -1,0 +1,23 @@
+"""Mesh/sharding/collectives + topology builders for the tpu_sim backend."""
+
+from .topology import (
+    full,
+    grid,
+    line,
+    random_regular,
+    ring,
+    to_name_map,
+    to_padded_neighbors,
+    tree,
+)
+
+__all__ = [
+    "tree",
+    "grid",
+    "ring",
+    "line",
+    "full",
+    "random_regular",
+    "to_name_map",
+    "to_padded_neighbors",
+]

@@ -1,0 +1,109 @@
+"""Topology builders: adjacency lists for clusters of N nodes.
+
+The reference gets its topology from Maelstrom's harness-supplied map
+(consumed at broadcast/broadcast.go:36-48); the topologies themselves
+(grid default, ``--topology tree4``, etc.) live in the external harness.
+These builders provide the same families natively, as integer adjacency
+lists usable both by the virtual-clock harness (via ``to_name_map``) and
+by the vectorized tpu_sim backend (via ``to_padded_neighbors``).
+
+The reference README notes tree was its best-performing broadcast
+topology (README.md:19).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def tree(n: int, branching: int = 4) -> list[list[int]]:
+    """k-ary tree (Maelstrom's ``tree4`` shape for k=4): node i's parent
+    is (i-1)//k; neighbors are parent + children."""
+    adj: list[list[int]] = [[] for _ in range(n)]
+    for i in range(1, n):
+        parent = (i - 1) // branching
+        adj[i].append(parent)
+        adj[parent].append(i)
+    return adj
+
+
+def grid(n: int) -> list[list[int]]:
+    """2D grid (Maelstrom's default broadcast topology): ceil(sqrt(n))
+    columns, neighbors up/down/left/right."""
+    cols = max(1, math.isqrt(n - 1) + 1) if n > 1 else 1
+    adj: list[list[int]] = [[] for _ in range(n)]
+    for i in range(n):
+        r, c = divmod(i, cols)
+        for dr, dc in ((-1, 0), (1, 0), (0, -1), (0, 1)):
+            rr, cc = r + dr, c + dc
+            j = rr * cols + cc
+            if rr >= 0 and cc >= 0 and cc < cols and 0 <= j < n:
+                adj[i].append(j)
+    return adj
+
+
+def ring(n: int) -> list[list[int]]:
+    if n == 1:
+        return [[]]
+    if n == 2:
+        return [[1], [0]]
+    return [[(i - 1) % n, (i + 1) % n] for i in range(n)]
+
+
+def line(n: int) -> list[list[int]]:
+    adj: list[list[int]] = [[] for _ in range(n)]
+    for i in range(n - 1):
+        adj[i].append(i + 1)
+        adj[i + 1].append(i)
+    return adj
+
+
+def full(n: int) -> list[list[int]]:
+    return [[j for j in range(n) if j != i] for i in range(n)]
+
+
+def random_regular(n: int, degree: int, seed: int = 0) -> np.ndarray:
+    """Directed random graph with out-degree exactly ``degree``, built
+    from ``degree`` seeded derangement-ish permutations (each permutation
+    contributes in-degree exactly 1 per node).  O(n·degree) memory, fully
+    vectorized — the construction the 1M-node epidemic benchmark uses
+    (BASELINE.json config 4).
+
+    Returns an (n, degree) int32 array of neighbor indices.
+    """
+    rng = np.random.default_rng(seed)
+    cols = []
+    for _ in range(degree):
+        perm = rng.permutation(n)
+        # Avoid self-loops while keeping perm a permutation (in-degree
+        # exactly 1 per node): cycle the targets of fixed points among
+        # themselves.  A single fixed point swaps with its successor.
+        fixed = np.flatnonzero(perm == np.arange(n))
+        if len(fixed) == 1 and n > 1:
+            j = (fixed[0] + 1) % n
+            perm[[fixed[0], j]] = perm[[j, fixed[0]]]
+        elif len(fixed) > 1:
+            perm[fixed] = np.roll(perm[fixed], 1)
+        cols.append(perm)
+    return np.stack(cols, axis=1).astype(np.int32)
+
+
+def to_name_map(adj: list[list[int]],
+                prefix: str = "n") -> dict[str, list[str]]:
+    """Adjacency list → Maelstrom-style topology map of node names."""
+    return {f"{prefix}{i}": [f"{prefix}{j}" for j in nbrs]
+            for i, nbrs in enumerate(adj)}
+
+
+def to_padded_neighbors(adj: list[list[int]],
+                        fill: int = -1) -> np.ndarray:
+    """Adjacency list → (n, max_degree) int32 array padded with ``fill``
+    (static shapes for jit; survey §7 "dynamic shapes" hard part)."""
+    n = len(adj)
+    deg = max((len(a) for a in adj), default=0)
+    out = np.full((n, max(deg, 1)), fill, dtype=np.int32)
+    for i, nbrs in enumerate(adj):
+        out[i, :len(nbrs)] = nbrs
+    return out

@@ -1,0 +1,36 @@
+"""Wire/protocol layer: Maelstrom message envelope, body schemas, errors."""
+
+from .errors import (
+    ABORT,
+    CRASH,
+    KEY_ALREADY_EXISTS,
+    KEY_DOES_NOT_EXIST,
+    MALFORMED_REQUEST,
+    NOT_SUPPORTED,
+    PRECONDITION_FAILED,
+    TEMPORARILY_UNAVAILABLE,
+    TIMEOUT,
+    TXN_CONFLICT,
+    ERROR_NAMES,
+    RPCError,
+)
+from .wire import Message, decode_line, encode_line, make_body
+
+__all__ = [
+    "Message",
+    "decode_line",
+    "encode_line",
+    "make_body",
+    "RPCError",
+    "ERROR_NAMES",
+    "TIMEOUT",
+    "NOT_SUPPORTED",
+    "TEMPORARILY_UNAVAILABLE",
+    "MALFORMED_REQUEST",
+    "CRASH",
+    "ABORT",
+    "KEY_DOES_NOT_EXIST",
+    "KEY_ALREADY_EXISTS",
+    "PRECONDITION_FAILED",
+    "TXN_CONFLICT",
+]

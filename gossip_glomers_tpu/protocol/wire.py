@@ -1,0 +1,75 @@
+"""Maelstrom wire format: line-delimited JSON message envelopes.
+
+Every message in the system is one JSON object per line:
+
+    {"src": "n1", "dest": "n2", "body": {"type": "...", "msg_id": 1,
+                                         "in_reply_to": 2, ...}}
+
+(reference: the external Maelstrom harness routes these over each node
+process's stdin/stdout; the Go client's ``Message{Src, Dest, Body}`` is the
+per-process view — survey §2b.)
+
+Message ``type`` vocabulary used across the five challenges
+(reference handler registrations: echo/main.go:12, unique-ids/main.go:25,36,
+broadcast/main.go:22-40, counter/main.go:25-40, kafka/main.go:25-51):
+
+    init, init_ok, topology, topology_ok, echo, echo_ok, generate,
+    generate_ok, broadcast, broadcast_ok, read, read_ok, add, add_ok,
+    send, send_ok, poll, poll_ok, commit_offsets, commit_offsets_ok,
+    list_committed_offsets, list_committed_offsets_ok, replicate_msg,
+    error — plus KV service ops: read, write, write_ok, cas, cas_ok.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class Message:
+    """One message envelope. ``body`` is a plain dict (decoded JSON)."""
+
+    src: str
+    dest: str
+    body: dict = field(default_factory=dict)
+
+    @property
+    def type(self) -> str:
+        return self.body.get("type", "")
+
+    @property
+    def msg_id(self) -> int | None:
+        return self.body.get("msg_id")
+
+    @property
+    def in_reply_to(self) -> int | None:
+        return self.body.get("in_reply_to")
+
+    def to_json(self) -> dict:
+        return {"src": self.src, "dest": self.dest, "body": self.body}
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "Message":
+        return cls(src=obj.get("src", ""), dest=obj.get("dest", ""),
+                   body=obj.get("body", {}) or {})
+
+
+def encode_line(msg: Message) -> str:
+    """Serialize a message to one newline-terminated JSON line."""
+    return json.dumps(msg.to_json(), separators=(",", ":")) + "\n"
+
+
+def decode_line(line: str) -> Message:
+    """Parse one line of JSON into a Message."""
+    return Message.from_json(json.loads(line))
+
+
+def make_body(type_: str, **fields: Any) -> dict:
+    """Convenience constructor: ``make_body("echo_ok", echo="x")``."""
+    body = {"type": type_}
+    for k, v in fields.items():
+        if v is not None:
+            body[k] = v
+    return body

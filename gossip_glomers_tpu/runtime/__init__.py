@@ -1,0 +1,17 @@
+"""Node runtime layer (Layer 1 parity with the Maelstrom Go client).
+
+``NodeCore`` holds the transport-independent logic: handler registry,
+msg-id allocation, RPC reply correlation, ``init`` bookkeeping.  Two
+concrete runtimes exist:
+
+- ``StdioNode`` (here) — a real per-process runtime speaking line-delimited
+  JSON over stdin/stdout, drop-in compatible with the external Maelstrom
+  harness.
+- ``harness.network.SimNodeRuntime`` — the same surface on a deterministic
+  virtual clock inside the in-repo harness.
+"""
+
+from .kv import KV, AsyncKV
+from .node import NodeCore, StdioNode
+
+__all__ = ["NodeCore", "StdioNode", "KV", "AsyncKV"]

@@ -1,0 +1,111 @@
+"""Clients for Maelstrom's ``seq-kv`` / ``lin-kv`` service nodes.
+
+Mirrors the Go client's ``KV`` (kv.go, surveyed from binaries; survey §2b):
+``NewSeqKV``/``NewLinKV`` construct a client addressing the harness-provided
+service over the normal message transport; ops are ``read``/``write``/``cas``
+bodies with keys ``key``, ``value``, ``from``, ``to``,
+``create_if_not_exists``.
+
+``AsyncKV`` is the event-driven client the challenge programs use —
+continuation-passing, so it runs identically on the threaded stdio runtime
+and the deterministic virtual-clock harness.  ``KV`` is the blocking
+API-parity wrapper (stdio runtime only), matching the reference call shape
+``kv.ReadInt(ctx, key)`` / ``kv.CompareAndSwap(ctx, key, from, to,
+create)`` used at counter/add.go:99,76 and kafka/logmap.go:121,159,272.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..protocol import Message, RPCError
+
+SEQ_KV = "seq-kv"
+LIN_KV = "lin-kv"
+LWW_KV = "lww-kv"
+
+# callback(value, error): exactly one of the two is non-None
+# (value may be None for ops with no result, when error is None).
+KVCallback = Callable[[Any, RPCError | None], None]
+
+
+class AsyncKV:
+    """Continuation-passing KV client over ``node.rpc``."""
+
+    def __init__(self, node, service: str = SEQ_KV,
+                 timeout: float = 1.0) -> None:
+        self.node = node
+        self.service = service
+        self.timeout = timeout
+
+    def _call(self, body: dict, cb: KVCallback, result_key: str | None,
+              timeout: float | None = None) -> None:
+        def _on_reply(reply: Message) -> None:
+            if reply.type == "error":
+                cb(None, RPCError.from_body(reply.body))
+            else:
+                value = reply.body.get(result_key) if result_key else None
+                cb(value, None)
+
+        self.node.rpc(self.service, body, _on_reply,
+                      timeout=self.timeout if timeout is None else timeout)
+
+    def read(self, key: str, cb: KVCallback,
+             timeout: float | None = None) -> None:
+        self._call({"type": "read", "key": key}, cb, "value", timeout)
+
+    def write(self, key: str, value: Any, cb: KVCallback,
+              timeout: float | None = None) -> None:
+        self._call({"type": "write", "key": key, "value": value}, cb, None,
+                   timeout)
+
+    def cas(self, key: str, from_: Any, to: Any, cb: KVCallback,
+            create_if_not_exists: bool = False,
+            timeout: float | None = None) -> None:
+        self._call({"type": "cas", "key": key, "from": from_, "to": to,
+                    "create_if_not_exists": create_if_not_exists}, cb, None,
+                   timeout)
+
+
+class KV:
+    """Blocking KV client (stdio runtime only; wraps ``node.sync_rpc``)."""
+
+    def __init__(self, node, service: str = SEQ_KV,
+                 timeout: float = 1.0) -> None:
+        self.node = node
+        self.service = service
+        self.timeout = timeout
+
+    def read(self, key: str, timeout: float | None = None) -> Any:
+        reply = self.node.sync_rpc(
+            self.service, {"type": "read", "key": key},
+            timeout=timeout or self.timeout)
+        return reply.body.get("value")
+
+    def read_int(self, key: str, timeout: float | None = None) -> int:
+        return int(self.read(key, timeout=timeout))
+
+    def write(self, key: str, value: Any,
+              timeout: float | None = None) -> None:
+        self.node.sync_rpc(self.service,
+                           {"type": "write", "key": key, "value": value},
+                           timeout=timeout or self.timeout)
+
+    def compare_and_swap(self, key: str, from_: Any, to: Any,
+                         create_if_not_exists: bool = False,
+                         timeout: float | None = None) -> None:
+        self.node.sync_rpc(
+            self.service,
+            {"type": "cas", "key": key, "from": from_, "to": to,
+             "create_if_not_exists": create_if_not_exists},
+            timeout=timeout or self.timeout)
+
+
+def new_seq_kv(node, timeout: float = 1.0) -> AsyncKV:
+    """Reference: maelstrom.NewSeqKV(n), counter/main.go:21."""
+    return AsyncKV(node, SEQ_KV, timeout)
+
+
+def new_lin_kv(node, timeout: float = 1.0) -> AsyncKV:
+    """Reference: maelstrom.NewLinKV(n), kafka/main.go:17."""
+    return AsyncKV(node, LIN_KV, timeout)

@@ -1,0 +1,254 @@
+"""Node runtime: transport-independent core + stdio (Maelstrom) runtime.
+
+Reimplements the surface of the Maelstrom Go client's ``Node`` (surveyed
+from node.go symbols embedded in the reference's checked-in binaries;
+survey §2b): ``Handle``, ``Run``, ``Send``, ``Reply``, ``RPC``,
+``SyncRPC``, ``ID``, ``NodeIDs``, automatic ``init``/``init_ok``
+bookkeeping, and reply→callback correlation via ``in_reply_to``.
+
+Design difference from the reference (deliberate, TPU-first): the core is
+**event-driven**.  Handlers never block; long-running behavior (the
+broadcast anti-entropy timer, the counter's flush loop, kafka's CAS retry
+loops) is expressed as timers + RPC continuations.  That makes the exact
+same challenge programs runnable on three backends: this threaded stdio
+runtime, the deterministic virtual-clock harness, and (in batched form)
+the ``tpu_sim`` vectorized backend.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import threading
+from typing import Any, Callable
+
+from ..protocol import Message, RPCError, TIMEOUT, decode_line, encode_line
+
+Handler = Callable[[Message], None]
+ReplyCallback = Callable[[Message], None]
+
+
+class NodeCore:
+    """Transport-independent node logic.
+
+    Subclasses implement ``_transmit(msg)`` (put a message on the wire),
+    ``schedule(delay, fn)`` (run ``fn`` after ``delay`` seconds of this
+    runtime's notion of time) and ``now()``; everything else is shared.
+    """
+
+    def __init__(self) -> None:
+        self.node_id: str = ""
+        self.node_ids: list[str] = []
+        self._handlers: dict[str, Handler] = {}
+        self._callbacks: dict[int, ReplyCallback] = {}
+        self._next_msg_id = 0
+        self._lock = threading.Lock()
+        # Programs guard read-modify-write state sections with this (the
+        # role the reference's RWMutex/channel plays: broadcast.go:13-16,
+        # add.go:39).  Uncontended on the single-threaded harness runtime.
+        self.state_lock = threading.RLock()
+        self.rng = random.Random(0)
+
+    # -- registration -----------------------------------------------------
+
+    def handle(self, type_: str, fn: Handler) -> None:
+        """Register ``fn`` for messages whose body type is ``type_``
+        (reference: Node.Handle, used at e.g. broadcast/main.go:22-40)."""
+        if type_ in self._handlers:
+            raise ValueError(f"duplicate handler for {type_!r}")
+        self._handlers[type_] = fn
+
+    # -- identity ---------------------------------------------------------
+
+    def id(self) -> str:
+        return self.node_id
+
+    def get_node_ids(self) -> list[str]:
+        return list(self.node_ids)
+
+    # -- outbound ---------------------------------------------------------
+
+    def _alloc_msg_id(self) -> int:
+        with self._lock:
+            self._next_msg_id += 1
+            return self._next_msg_id
+
+    def send(self, dest: str, body: dict) -> None:
+        """Fire-and-forget send; no msg_id, no reply expected
+        (reference: Node.Send, e.g. broadcast/broadcast.go:55)."""
+        self._transmit(Message(self.node_id, dest, dict(body)))
+
+    def reply(self, req: Message, body: dict) -> None:
+        """Reply to ``req``: same body plus ``in_reply_to`` = request
+        msg_id (reference: Node.Reply, e.g. echo/main.go:19)."""
+        out = dict(body)
+        if req.msg_id is not None:
+            out["in_reply_to"] = req.msg_id
+        self._transmit(Message(self.node_id, req.src, out))
+
+    def rpc(self, dest: str, body: dict, callback: ReplyCallback,
+            timeout: float | None = None) -> int:
+        """Async request: assign a msg_id, register ``callback`` for the
+        reply (reference: Node.RPC, broadcast/broadcast.go:120).
+
+        If ``timeout`` is given and no reply arrives in time, the callback
+        fires once with a synthetic ``error`` body, code 0 (timeout) — the
+        analogue of a Go context deadline on SyncRPC.
+        """
+        msg_id = self._alloc_msg_id()
+        out = dict(body)
+        out["msg_id"] = msg_id
+        with self._lock:
+            self._callbacks[msg_id] = callback
+        self._transmit(Message(self.node_id, dest, out))
+        if timeout is not None:
+            def _expire() -> None:
+                with self._lock:
+                    cb = self._callbacks.pop(msg_id, None)
+                if cb is not None:
+                    err = RPCError(TIMEOUT, "rpc timeout").to_body(msg_id)
+                    cb(Message(dest, self.node_id, err))
+            self.schedule(timeout, _expire)
+        return msg_id
+
+    # -- inbound ----------------------------------------------------------
+
+    def deliver(self, msg: Message) -> None:
+        """Dispatch one inbound message (reference: the per-message work of
+        Node.Run — handleInitMessage / handleCallback / handleMessage)."""
+        body = msg.body
+        irt = msg.in_reply_to
+        if irt is not None:
+            with self._lock:
+                cb = self._callbacks.pop(irt, None)
+            if cb is None:
+                self.log(f"Ignoring reply to {irt} with no callback")
+                return
+            cb(msg)
+            return
+        if msg.type == "init":
+            self._handle_init(msg)
+            return
+        handler = self._handlers.get(msg.type)
+        if handler is None:
+            # The reference client treats this as fatal: Run() returns
+            # "No handler for %s" and every main() exits via log.Fatal.
+            self.on_unhandled(msg)
+            return
+        handler(msg)
+
+    def on_unhandled(self, msg: Message) -> None:
+        self.log(f"No handler for {json.dumps(msg.to_json())}")
+
+    def _handle_init(self, msg: Message) -> None:
+        self.node_id = msg.body.get("node_id", "")
+        self.node_ids = list(msg.body.get("node_ids", []))
+        user_init = self._handlers.get("init")
+        if user_init is not None:
+            user_init(msg)
+        self.log(f"Node {self.node_id} initialized")
+        self.reply(msg, {"type": "init_ok"})
+
+    # -- to be provided by the runtime ------------------------------------
+
+    def _transmit(self, msg: Message) -> None:
+        raise NotImplementedError
+
+    def schedule(self, delay: float, fn: Callable[[], None]) -> None:
+        raise NotImplementedError
+
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def log(self, text: str) -> None:
+        raise NotImplementedError
+
+
+class StdioNode(NodeCore):
+    """Per-process runtime over stdin/stdout, Maelstrom-compatible.
+
+    Matches the Go client's process model: one handler invocation per
+    thread (Go: goroutine per message), stdout serialized by a lock,
+    diagnostics to stderr (reference log strings: "Node %s initialized",
+    "Sent %s", "Received %s").
+    """
+
+    def __init__(self, in_stream=None, out_stream=None, err_stream=None):
+        super().__init__()
+        self._in = in_stream if in_stream is not None else sys.stdin
+        self._out = out_stream if out_stream is not None else sys.stdout
+        self._err = err_stream if err_stream is not None else sys.stderr
+        self._out_lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self.rng = random.Random()
+
+    def _transmit(self, msg: Message) -> None:
+        line = encode_line(msg)
+        with self._out_lock:
+            self._out.write(line)
+            self._out.flush()
+        self.log(f"Sent {line.strip()}")
+
+    def schedule(self, delay: float, fn: Callable[[], None]) -> None:
+        t = threading.Timer(delay, fn)
+        t.daemon = True
+        t.start()
+
+    def now(self) -> float:
+        import time
+        return time.monotonic()
+
+    def log(self, text: str) -> None:
+        print(text, file=self._err, flush=True)
+
+    def sync_rpc(self, dest: str, body: dict,
+                 timeout: float = 1.0) -> Message:
+        """Blocking RPC with deadline (reference: Node.SyncRPC — used by
+        the Go KV client).  Only valid on the threaded runtime; raises the
+        reply's RPCError if the reply is an error body."""
+        done = threading.Event()
+        box: list[Message] = []
+
+        def _cb(reply: Message) -> None:
+            box.append(reply)
+            done.set()
+
+        self.rpc(dest, body, _cb, timeout=timeout)
+        done.wait(timeout + 1.0)
+        if not box:
+            raise RPCError(TIMEOUT, "sync rpc timeout")
+        reply = box[0]
+        if reply.type == "error":
+            raise RPCError.from_body(reply.body)
+        return reply
+
+    def on_unhandled(self, msg: Message) -> None:
+        # Parity with the Go client: a message with no registered handler
+        # kills the node (Run returns "No handler for %s"; every reference
+        # main() exits via log.Fatal on a Run error).
+        self.log(f"No handler for {json.dumps(msg.to_json())}")
+        import os
+        os._exit(1)
+
+    def run(self) -> None:
+        """Blocking event loop: read line-JSON from stdin, dispatch each
+        message on its own thread (reference: Node.Run)."""
+        for line in self._in:
+            line = line.strip()
+            if not line:
+                continue
+            self.log(f"Received {line}")
+            try:
+                msg = decode_line(line)
+            except ValueError as exc:
+                # Go's Run returns the unmarshal error -> log.Fatal
+                self.log(f"fatal: malformed message: {exc}")
+                raise SystemExit(1)
+            t = threading.Thread(target=self.deliver, args=(msg,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+            self._threads = [th for th in self._threads if th.is_alive()]
+        for th in self._threads:
+            th.join(timeout=2.0)

@@ -1,0 +1,17 @@
+"""Utilities: typed configuration, tracing/stats ledger."""
+
+from .config import (
+    BroadcastConfig,
+    CounterConfig,
+    KafkaConfig,
+    NetConfig,
+    SimConfig,
+)
+
+__all__ = [
+    "BroadcastConfig",
+    "CounterConfig",
+    "KafkaConfig",
+    "NetConfig",
+    "SimConfig",
+]

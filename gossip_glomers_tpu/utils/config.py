@@ -1,0 +1,85 @@
+"""Typed configuration for every tuning constant in the framework.
+
+The reference has no config system — all tuning is compile-time constants
+scattered through the Go sources (survey §5).  Each of those constants
+defines parity-relevant behavior, so they are lifted here verbatim as
+defaults, with citations, and everything is overridable.
+
+All durations are in **seconds** (floats).  The simulated-time harness
+interprets them on its virtual clock, so they are cheap no matter how
+large.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BroadcastConfig:
+    """Broadcast-node tuning (reference: broadcast/main.go, broadcast.go)."""
+
+    # Anti-entropy timer: sleep 2 s + uniform(0, 1 s) jitter between
+    # SyncBroadcast rounds (broadcast/main.go:45-48).
+    sync_interval: float = 2.0
+    sync_jitter: float = 1.0
+    # Declared but never used in the reference (broadcast/broadcast.go:11);
+    # kept so a config dump is a superset of the reference's constants.
+    cleanup_size: int = 512
+
+
+@dataclass
+class CounterConfig:
+    """Counter-node tuning (reference: counter/add.go, counter/main.go)."""
+
+    kv_key: str = "value"            # add.go:13 (KV_VAL_KEY)
+    flush_interval: float = 0.200    # long wait between flushes, add.go:62
+    retry_min: float = 0.025         # short CAS retry floor, add.go:56-58
+    retry_max: float = 0.075         # 25 + rand(51) ms ceiling, add.go:56-58
+    kv_op_timeout: float = 1.0       # updateKV context timeout, add.go:69
+    poll_interval: float = 0.700     # background KV poll, counter/main.go:53
+    poll_timeout: float = 0.500      # poll context timeout, counter/main.go:54
+
+
+@dataclass
+class KafkaConfig:
+    """Kafka-node tuning (reference: kafka/logmap.go:15-20 and call sites)."""
+
+    default_offset: int = 1          # first offset for a fresh key, logmap.go:16
+    offset_inc: int = 1              # logmap.go:17
+    kv_timeout: float = 1.0          # defaultKVTimeout (seconds), logmap.go:18
+    kv_retries: int = 10             # defaultKVRetries, logmap.go:19
+    cas_timeout: float = 5.0         # 5*defaultKVTimeout on CAS paths,
+                                     # logmap.go:135,256
+
+
+@dataclass
+class NetConfig:
+    """Simulated-network behavior (the harness side; reference: external
+    Maelstrom — latency/partition knobs per README.md:16-18)."""
+
+    latency: float = 0.0             # fixed per-hop delivery latency
+    latency_jitter: float = 0.0     # uniform extra latency
+    rpc_timeout: float = 1.0        # default SyncRPC deadline (client lib)
+    seed: int = 0                   # all randomness is seeded
+
+
+@dataclass
+class SimConfig:
+    """tpu_sim backend shape/scale parameters (no reference equivalent —
+    the vectorized backend is new; survey §7)."""
+
+    n_nodes: int = 25
+    msg_capacity: int = 128          # bitset width: max distinct broadcast msgs
+    degree: int = 3                  # for random-regular topologies
+    max_rounds: int = 64
+    seed: int = 0
+
+
+@dataclass
+class Config:
+    broadcast: BroadcastConfig = field(default_factory=BroadcastConfig)
+    counter: CounterConfig = field(default_factory=CounterConfig)
+    kafka: KafkaConfig = field(default_factory=KafkaConfig)
+    net: NetConfig = field(default_factory=NetConfig)
+    sim: SimConfig = field(default_factory=SimConfig)

@@ -1,0 +1,42 @@
+import json
+
+from gossip_glomers_tpu.protocol import (KEY_DOES_NOT_EXIST,
+                                         PRECONDITION_FAILED, Message,
+                                         RPCError, decode_line, encode_line,
+                                         make_body)
+
+
+def test_round_trip():
+    msg = Message("n1", "n2", {"type": "broadcast", "message": 7,
+                               "msg_id": 3})
+    line = encode_line(msg)
+    assert line.endswith("\n")
+    back = decode_line(line)
+    assert back == msg
+    assert back.type == "broadcast"
+    assert back.msg_id == 3
+    assert back.in_reply_to is None
+
+
+def test_wire_shape_matches_maelstrom():
+    obj = json.loads(encode_line(Message("c1", "n0", {"type": "echo",
+                                                      "echo": "hi",
+                                                      "msg_id": 1})))
+    assert set(obj) == {"src", "dest", "body"}
+    assert obj["body"]["type"] == "echo"
+
+
+def test_make_body_drops_none():
+    assert make_body("read_ok", value=3, extra=None) == {"type": "read_ok",
+                                                         "value": 3}
+
+
+def test_rpc_error_codes():
+    err = RPCError(PRECONDITION_FAILED)
+    assert err.code == 22
+    assert err.retriable
+    body = err.to_body(in_reply_to=9)
+    assert body["type"] == "error" and body["in_reply_to"] == 9
+    back = RPCError.from_body(body)
+    assert back.code == 22
+    assert RPCError(KEY_DOES_NOT_EXIST).code == 20

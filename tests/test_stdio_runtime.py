@@ -1,0 +1,100 @@
+"""Pipe-level tests of the Maelstrom-compatible stdio runtime: spawn a
+node as a real subprocess and speak line-JSON to it, exactly as the
+external Maelstrom harness would (survey §2b, Node.Run contract)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(module: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-m", f"gossip_glomers_tpu.nodes.{module}"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env)
+
+
+def _send(proc, src, dest, body):
+    proc.stdin.write(json.dumps({"src": src, "dest": dest,
+                                 "body": body}) + "\n")
+    proc.stdin.flush()
+
+
+def _recv(proc):
+    line = proc.stdout.readline()
+    assert line, "node closed stdout"
+    return json.loads(line)
+
+
+def test_echo_node_over_pipes():
+    proc = _spawn("echo")
+    try:
+        _send(proc, "c1", "n0", {"type": "init", "msg_id": 1,
+                                 "node_id": "n0", "node_ids": ["n0"]})
+        reply = _recv(proc)
+        assert reply["body"]["type"] == "init_ok"
+        assert reply["body"]["in_reply_to"] == 1
+
+        _send(proc, "c1", "n0", {"type": "echo", "msg_id": 2,
+                                 "echo": "hello tpu"})
+        reply = _recv(proc)
+        assert reply["body"]["type"] == "echo_ok"
+        assert reply["body"]["echo"] == "hello tpu"
+        assert reply["body"]["in_reply_to"] == 2
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=5)
+
+
+def test_broadcast_node_over_pipes():
+    proc = _spawn("broadcast")
+    try:
+        _send(proc, "c1", "n0", {"type": "init", "msg_id": 1,
+                                 "node_id": "n0",
+                                 "node_ids": ["n0", "n1"]})
+        assert _recv(proc)["body"]["type"] == "init_ok"
+
+        _send(proc, "c1", "n0", {"type": "topology", "msg_id": 2,
+                                 "topology": {"n0": ["n1"],
+                                              "n1": ["n0"]}})
+        assert _recv(proc)["body"]["type"] == "topology_ok"
+
+        _send(proc, "c1", "n0", {"type": "broadcast", "msg_id": 3,
+                                 "message": 42})
+        # expect the gossip fan-out to n1 plus the ack, in either order
+        got = [_recv(proc), _recv(proc)]
+        types = {(m["dest"], m["body"]["type"]) for m in got}
+        assert ("n1", "broadcast") in types
+        assert ("c1", "broadcast_ok") in types
+
+        _send(proc, "c1", "n0", {"type": "read", "msg_id": 4})
+        reply = _recv(proc)
+        assert reply["body"]["type"] == "read_ok"
+        assert reply["body"]["messages"] == [42]
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=5)
+
+
+def test_unique_ids_node_over_pipes():
+    proc = _spawn("unique_ids")
+    try:
+        _send(proc, "c1", "n0", {"type": "init", "msg_id": 1,
+                                 "node_id": "n0", "node_ids": ["n0"]})
+        assert _recv(proc)["body"]["type"] == "init_ok"
+        ids = set()
+        for i in range(20):
+            _send(proc, "c1", "n0", {"type": "generate", "msg_id": 2 + i})
+        for _ in range(20):
+            reply = _recv(proc)
+            assert reply["body"]["type"] == "generate_ok"
+            ids.add(reply["body"]["id"])
+        assert len(ids) == 20
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=5)
