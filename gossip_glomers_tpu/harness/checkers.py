@@ -53,13 +53,22 @@ def check_broadcast_convergence(
                          "divergent_nodes": missing}
 
 
-def check_counter(final_reads: dict[str, int],
-                  expected_sum: int) -> tuple[bool, dict]:
-    """After quiescence every node's read must equal the sum of acked
-    adds (g-counter contract)."""
-    wrong = {n: v for n, v in final_reads.items() if v != expected_sum}
-    return not wrong, {"expected": expected_sum, "reads": final_reads,
-                       "wrong": wrong}
+def check_counter(final_reads: dict[str, int], acked_sum: int,
+                  attempted_sum: int | None = None) -> tuple[bool, dict]:
+    """After quiescence every node's read must lie in
+    [sum of acked adds, sum of attempted adds] — the real g-counter
+    contract when KV ops can time out indeterminately: the reference's
+    flush loop re-applies a delta whose CAS timed out after the KV had
+    already absorbed it (add.go:43-95 retries any failed updateKV), so an
+    acked-sum-exact check would reject reference-legal histories.  With no
+    faults the two bounds coincide."""
+    if attempted_sum is None:
+        attempted_sum = acked_sum
+    wrong = {n: v for n, v in final_reads.items()
+             if not acked_sum <= v <= attempted_sum}
+    return not wrong, {"acked_sum": acked_sum,
+                       "attempted_sum": attempted_sum,
+                       "reads": final_reads, "wrong": wrong}
 
 
 def check_kafka(send_acks: list[tuple[str, int, int]],

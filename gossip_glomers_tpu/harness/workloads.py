@@ -178,9 +178,11 @@ def run_counter(n_nodes: int = 3, n_ops: int = 60, rate: float = 10.0,
                     services=("seq-kv",), partitions=partitions)
     client = net.client("c1")
     acked_deltas: list[int] = []
+    attempted = 0
     rng = net.rng
     for i in range(n_ops):
         delta = rng.randrange(1, 10)
+        attempted += delta
 
         def on_ack(rep: Message, delta=delta) -> None:
             if rep.type == "add_ok":
@@ -200,7 +202,8 @@ def run_counter(n_nodes: int = 3, n_ops: int = 60, rate: float = 10.0,
                        f"n{i}", rep.body.get("value")))
     net.run_for(1.0)
 
-    ok, details = checkers.check_counter(final_reads, sum(acked_deltas))
+    ok, details = checkers.check_counter(final_reads, sum(acked_deltas),
+                                         attempted_sum=attempted)
     ok = ok and len(acked_deltas) == n_ops
     details["n_acked"] = len(acked_deltas)
     return WorkloadResult(ok, details, _stats(net, n_ops))

@@ -1,0 +1,26 @@
+"""tpu_sim: the vectorized TPU backend.
+
+Instead of one OS process per node talking JSON through a harness (the
+reference's model — Maelstrom spawns N copies of a Go binary, survey §1
+Layer 0), every simulated node is a **row of a device-sharded state
+array**.  A simulation round is a pure jitted function
+
+    (state, static topology, fault masks) -> state'
+
+and the "network" is a sparse neighbor gather: message delivery between
+nodes on different devices rides XLA collectives (``all_gather`` /
+``psum`` over the mesh's ICI links), not a socket.  Fault injection is a
+time-varying boolean edge mask (survey §5), and one simulation round
+models one network hop (Maelstrom's injected 100 ms per-hop latency ==
+one round).
+
+Modules:
+
+- :mod:`.broadcast` — challenge 3 (fault-tolerant broadcast): bitset
+  flood + periodic anti-entropy; the flagship/benchmark model.
+"""
+
+from .broadcast import (BroadcastSim, BroadcastState, Partitions,
+                        make_inject)
+
+__all__ = ["BroadcastSim", "BroadcastState", "Partitions", "make_inject"]

@@ -1,0 +1,399 @@
+"""Vectorized fault-tolerant broadcast (challenge 3) on TPU.
+
+Semantics mirrored from the reference node (broadcast/broadcast.go):
+
+- **Eager gossip** (HandleBroadcast + rebroadcastAllExcept,
+  broadcast.go:50-79): a node that learns a new value floods it to its
+  neighbors; duplicates are absorbed.  Here: each node keeps a *received*
+  bitset and a *frontier* bitset (values learned last round); one round
+  delivers every node's frontier to its live neighbors and the dedup is a
+  bitwise ``& ~received``.
+- **Periodic push-pull anti-entropy** (SyncBroadcast, broadcast.go:81-122,
+  fired every 2 s + jitter by main.go:42-51): the partition-repair path.
+  Here: every ``sync_every`` rounds a node's payload is its FULL received
+  set instead of just the frontier — the round delivers the pairwise set
+  unions the reference's read/diff/merge dance converges to, and newly
+  learned values re-enter the frontier so they keep flooding (the
+  reference's ``rebroadcastAllExcept`` inside the sync callback,
+  broadcast.go:97-102).
+- **Fault injection**: Maelstrom's partition nemesis becomes a
+  time-varying boolean edge mask (survey §5); latency (100 ms/hop in the
+  reference runs, README.md:16) is the round itself — 1 round == 1 hop.
+
+State layout (struct-of-arrays, node axis shardable over the mesh):
+
+- ``received``: (N, W) uint32 — bit v%32 of word v//32 set iff value v
+  is known.  W = ceil(n_values/32).
+- ``frontier``: (N, W) uint32 — values newly learned last round.
+
+The inter-node "network" is one sparse gather: ``inbox[i] = OR_d
+payload[nbr[i, d]]`` over live edges.  Multi-device, the payload is
+``all_gather``-ed along the ``nodes`` mesh axis (ICI), then gathered
+locally — the gossip fan-out *is* the collective.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORD = 32
+
+
+def num_words(n_values: int) -> int:
+    return max(1, (n_values + WORD - 1) // WORD)
+
+
+def make_inject(n_nodes: int, n_values: int,
+                origins: np.ndarray | None = None) -> np.ndarray:
+    """Initial injection bitset: value v starts at node origins[v]
+    (default v % n_nodes — the round-robin the workload client uses).
+    Returns (N, W) uint32."""
+    w = num_words(n_values)
+    out = np.zeros((n_nodes, w), dtype=np.uint32)
+    if origins is None:
+        origins = np.arange(n_values) % n_nodes
+    for v in range(n_values):
+        out[origins[v], v // WORD] |= np.uint32(1 << (v % WORD))
+    return out
+
+
+class Partitions(NamedTuple):
+    """Seeded partition schedule as data (faults.py's PartitionSchedule,
+    compiled to arrays).  Window w is active for rounds
+    [starts[w], ends[w]); while active, edges crossing groups drop."""
+
+    starts: jnp.ndarray   # (P,) int32, round number (inclusive)
+    ends: jnp.ndarray     # (P,) int32, round number (exclusive)
+    group: jnp.ndarray    # (P, N) int8 — component id per node per window
+
+    @staticmethod
+    def none(n_nodes: int) -> "Partitions":
+        return Partitions(jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((0, n_nodes), jnp.int8))
+
+
+class BroadcastState(NamedTuple):
+    received: jnp.ndarray    # (N, W) uint32
+    frontier: jnp.ndarray    # (N, W) uint32
+    t: jnp.ndarray           # () int32 — round counter
+    msgs: jnp.ndarray        # () uint32 — value-messages sent (wraps @2^32)
+
+
+def _popcount(x: jnp.ndarray) -> jnp.ndarray:
+    return lax.population_count(x)
+
+
+def _edge_live(t: jnp.ndarray, row_ids: jnp.ndarray, nbrs: jnp.ndarray,
+               nbr_mask: jnp.ndarray, parts: Partitions) -> jnp.ndarray:
+    """(rows, D) bool — which edges deliver this round (pad edges never,
+    partitioned edges not while a window covering them is active).
+
+    ``row_ids`` are the *global* node indices of the local rows (arange(N)
+    single-device; the shard's block under shard_map) — partition groups
+    are indexed globally.
+    """
+    live = nbr_mask
+    n_windows = parts.starts.shape[0]
+    if n_windows == 0:
+        return live
+
+    def body(w, live):
+        active = (parts.starts[w] <= t) & (t < parts.ends[w])
+        g = parts.group[w]                       # (N,) global
+        same = g[row_ids][:, None] == g[jnp.clip(nbrs, 0, g.shape[0] - 1)]
+        return live & jnp.where(active, same, True)
+
+    return lax.fori_loop(0, n_windows, body, live)
+
+
+def _gather_or(payload: jnp.ndarray, nbrs: jnp.ndarray,
+               live: jnp.ndarray) -> jnp.ndarray:
+    """inbox[i] = OR over live edges d of payload[nbrs[i, d]].
+
+    ``payload`` may cover more rows than ``nbrs`` (the all_gather-ed full
+    node axis under shard_map); output has nbrs.shape[0] rows.  The loop
+    over the (small, static) degree axis keeps the working set at one
+    (N, W) gather per step instead of an (N, D, W) intermediate.
+    """
+
+    def term(d):
+        idx = lax.dynamic_index_in_dim(nbrs, d, axis=1, keepdims=False)
+        ok = lax.dynamic_index_in_dim(live, d, axis=1, keepdims=True)
+        rows = payload[jnp.clip(idx, 0, payload.shape[0] - 1)]
+        return jnp.where(ok, rows, jnp.uint32(0))
+
+    # Initializing the carry from the d=0 term (instead of zeros) keeps
+    # its sharding/varying type identical to the body output under
+    # shard_map (scan-vma rule).
+    return lax.fori_loop(1, nbrs.shape[1], lambda d, acc: acc | term(d),
+                         term(0))
+
+
+def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
+           nbrs: jnp.ndarray, nbr_mask: jnp.ndarray, parts: Partitions,
+           sync_every: int,
+           widen: Callable[[jnp.ndarray], jnp.ndarray] = lambda p: p,
+           reduce_sum: Callable[[jnp.ndarray], jnp.ndarray] = lambda s: s,
+           ) -> BroadcastState:
+    """One simulation round == one network hop — the single source of the
+    round semantics, shared by the single-device and sharded paths.
+
+    Normal rounds flood the frontier (eager gossip); every
+    ``sync_every``-th round floods the full received set (anti-entropy).
+    ``widen`` maps the local payload block to the full node axis (identity
+    single-device; ``all_gather`` along 'nodes' under shard_map) and
+    ``reduce_sum`` globalizes the message count (identity / ``psum``).
+    """
+    is_sync = (state.t % jnp.int32(sync_every) == 0) & (state.t > 0)
+    # frontier ⊆ received, so the anti-entropy payload is just `received`.
+    payload = jnp.where(is_sync, state.received, state.frontier)
+    payload_full = widen(payload)
+    live = _edge_live(state.t, row_ids, nbrs, nbr_mask, parts)
+    # ledger: the reference sends one message per (value, edge) —
+    # broadcast.go:50-57 fans each value out separately.
+    sent = reduce_sum(jnp.sum(
+        _popcount(payload).sum(axis=1).astype(jnp.uint32)
+        * live.sum(axis=1).astype(jnp.uint32), dtype=jnp.uint32))
+    inbox = _gather_or(payload_full, nbrs, live)
+    new = inbox & ~state.received
+    return BroadcastState(received=state.received | new,
+                          frontier=new,
+                          t=state.t + 1,
+                          msgs=state.msgs + sent)
+
+
+def flood_step(state: BroadcastState, *, nbrs: jnp.ndarray,
+               nbr_mask: jnp.ndarray, parts: Partitions,
+               sync_every: int) -> BroadcastState:
+    """Single-device round (the ``entry()`` compile-check target)."""
+    row_ids = jnp.arange(nbrs.shape[0], dtype=jnp.int32)
+    return _round(state, row_ids=row_ids, nbrs=nbrs, nbr_mask=nbr_mask,
+                  parts=parts, sync_every=sync_every)
+
+
+class BroadcastSim:
+    """Round-synchronous broadcast simulator over an (optional) device
+    mesh.
+
+    Single-device: plain ``jax.jit``.  Multi-device: ``shard_map`` over
+    ``Mesh(axis 'nodes' [, 'words'])`` — state rows block-sharded over
+    'nodes', bitset words over 'words'; each round all_gathers the payload
+    along 'nodes' (ICI) and gathers neighbor rows locally.
+    """
+
+    def __init__(self, nbrs: np.ndarray, *, n_values: int,
+                 sync_every: int = 8,
+                 parts: Partitions | None = None,
+                 mesh: Mesh | None = None) -> None:
+        n = nbrs.shape[0]
+        self.n_nodes = n
+        self.n_values = n_values
+        self.n_words = num_words(n_values)
+        self.sync_every = sync_every
+        self.mesh = mesh
+        self.parts = parts if parts is not None else Partitions.none(n)
+        self._fused = None
+        self._fused_max_rounds = None
+
+        nbr_mask = nbrs >= 0
+        if mesh is not None:
+            node_sh = NamedSharding(mesh, P("nodes", None))
+            self._state_spec = (P("nodes", "words")
+                                if "words" in mesh.axis_names
+                                else P("nodes", None))
+            self.nbrs = jax.device_put(jnp.asarray(nbrs, jnp.int32), node_sh)
+            self.nbr_mask = jax.device_put(jnp.asarray(nbr_mask), node_sh)
+        else:
+            self._state_spec = None
+            self.nbrs = jnp.asarray(nbrs, jnp.int32)
+            self.nbr_mask = jnp.asarray(nbr_mask)
+        self._step = self._build_step()
+
+    # -- construction ------------------------------------------------------
+
+    def init_state(self, inject: np.ndarray) -> BroadcastState:
+        received = jnp.asarray(inject, jnp.uint32)
+        if self.mesh is not None:
+            received = jax.device_put(
+                received, NamedSharding(self.mesh, self._state_spec))
+        return BroadcastState(received=received, frontier=received,
+                              t=jnp.int32(0), msgs=jnp.uint32(0))
+
+    def target_bits(self, inject: np.ndarray) -> jnp.ndarray:
+        """(W,) uint32 — union of all injected values: the convergence
+        target every node must reach."""
+        return jnp.asarray(np.bitwise_or.reduce(
+            np.asarray(inject, np.uint32), axis=0))
+
+    # -- round/step builders ----------------------------------------------
+
+    def _sharded_round(self, state: BroadcastState, nbrs, nbr_mask,
+                       parts: Partitions) -> BroadcastState:
+        """The shared round, specialized to run inside shard_map: global
+        row ids from the shard index, payload all_gather-ed along 'nodes'
+        (the gossip collective riding ICI), ledger psum-ed."""
+        mesh_axes = tuple(self.mesh.axis_names)
+        block = nbrs.shape[0]
+        row_ids = (lax.axis_index("nodes") * block
+                   + jnp.arange(block, dtype=jnp.int32))
+        return _round(
+            state, row_ids=row_ids, nbrs=nbrs, nbr_mask=nbr_mask,
+            parts=parts, sync_every=self.sync_every,
+            widen=lambda p: lax.all_gather(p, "nodes", axis=0, tiled=True),
+            reduce_sum=lambda s: lax.psum(s, mesh_axes))
+
+    def _specs(self):
+        state_spec = self._state_spec
+        return (BroadcastState(state_spec, state_spec, P(), P()),
+                P("nodes", None), Partitions(P(), P(), P(None, None)))
+
+    def _build_step(self):
+        parts, sync_every = self.parts, self.sync_every
+
+        if self.mesh is None:
+            @jax.jit
+            def step(state: BroadcastState, nbrs, nbr_mask) -> BroadcastState:
+                return flood_step(state, nbrs=nbrs, nbr_mask=nbr_mask,
+                                  parts=parts, sync_every=sync_every)
+            return step
+
+        state_spec, node_spec, part_spec = self._specs()
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(state_spec, node_spec, node_spec, part_spec),
+            out_specs=state_spec,
+        )
+        def step(state: BroadcastState, nbrs, nbr_mask,
+                 parts: Partitions) -> BroadcastState:
+            return self._sharded_round(state, nbrs, nbr_mask, parts)
+
+        return lambda state, nbrs, nbr_mask: step(state, nbrs, nbr_mask,
+                                                  self.parts)
+
+    def step(self, state: BroadcastState) -> BroadcastState:
+        return self._step(state, self.nbrs, self.nbr_mask)
+
+    def _build_fused(self, max_rounds: int):
+        """Whole-convergence runner as ONE device program: a
+        ``lax.while_loop`` of rounds with the convergence check on
+        device.  Avoids a host↔device round-trip per step — the per-call
+        dispatch latency is what dominates small rounds, especially over
+        a remote-TPU tunnel."""
+        parts, sync_every = self.parts, self.sync_every
+        limit = jnp.int32(max_rounds)
+
+        if self.mesh is None:
+            @jax.jit
+            def run(state: BroadcastState, nbrs, nbr_mask, target):
+                def cond(s):
+                    return ((s.t < limit)
+                            & ~jnp.all(s.received == target[None, :]))
+
+                def body(s):
+                    return flood_step(s, nbrs=nbrs, nbr_mask=nbr_mask,
+                                      parts=parts, sync_every=sync_every)
+
+                return lax.while_loop(cond, body, state)
+            return run
+
+        mesh = self.mesh
+        state_spec, node_spec, part_spec = self._specs()
+        target_spec = (P("words") if "words" in mesh.axis_names else P())
+        axes = tuple(mesh.axis_names)
+        n_shards = int(np.prod(mesh.devices.shape))
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(state_spec, node_spec, node_spec, target_spec,
+                      part_spec),
+            out_specs=state_spec,
+        )
+        def run(state: BroadcastState, nbrs, nbr_mask, target,
+                parts: Partitions) -> BroadcastState:
+            def all_converged(s: BroadcastState) -> jnp.ndarray:
+                ok_local = jnp.all(s.received == target[None, :])
+                return (lax.psum(ok_local.astype(jnp.int32), axes)
+                        == n_shards)
+
+            def cond(carry):
+                s, done = carry
+                return (~done) & (s.t < limit)
+
+            def body(carry):
+                s, _ = carry
+                s2 = self._sharded_round(s, nbrs, nbr_mask, parts)
+                return (s2, all_converged(s2))
+
+            final, _ = lax.while_loop(cond, body,
+                                      (state, all_converged(state)))
+            return final
+
+        return lambda state, nbrs, nbr_mask, target: run(
+            state, nbrs, nbr_mask, target, self.parts)
+
+    # -- drivers -----------------------------------------------------------
+
+    def converged(self, state: BroadcastState,
+                  target: jnp.ndarray) -> bool:
+        return bool(jnp.all(state.received == target[None, :]))
+
+    def run(self, inject: np.ndarray, *, max_rounds: int = 1 << 16,
+            check_every: int = 1) -> tuple[BroadcastState, int]:
+        """Step until every node holds every injected value (or
+        ``max_rounds``).  Returns (final state, rounds run).
+
+        One host↔device sync per ``check_every`` rounds; use
+        :meth:`run_fused` for a single-dispatch whole-run program.
+        """
+        target = self.target_bits(inject)
+        state = self.init_state(inject)
+        rounds = 0
+        while rounds < max_rounds:
+            for _ in range(check_every):
+                state = self.step(state)
+                rounds += 1
+            if self.converged(state, target):
+                break
+        return state, rounds
+
+    def run_fused(self, inject: np.ndarray, *, max_rounds: int = 1 << 16,
+                  ) -> tuple[BroadcastState, int]:
+        """Like :meth:`run` but the whole convergence loop executes as a
+        single device program.  Returns (final state, rounds run)."""
+        if self._fused is None or self._fused_max_rounds != max_rounds:
+            self._fused = self._build_fused(max_rounds)
+            self._fused_max_rounds = max_rounds
+        target = self.target_bits(inject)
+        if self.mesh is not None and "words" in self.mesh.axis_names:
+            target = jax.device_put(
+                target, NamedSharding(self.mesh, P("words")))
+        state = self.init_state(inject)
+        final = self._fused(state, self.nbrs, self.nbr_mask, target)
+        return final, int(final.t)
+
+    def read(self, state: BroadcastState) -> list[list[int]]:
+        """Per-node sorted value lists (the ``read`` handler's reply,
+        broadcast.go:124-132) — host-side, for checkers."""
+        rec = np.asarray(state.received)
+        out: list[list[int]] = []
+        for i in range(rec.shape[0]):
+            vals = []
+            for w in range(rec.shape[1]):
+                word = int(rec[i, w])
+                while word:
+                    b = word & -word
+                    vals.append(w * WORD + b.bit_length() - 1)
+                    word ^= b
+            out.append(vals)
+        return out

@@ -1,16 +1,25 @@
-"""Test env: force an 8-device virtual CPU mesh before jax is imported.
+"""Test env: force an 8-device virtual CPU mesh before any backend init.
 
 Multi-chip TPU hardware is not available in CI; sharding tests run over
 XLA's virtual host devices (same SPMD partitioner, same collectives).
+
+Note: a sitecustomize in this image registers the TPU PJRT plugin at
+interpreter start and forces the platform, so plain env vars are not
+enough — ``jax.config.update`` after import wins, as long as XLA_FLAGS
+carries the virtual-device count before the CPU backend initializes.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

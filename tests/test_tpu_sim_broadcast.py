@@ -1,0 +1,166 @@
+"""tpu_sim broadcast backend: convergence, sharding, faults, ledger.
+
+Runs on the 8-device virtual CPU mesh from conftest.py — same SPMD
+partitioner and collectives as real multi-chip TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from gossip_glomers_tpu.parallel.topology import (grid, line,
+                                                  random_regular, tree,
+                                                  to_padded_neighbors)
+from gossip_glomers_tpu.tpu_sim.broadcast import (BroadcastSim, Partitions,
+                                                  make_inject, num_words)
+
+
+def mesh_1d():
+    return Mesh(np.array(jax.devices()).reshape(8), ("nodes",))
+
+
+def mesh_2d():
+    return Mesh(np.array(jax.devices()).reshape(4, 2), ("nodes", "words"))
+
+
+def converged_reads(sim, state, n_values):
+    want = list(range(n_values))
+    return all(sorted(r) == want for r in sim.read(state))
+
+
+def test_single_device_tree_converges():
+    nbrs = to_padded_neighbors(tree(25))
+    sim = BroadcastSim(nbrs, n_values=40)
+    state, rounds = sim.run(make_inject(25, 40))
+    assert converged_reads(sim, state, 40)
+    assert rounds <= 8  # tree25 (4-ary) diameter is 4; +slack for schedule
+
+
+def test_flood_rounds_equal_eccentricity():
+    # Single value injected at node 0 of a line graph: pure flood takes
+    # exactly n-1 rounds (the graph eccentricity of the origin) — the
+    # reference's "<500 ms at 100 ms/hop" claim is this quantity in
+    # rounds (README.md:16: hops * per-hop latency).
+    n = 12
+    nbrs = to_padded_neighbors(line(n))
+    sim = BroadcastSim(nbrs, n_values=1, sync_every=1 << 20)
+    inject = make_inject(n, 1, origins=np.array([0]))
+    state, rounds = sim.run(inject)
+    assert rounds == n - 1
+    assert converged_reads(sim, state, 1)
+
+
+def test_message_ledger_line_flood():
+    # Line of 3 nodes, 1 value at the end: round 1 n0->n1 (1 msg... the
+    # ledger counts one message per (value, live edge) per round:
+    # r1: n0 floods to its 1 neighbor = 1; r2: n1 floods to both = 2;
+    # r3: n2 floods back to n1 = 1 (absorbed). Total 4.
+    nbrs = to_padded_neighbors(line(3))
+    sim = BroadcastSim(nbrs, n_values=1, sync_every=1 << 20)
+    state, rounds = sim.run(make_inject(3, 1, origins=np.array([0])))
+    assert rounds == 2
+    state = sim.step(state)  # flush the last frontier
+    assert int(state.msgs) == 4
+
+
+@pytest.mark.parametrize("topo", ["tree", "grid", "rr"])
+def test_sharded_topologies_converge(topo):
+    n, n_values = 64, 48
+    if topo == "tree":
+        nbrs = to_padded_neighbors(tree(n))
+    elif topo == "grid":
+        nbrs = to_padded_neighbors(grid(n))
+    else:
+        nbrs = random_regular(n, 4, seed=3)
+    sim = BroadcastSim(nbrs, n_values=n_values, mesh=mesh_1d())
+    state, _ = sim.run(make_inject(n, n_values))
+    assert converged_reads(sim, state, n_values)
+
+
+def test_sharded_matches_single_device_exactly():
+    n, n_values = 64, 64
+    nbrs = to_padded_neighbors(grid(n))
+    inject = make_inject(n, n_values)
+    ref_sim = BroadcastSim(nbrs, n_values=n_values)
+    ref, ref_rounds = ref_sim.run(inject)
+    for mesh in (mesh_1d(), mesh_2d()):
+        sim = BroadcastSim(nbrs, n_values=n_values, mesh=mesh)
+        state, rounds = sim.run(inject)
+        assert rounds == ref_rounds
+        assert (np.asarray(state.received)
+                == np.asarray(ref.received)).all()
+        assert int(state.msgs) == int(ref.msgs)
+
+
+def test_fused_matches_stepwise():
+    n, n_values = 64, 64
+    nbrs = to_padded_neighbors(tree(n))
+    inject = make_inject(n, n_values)
+    for mesh in (None, mesh_1d(), mesh_2d()):
+        sim = BroadcastSim(nbrs, n_values=n_values, mesh=mesh)
+        s1, r1 = sim.run(inject)
+        s2, r2 = sim.run_fused(inject)
+        assert r1 == r2
+        assert (np.asarray(s1.received) == np.asarray(s2.received)).all()
+        assert int(s1.msgs) == int(s2.msgs)
+
+
+def test_partition_blocks_then_anti_entropy_heals():
+    # Cut the graph in half for 10 rounds. Values cannot cross during the
+    # window (flood frontiers die out), so only anti-entropy (full-set
+    # payload every sync_every rounds) repairs the halves after it lifts
+    # — the reference's SyncBroadcast role (broadcast.go:81-122).
+    n = 64
+    nbrs = to_padded_neighbors(grid(n))
+    group = np.zeros((1, n), np.int8)
+    group[0, : n // 2] = 1
+    parts = Partitions(jnp.array([0], jnp.int32), jnp.array([10], jnp.int32),
+                       jnp.asarray(group))
+    sim = BroadcastSim(nbrs, n_values=8, sync_every=4, parts=parts)
+    inject = make_inject(n, 8, origins=np.zeros(8, dtype=np.int64))
+
+    # mid-partition: nothing in the far half
+    state = sim.init_state(inject)
+    for _ in range(9):
+        state = sim.step(state)
+    reads = sim.read(state)
+    assert all(not r for r in reads[n // 2:])
+
+    state, rounds = sim.run(inject)
+    assert rounds > 10
+    assert converged_reads(sim, state, 8)
+
+
+def test_partition_heals_sharded():
+    n = 64
+    nbrs = to_padded_neighbors(grid(n))
+    group = np.zeros((1, n), np.int8)
+    group[0, : n // 2] = 1
+    parts = Partitions(jnp.array([0], jnp.int32), jnp.array([10], jnp.int32),
+                       jnp.asarray(group))
+    inject = make_inject(n, 8, origins=np.zeros(8, dtype=np.int64))
+    ref, ref_rounds = BroadcastSim(
+        nbrs, n_values=8, sync_every=4, parts=parts).run(inject)
+    sim = BroadcastSim(nbrs, n_values=8, sync_every=4, parts=parts,
+                       mesh=mesh_1d())
+    state, rounds = sim.run(inject)
+    assert rounds == ref_rounds
+    assert (np.asarray(state.received) == np.asarray(ref.received)).all()
+
+
+def test_num_words():
+    assert num_words(1) == 1
+    assert num_words(32) == 1
+    assert num_words(33) == 2
+    assert num_words(0) == 1
+
+
+def test_graft_entry_points():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert int(out.t) == 1
+    g.dryrun_multichip(8)
