@@ -18,9 +18,24 @@ Modules:
 
 - :mod:`.broadcast` — challenge 3 (fault-tolerant broadcast): bitset
   flood + periodic anti-entropy; the flagship/benchmark model.
+- :mod:`.counter` — challenge 4 (g-counter): CAS-contention and
+  all-reduce flush modes, KV-reachability faults.
+- :mod:`.kafka` — challenge 5 (replicated log): rank-within-round
+  offset allocation, loss-masked einsum replication.
+- :mod:`.unique_ids` — challenge 2: coordination-free (t, node, seq)
+  id mint.
+- :mod:`.echo` — challenge 1: batched identity, the smoke test.
 """
 
 from .broadcast import (BroadcastSim, BroadcastState, Partitions,
                         make_inject)
+from .counter import CounterSim, CounterState, KVReach
+from .echo import EchoSim, EchoState
+from .kafka import KafkaSim, KafkaState
+from .unique_ids import UniqueIdsSim, UniqueIdsState
 
-__all__ = ["BroadcastSim", "BroadcastState", "Partitions", "make_inject"]
+__all__ = ["BroadcastSim", "BroadcastState", "Partitions", "make_inject",
+           "CounterSim", "CounterState", "KVReach",
+           "KafkaSim", "KafkaState",
+           "UniqueIdsSim", "UniqueIdsState",
+           "EchoSim", "EchoState"]

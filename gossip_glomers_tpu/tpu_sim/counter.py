@@ -1,0 +1,262 @@
+"""Vectorized grow-only counter (challenge 4) on TPU.
+
+Semantics mirrored from the reference node (counter/add.go, main.go):
+
+- ``add`` acks before durability: deltas buffer locally in ``pending``
+  (the channel + kvUpdater accumulator, add.go:33-47).
+- Flushing is read-then-CAS against ONE sequentially-consistent KV key
+  (updateKV, add.go:67-95); contention means losers retry with a
+  refreshed read.
+- ``read`` serves each node's cached view of the KV, refreshed by a
+  periodic poll (add.go:29-31, main.go:50-62) — deliberately stale-able.
+
+Two flush modes:
+
+- **cas** (parity-flavored): one CAS winner per round — the node with
+  the smallest index whose cached value matches the KV (a fresh read)
+  wins; everyone else observes the new value next round (the reference's
+  failed-CAS → re-read → retry loop, one linearization step per round).
+  Drains one contender per round, reproducing the contention behavior of
+  N nodes CAS-ing one key.
+- **allreduce** (scaled regime): every reachable node's pending sum is
+  applied in one ``psum`` — the g-counter as a collective, for the
+  1k-node+ partitioned benchmark (BASELINE.json config 3).
+
+The KV service is reachability-gated: node i can flush/poll only while
+it can reach the KV (partition windows mask it out, survey §5 fault
+model).  State is a struct-of-arrays over the node axis, shardable with
+shard_map exactly like the broadcast sim.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class KVReach(NamedTuple):
+    """Which nodes can reach the KV service per round: window w is
+    active for rounds [starts[w], ends[w]); while active, nodes with
+    ``blocked[w, i]`` cannot flush or poll."""
+
+    starts: jnp.ndarray    # (P,) int32
+    ends: jnp.ndarray      # (P,) int32
+    blocked: jnp.ndarray   # (P, N) bool
+
+    @staticmethod
+    def none(n_nodes: int) -> "KVReach":
+        return KVReach(jnp.zeros((0,), jnp.int32),
+                       jnp.zeros((0,), jnp.int32),
+                       jnp.zeros((0, n_nodes), bool))
+
+
+class CounterState(NamedTuple):
+    pending: jnp.ndarray   # (N,) int32 — acked, unflushed deltas
+    cached: jnp.ndarray    # (N,) int32 — each node's last-read KV value
+    kv: jnp.ndarray        # () int32 — the seq-kv key's value
+    t: jnp.ndarray         # () int32
+    msgs: jnp.ndarray      # () uint32 — KV request/response messages
+
+
+def _reach(t: jnp.ndarray, row_ids: jnp.ndarray,
+           sched: KVReach) -> jnp.ndarray:
+    """(rows,) bool — who can reach the KV this round."""
+    n_windows = sched.starts.shape[0]
+    ok = jnp.ones(row_ids.shape, bool)
+    if n_windows == 0:
+        return ok
+
+    def body(w, ok):
+        active = (sched.starts[w] <= t) & (t < sched.ends[w])
+        return ok & ~(active & sched.blocked[w][row_ids])
+
+    return lax.fori_loop(0, n_windows, body, ok)
+
+
+class CounterSim:
+    """Round-synchronous g-counter simulator.
+
+    Drive with :meth:`add` (host-side op injection, the ``add`` handler)
+    and :meth:`step`; read with :meth:`reads` (each node's cached value,
+    NOT the KV — reference read semantics, add.go:29-31).
+    """
+
+    def __init__(self, n_nodes: int, *, mode: str = "cas",
+                 poll_every: int = 4,
+                 kv_sched: KVReach | None = None,
+                 mesh: Mesh | None = None) -> None:
+        if mode not in ("cas", "allreduce"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.n_nodes = n_nodes
+        self.mode = mode
+        self.poll_every = poll_every
+        self.mesh = mesh
+        self.kv_sched = (kv_sched if kv_sched is not None
+                         else KVReach.none(n_nodes))
+        self._node_spec = P("nodes") if mesh is not None else None
+        self._step = self._build_step()
+        self._run_n = self._build_run_n()
+
+    def init_state(self) -> CounterState:
+        z = jnp.zeros((self.n_nodes,), jnp.int32)
+        if self.mesh is not None:
+            z = jax.device_put(
+                z, NamedSharding(self.mesh, self._node_spec))
+        return CounterState(pending=z, cached=z, kv=jnp.int32(0),
+                            t=jnp.int32(0), msgs=jnp.uint32(0))
+
+    # -- op injection ------------------------------------------------------
+
+    def add(self, state: CounterState,
+            deltas: np.ndarray) -> CounterState:
+        """Buffer acked deltas: ``deltas`` is (N,) per-node int32 (the
+        batched form of the ``add`` handler — ack precedes durability,
+        add.go:33-41)."""
+        d = jnp.asarray(deltas, jnp.int32)
+        if self.mesh is not None:
+            d = jax.device_put(d, NamedSharding(self.mesh, self._node_spec))
+        return state._replace(pending=state.pending + d)
+
+    # -- round -------------------------------------------------------------
+
+    def _round(self, state: CounterState, row_ids: jnp.ndarray,
+               sched: KVReach, *, psum=None) -> CounterState:
+        """One round: flush attempts + the periodic cache poll.
+
+        ``psum`` is the cross-shard reduction (identity single-device).
+        """
+        def allsum(x):
+            s = jnp.sum(x)
+            return psum(s) if psum is not None else s
+
+        reach = _reach(state.t, row_ids, self.kv_sched)
+        want = (state.pending > 0) & reach
+
+        if self.mode == "allreduce":
+            flushed = jnp.where(want, state.pending, 0)
+            total = allsum(flushed)
+            kv = state.kv + total
+            pending = state.pending - flushed
+            # each flush is a read + CAS round-trip: 4 messages
+            attempts = allsum(want.astype(jnp.uint32)) * jnp.uint32(4)
+            winner_mask = want
+        else:
+            # cas mode: fresh-read holders CAS first; lowest index wins
+            # (the KV linearizes one CAS per round; everyone else fails,
+            # re-reads, retries — add.go:78-88's retry loop).
+            fresh = want & (state.cached == state.kv)
+            candidates = jnp.where(fresh, row_ids,
+                                   jnp.int32(self.n_nodes))
+            local_min = jnp.min(candidates)
+            winner = (local_min if psum is None
+                      else lax.pmin(local_min, "nodes"))
+            winner_delta = allsum(
+                jnp.where(row_ids == winner, state.pending, 0))
+            has_winner = winner < self.n_nodes
+            kv = state.kv + jnp.where(has_winner, winner_delta, 0)
+            winner_mask = (row_ids == winner)
+            pending = jnp.where(winner_mask, 0, state.pending)
+            # every contender pays a read + CAS exchange (4 msgs);
+            # losers' CAS fails and they re-read next round.
+            attempts = allsum(want.astype(jnp.uint32)) * jnp.uint32(4)
+
+        # cache refresh: every CAS attempt starts with a fresh read
+        # (updateKV -> readKV, add.go:67-71), so all contenders see the
+        # new value for their next attempt; idle nodes poll every
+        # poll_every rounds (reference 700 ms poll, main.go:50-62).
+        polled = reach & ((state.t % jnp.int32(self.poll_every)) == 0)
+        cached = jnp.where(want | winner_mask | polled, kv, state.cached)
+        attempts = attempts + allsum(
+            (polled & ~winner_mask).astype(jnp.uint32)) * jnp.uint32(2)
+        return CounterState(pending=pending, cached=cached, kv=kv,
+                            t=state.t + 1, msgs=state.msgs + attempts)
+
+    def _build_step(self):
+        sched = self.kv_sched
+
+        if self.mesh is None:
+            row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+
+            @jax.jit
+            def step(state: CounterState) -> CounterState:
+                return self._round(state, row_ids, sched)
+            return step
+
+        mesh = self.mesh
+        node_spec = self._node_spec
+        state_spec = CounterState(node_spec, node_spec, P(), P(), P())
+        sched_spec = KVReach(P(), P(), P(None, None))
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(state_spec, sched_spec), out_specs=state_spec)
+        def step(state: CounterState, sched: KVReach) -> CounterState:
+            block = state.pending.shape[0]
+            row_ids = (lax.axis_index("nodes") * block
+                       + jnp.arange(block, dtype=jnp.int32))
+            return self._round(state, row_ids, sched,
+                               psum=lambda s: lax.psum(s, "nodes"))
+
+        return lambda state: step(state, self.kv_sched)
+
+    def _build_run_n(self):
+        """Multi-round runner as ONE device program (dynamic fori_loop
+        bound) — one dispatch per run() call instead of per round.  Also
+        sidesteps a CPU-backend hazard: piling up many un-synced
+        multi-device dispatches can interleave their collectives across
+        programs and deadlock the in-process rendezvous."""
+        sched = self.kv_sched
+
+        if self.mesh is None:
+            row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+
+            @jax.jit
+            def run_n(state: CounterState, n) -> CounterState:
+                return lax.fori_loop(
+                    0, n, lambda i, s: self._round(s, row_ids, sched),
+                    state)
+            return run_n
+
+        node_spec = self._node_spec
+        state_spec = CounterState(node_spec, node_spec, P(), P(), P())
+        sched_spec = KVReach(P(), P(), P(None, None))
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh,
+            in_specs=(state_spec, sched_spec, P()), out_specs=state_spec)
+        def run_n(state: CounterState, sched: KVReach, n) -> CounterState:
+            block = state.pending.shape[0]
+            row_ids = (lax.axis_index("nodes") * block
+                       + jnp.arange(block, dtype=jnp.int32))
+            return lax.fori_loop(
+                0, n,
+                lambda i, s: self._round(
+                    s, row_ids, sched,
+                    psum=lambda x: lax.psum(x, "nodes")),
+                state)
+
+        return lambda state, n: run_n(state, self.kv_sched, n)
+
+    def step(self, state: CounterState) -> CounterState:
+        return self._step(state)
+
+    def run(self, state: CounterState, n_rounds: int) -> CounterState:
+        return self._run_n(state, jnp.int32(n_rounds))
+
+    # -- reads -------------------------------------------------------------
+
+    def reads(self, state: CounterState) -> np.ndarray:
+        """(N,) int32 — each node's ``read`` reply (cached value only,
+        add.go:29-31)."""
+        return np.asarray(state.cached)
+
+    def kv_value(self, state: CounterState) -> int:
+        return int(state.kv)

@@ -1,0 +1,60 @@
+"""Vectorized echo (challenge 1) on TPU — the smoke test.
+
+The reference echo node replies to each request with the same body,
+``type`` rewritten to ``echo_ok`` (echo/main.go:12-20).  Batched, that
+is the identity kernel over a (N, B) payload block with a request/reply
+message ledger — it exists to validate the op-injection → step → read
+pipeline end-to-end with the simplest possible handler, exactly the
+role echo plays for the reference stack.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class EchoState(NamedTuple):
+    t: jnp.ndarray      # () int32
+    msgs: jnp.ndarray   # () uint32 — request + reply count
+
+
+class EchoSim:
+    def __init__(self, n_nodes: int, *, mesh: Mesh | None = None) -> None:
+        self.n_nodes = n_nodes
+        self.mesh = mesh
+
+        def echo(state: EchoState, payload, valid):
+            replies = jnp.where(valid, payload, jnp.int32(-1))
+            n_ops = jnp.sum(valid.astype(jnp.uint32))
+            if mesh is not None:
+                n_ops = jax.lax.psum(n_ops, "nodes")
+            new = EchoState(t=state.t + 1,
+                            msgs=state.msgs + n_ops * jnp.uint32(2))
+            return new, replies
+
+        if mesh is None:
+            self._step = jax.jit(echo)
+        else:
+            import functools
+            spec = P("nodes", None)
+            self._step = jax.jit(functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(EchoState(P(), P()), spec, spec),
+                out_specs=(EchoState(P(), P()), spec))(echo))
+
+    def init_state(self) -> EchoState:
+        return EchoState(t=jnp.int32(0), msgs=jnp.uint32(0))
+
+    def step(self, state: EchoState, payload: np.ndarray,
+             valid: np.ndarray) -> tuple[EchoState, jnp.ndarray]:
+        p = jnp.asarray(payload, jnp.int32)
+        v = jnp.asarray(valid)
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P("nodes", None))
+            p, v = jax.device_put(p, sh), jax.device_put(v, sh)
+        return self._step(state, p, v)

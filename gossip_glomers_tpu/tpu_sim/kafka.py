@@ -1,0 +1,284 @@
+"""Vectorized replicated append-only log (challenge 5, "Kafka") on TPU.
+
+Semantics mirrored from the reference node (kafka/log.go, logmap.go):
+
+- ``send``: allocate the next offset for the key from a linearizable KV
+  via a CAS loop (getNextOffsetKV, logmap.go:255-285), append locally,
+  fire-and-forget replicate to every peer (sendReplicateMsg,
+  log.go:159-175 — "acks=0", loss is acceptable), reply the offset.
+- ``poll``: serve from the LOCAL log only (log.go:79-110).
+- ``commit_offsets``: monotonic max into the KV (logmap.go:134-198).
+- ``list_committed_offsets``: local cache only, deliberately not synced
+  (log.go:131-156).
+
+Vectorized model: offsets are slots of padded per-key arrays.  The CAS
+contention loop becomes a **rank-within-round allocation**: all sends in
+one round are linearized in (node, slot) order, each getting
+``next_slot[key] + rank`` — the sort/scan equivalent of the reference's
+one-winner-per-CAS-retry loop, and the "offset gen as a collective"
+called for by BASELINE.json config 5.  Replication is one masked
+einsum per round: delivery[dest] = OR over origins of (link alive AND
+origin's new appends) — the full-mesh fire-and-forget as a batched
+matmul, with link loss as a (N, N) boolean mask.
+
+State (node axis shardable over the mesh):
+
+- ``log_vals (K, C) int32``  — content by (key, slot); offset = slot+1
+  (defaultOffset=1, logmap.go:16).  Replicated: offsets are unique, so
+  all replicas agree on content — only *presence* differs per node.
+- ``present (N, K, C) bool`` — does node n hold (key, slot)?
+- ``next_slot (K,) int32``   — the lin-kv allocation high-water mark.
+- ``committed (K,) int32``   — lin-kv committed offsets.
+- ``local_committed (N, K) int32`` — per-node committed cache.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class KafkaState(NamedTuple):
+    log_vals: jnp.ndarray         # (K, C) int32
+    present: jnp.ndarray          # (N, K, C) bool
+    next_slot: jnp.ndarray        # (K,) int32
+    committed: jnp.ndarray        # (K,) int32
+    local_committed: jnp.ndarray  # (N, K) int32
+    t: jnp.ndarray                # () int32
+    msgs: jnp.ndarray             # () uint32
+
+
+def _rank_within_key(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """(M,) int32 — for each element, how many valid earlier elements
+    share its key.  Sort-based (O(M log M)): stable-argsort the keys,
+    then rank = position - start_of_run within the sorted order.  This
+    is the linearization that replaces the reference's CAS-retry loop."""
+    m = keys.shape[0]
+    sort_keys = jnp.where(valid, keys, jnp.int32(2 ** 30))
+    order = jnp.argsort(sort_keys, stable=True)
+    sorted_keys = sort_keys[order]
+    pos = jnp.arange(m, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_keys[1:] != sorted_keys[:-1]])
+    run_start = lax.associative_scan(
+        jnp.maximum, jnp.where(is_start, pos, 0))
+    rank_sorted = pos - run_start
+    return jnp.zeros((m,), jnp.int32).at[order].set(rank_sorted)
+
+
+class KafkaSim:
+    """Round-synchronous replicated-log simulator.
+
+    Per round, each node submits up to S ``send`` ops and at most one
+    ``commit_offsets`` op (batched as arrays); replication loss is an
+    (N, N) link mask.  ``poll`` / ``list_committed`` are host-side reads
+    with the reference's local-only semantics.
+    """
+
+    def __init__(self, n_nodes: int, n_keys: int, capacity: int, *,
+                 max_sends: int = 4, mesh: Mesh | None = None) -> None:
+        self.n_nodes = n_nodes
+        self.n_keys = n_keys
+        self.capacity = capacity
+        self.max_sends = max_sends
+        self.mesh = mesh
+        self._step = self._build_step()
+
+    def init_state(self) -> KafkaState:
+        n, k, c = self.n_nodes, self.n_keys, self.capacity
+        state = KafkaState(
+            log_vals=jnp.full((k, c), -1, jnp.int32),
+            present=jnp.zeros((n, k, c), bool),
+            next_slot=jnp.zeros((k,), jnp.int32),
+            committed=jnp.zeros((k,), jnp.int32),
+            local_committed=jnp.zeros((n, k), jnp.int32),
+            t=jnp.int32(0), msgs=jnp.uint32(0))
+        if self.mesh is not None:
+            state = state._replace(
+                present=jax.device_put(
+                    state.present,
+                    NamedSharding(self.mesh, P("nodes", None, None))),
+                local_committed=jax.device_put(
+                    state.local_committed,
+                    NamedSharding(self.mesh, P("nodes", None))))
+        return state
+
+    # -- round -------------------------------------------------------------
+
+    def _round(self, state: KafkaState, send_key, send_val, commit_req,
+               repl_ok, *, row_ids, widen, reduce_sum,
+               reduce_max) -> KafkaState:
+        """One round: allocate + append + replicate + commit.
+
+        send_key/send_val: (rows, S) int32, key = -1 for no-op.
+        commit_req: (rows, K) int32, -1 for no commit of that key.
+        repl_ok: (N, N) bool — repl_ok[o, d]: o's replicate_msg reaches d.
+        widen/reduce_sum: identity single-device; all_gather along
+        'nodes' / psum under shard_map.
+        """
+        n, k_dim, cap = self.n_nodes, self.n_keys, self.capacity
+        s_dim = send_key.shape[1]
+
+        # -- offset allocation (global, linearized in (node, slot) order:
+        #    the reference's lin-kv CAS loop, logmap.go:255-285) --------
+        all_key = widen(send_key).reshape(-1)            # (N*S,)
+        all_val = widen(send_val).reshape(-1)
+        valid = all_key >= 0
+        keys_c = jnp.clip(all_key, 0, k_dim - 1)
+        rank = _rank_within_key(keys_c, valid)
+        slot = state.next_slot[keys_c] + rank            # (N*S,)
+        ok = valid & (slot < cap)
+
+        # -- append: content is global (offsets unique ⇒ no conflicts).
+        # Invalid entries scatter to an out-of-bounds row and are dropped
+        # (in-bounds dummy slots would race real writes).
+        scat_k = jnp.where(ok, keys_c, jnp.int32(k_dim))
+        scat_c = jnp.where(ok, slot, 0)
+        log_vals = state.log_vals.at[scat_k, scat_c].set(
+            all_val, mode="drop")
+        counts = jnp.zeros((k_dim,), jnp.int32).at[keys_c].add(
+            ok.astype(jnp.int32))
+        next_slot = state.next_slot + counts
+
+        # new appends per origin node: (N, K, C) one-hot
+        origin = jnp.repeat(jnp.arange(n, dtype=jnp.int32), s_dim)
+        new_mask = jnp.zeros((n, k_dim, cap), bool).at[
+            origin, scat_k, scat_c].max(ok, mode="drop")
+
+        # -- replication: masked OR over origins as one matmul
+        #    (fire-and-forget full mesh, log.go:159-175) ----------------
+        deliver = jnp.einsum(
+            "od,okc->dkc", repl_ok.astype(jnp.int8),
+            new_mask.astype(jnp.int8)) > 0                # (N, K, C)
+        present = state.present | deliver[row_ids] | new_mask[row_ids]
+
+        # -- commits: monotonic max (logmap.go:134-198); the local cache
+        #    tracks only this node's own commits (log.go:131-156) -------
+        committed = jnp.maximum(
+            state.committed, reduce_max(jnp.max(commit_req, axis=0)))
+        local_committed = jnp.maximum(state.local_committed, commit_req)
+
+        # -- ledger: 4 msgs per send's KV exchange (read + CAS pair),
+        #    N-1 replicate_msg per send, 4 per commit key exchange ------
+        n_sends = reduce_sum(jnp.sum(
+            (send_key >= 0).astype(jnp.uint32)))
+        n_commits = reduce_sum(jnp.sum(
+            (commit_req >= 0).astype(jnp.uint32)))
+        msgs = (state.msgs + n_sends * jnp.uint32(4 + (n - 1))
+                + n_commits * jnp.uint32(4))
+        return KafkaState(log_vals, present, next_slot, committed,
+                          local_committed, state.t + 1, msgs)
+
+    def _build_step(self):
+        if self.mesh is None:
+            row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+
+            @jax.jit
+            def step(state, send_key, send_val, commit_req, repl_ok):
+                return self._round(state, send_key, send_val, commit_req,
+                                   repl_ok, row_ids=row_ids,
+                                   widen=lambda x: x,
+                                   reduce_sum=lambda x: x,
+                                   reduce_max=lambda x: x)
+            return step
+
+        mesh = self.mesh
+        node2 = P("nodes", None)
+        state_spec = KafkaState(P(None, None), P("nodes", None, None),
+                                P(), P(), node2, P(), P())
+
+        # check_vma=False: log_vals/next_slot are computed identically on
+        # every shard from all_gather-ed send batches — genuinely
+        # replicated, but derived from gathered (varying-marked) values,
+        # which the static replication checker cannot prove.
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(state_spec, node2, node2, node2, P(None, None)),
+            out_specs=state_spec, check_vma=False)
+        def step(state, send_key, send_val, commit_req, repl_ok):
+            block = send_key.shape[0]
+            row_ids = (lax.axis_index("nodes") * block
+                       + jnp.arange(block, dtype=jnp.int32))
+            return self._round(
+                state, send_key, send_val, commit_req, repl_ok,
+                row_ids=row_ids,
+                widen=lambda x: lax.all_gather(x, "nodes", axis=0,
+                                               tiled=True),
+                reduce_sum=lambda x: lax.psum(x, "nodes"),
+                reduce_max=lambda x: lax.pmax(x, "nodes"))
+
+        return step
+
+    def step(self, state: KafkaState,
+             send_key: np.ndarray | None = None,
+             send_val: np.ndarray | None = None,
+             commit_req: np.ndarray | None = None,
+             repl_ok: np.ndarray | None = None) -> KafkaState:
+        n, s, k = self.n_nodes, self.max_sends, self.n_keys
+        if send_key is None:
+            send_key = np.full((n, s), -1, np.int32)
+            send_val = np.zeros((n, s), np.int32)
+        if commit_req is None:
+            commit_req = np.full((n, k), -1, np.int32)
+        if repl_ok is None:
+            repl_ok = np.ones((n, n), bool)
+        args = [jnp.asarray(send_key, jnp.int32),
+                jnp.asarray(send_val, jnp.int32),
+                jnp.asarray(commit_req, jnp.int32),
+                jnp.asarray(repl_ok)]
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P("nodes", None))
+            args[:3] = [jax.device_put(a, sh) for a in args[:3]]
+        return self._step(state, *args)
+
+    # -- host-side reads (reference read semantics) ------------------------
+
+    def alloc_offsets(self, state_before: KafkaState,
+                      send_key: np.ndarray) -> np.ndarray:
+        """(N, S) int32 — the offsets the sends of this round were acked
+        with (``send_ok`` replies), or -1.  Computed host-side with the
+        same (node, slot)-order linearization as the device round."""
+        ns = state_before  # allocation depends only on pre-round next_slot
+        base = np.asarray(ns.next_slot)
+        flat = np.asarray(send_key, np.int32).reshape(-1)
+        seen: dict[int, int] = {}
+        out = np.full(flat.shape, -1, np.int32)
+        for i, k in enumerate(flat):
+            if k < 0:
+                continue
+            r = seen.get(int(k), 0)
+            seen[int(k)] = r + 1
+            slot = int(base[k]) + r
+            if slot < self.capacity:
+                out[i] = slot + 1       # offset = slot + defaultOffset(1)
+        return out.reshape(send_key.shape)
+
+    def poll(self, state: KafkaState, node: int, key: int,
+             from_offset: int) -> list[list[int]]:
+        """[[offset, msg], ...] from this node's LOCAL log only
+        (log.go:79-110) — present slots at offset >= from_offset."""
+        present = np.asarray(state.present[node, key])
+        vals = np.asarray(state.log_vals[key])
+        out = []
+        for c in np.flatnonzero(present):
+            off = int(c) + 1
+            if off >= from_offset:
+                out.append([off, int(vals[c])])
+        return out
+
+    def list_committed(self, state: KafkaState, node: int) -> dict[int, int]:
+        """Per-key committed offsets from the node's LOCAL cache only
+        (log.go:131-156)."""
+        lc = np.asarray(state.local_committed[node])
+        return {k: int(lc[k]) for k in range(self.n_keys) if lc[k] > 0}
+
+    def committed_kv(self, state: KafkaState) -> dict[int, int]:
+        c = np.asarray(state.committed)
+        return {k: int(c[k]) for k in range(self.n_keys) if c[k] > 0}
