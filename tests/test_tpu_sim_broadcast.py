@@ -164,3 +164,62 @@ def test_graft_entry_points():
     out = jax.jit(fn)(*args)
     assert int(out.t) == 1
     g.dryrun_multichip(8)
+
+
+# -- structured (gather-free) topology exchange -------------------------
+
+
+def test_structured_exchange_matches_gather_all_topologies():
+    from gossip_glomers_tpu.parallel.topology import ring
+    from gossip_glomers_tpu.tpu_sim.structured import make_exchange
+
+    builders = {"tree": tree, "grid": grid, "ring": ring, "line": line}
+    for topo, builder in builders.items():
+        for n in (5, 16, 25, 64, 100):
+            nbrs = to_padded_neighbors(builder(n))
+            nv = min(n, 48)
+            inject = make_inject(n, nv)
+            ref = BroadcastSim(nbrs, n_values=nv)
+            fast = BroadcastSim(nbrs, n_values=nv,
+                                exchange=make_exchange(topo, n))
+            s1, r1 = ref.run(inject)
+            s2, r2 = fast.run(inject)
+            assert r1 == r2, (topo, n)
+            assert (ref.received_node_major(s1)
+                    == fast.received_node_major(s2)).all(), (topo, n)
+            assert int(s1.msgs) == int(s2.msgs), (topo, n)
+
+
+def test_structured_sharded_and_fused_match():
+    from gossip_glomers_tpu.tpu_sim.structured import make_exchange
+
+    n, nv = 64, 64
+    nbrs = to_padded_neighbors(tree(n))
+    inject = make_inject(n, nv)
+    ref = BroadcastSim(nbrs, n_values=nv)
+    s1, r1 = ref.run(inject)
+    for mesh in (None, mesh_1d(), mesh_2d()):
+        fast = BroadcastSim(nbrs, n_values=nv, mesh=mesh,
+                            exchange=make_exchange("tree", n))
+        s2, r2 = fast.run(inject)
+        assert r1 == r2
+        assert (ref.received_node_major(s1)
+                == fast.received_node_major(s2)).all()
+        assert int(s1.msgs) == int(s2.msgs)
+        s3, r3 = fast.run_fused(inject)
+        assert r1 == r3
+        assert (ref.received_node_major(s1)
+                == fast.received_node_major(s3)).all()
+
+
+def test_structured_rejects_partitions():
+    from gossip_glomers_tpu.tpu_sim.structured import make_exchange
+
+    n = 16
+    group = np.zeros((1, n), np.int8)
+    group[0, :8] = 1
+    parts = Partitions(jnp.array([0], jnp.int32),
+                       jnp.array([4], jnp.int32), jnp.asarray(group))
+    with pytest.raises(ValueError):
+        BroadcastSim(to_padded_neighbors(tree(n)), n_values=4,
+                     parts=parts, exchange=make_exchange("tree", n))
