@@ -1,0 +1,273 @@
+"""Real-process Maelstrom-style harness: OS processes + pipes + router.
+
+This is the in-repo equivalent of the role Maelstrom itself plays for
+the reference (survey §1 Layer 0): it spawns one OS process per node,
+speaks the line-JSON envelope over each process's stdin/stdout, routes
+every message (optionally with latency and partition drops), serves the
+``seq-kv``/``lin-kv`` service endpoints, and keeps a message ledger.
+
+Two kinds of node programs run under it, interchangeably:
+
+- **our stdio nodes** (``python -m gossip_glomers_tpu.nodes.<name>``) —
+  the Layer-1/2 reimplementation, and
+- **the reference's checked-in Go binaries**
+  (``/root/reference/*/maelstrom-*``) — the actual upstream
+  implementation, executed as an opaque artifact for black-box parity
+  runs (no reference *code* is used, only its observable protocol
+  behavior).
+
+That makes cross-implementation parity a first-class test: the same
+workload driven into both stacks through identical pipes must produce
+the same convergence results and — in the deterministic eager-flood
+window before the first randomized anti-entropy timer (2 s + jitter,
+broadcast/main.go:45-48) — identical server-to-server message counts.
+
+Unlike harness/network.py (virtual clock, single-threaded,
+deterministic), this harness runs on the wall clock with real OS
+concurrency, because the child processes do.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+from collections import Counter
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable
+
+from ..protocol import (KEY_DOES_NOT_EXIST, PRECONDITION_FAILED, Message,
+                        RPCError)
+
+DropFn = Callable[[str, str, float], bool]
+
+
+class _ProcNode:
+    def __init__(self, net: "ProcessNetwork", node_id: str,
+                 argv: list[str]) -> None:
+        self.id = node_id
+        # Scrub the env trigger that makes this image's sitecustomize
+        # register the TPU plugin in every child interpreter — node
+        # processes are pure-stdlib and would pay ~2 s of startup each.
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        self.proc = subprocess.Popen(
+            argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, bufsize=1, env=env)
+        self._stdin_lock = threading.Lock()
+        self._pump = threading.Thread(target=self._pump_stdout,
+                                      args=(net,), daemon=True)
+        self._pump.start()
+
+    def _pump_stdout(self, net: "ProcessNetwork") -> None:
+        assert self.proc.stdout is not None
+        for line in self.proc.stdout:
+            line = line.strip()
+            if line:
+                net._route(self.id, line)
+
+    def write(self, line: str) -> None:
+        with self._stdin_lock:
+            try:
+                assert self.proc.stdin is not None
+                self.proc.stdin.write(line + "\n")
+                self.proc.stdin.flush()
+            except (BrokenPipeError, ValueError):
+                pass  # node died; the workload's checks will notice
+
+    def stop(self) -> None:
+        try:
+            self.proc.terminate()
+            self.proc.wait(timeout=2.0)
+        except Exception:
+            self.proc.kill()
+
+
+class _KV:
+    """In-router linearizable KV endpoint (``seq-kv``/``lin-kv``) — the
+    same contract as harness/services.py, thread-safe for this
+    wall-clock harness."""
+
+    def __init__(self, service_id: str) -> None:
+        self.id = service_id
+        self.store: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def handle(self, body: dict) -> dict:
+        op = body.get("type")
+        key = str(body.get("key"))
+        with self._lock:
+            if op == "read":
+                if key not in self.store:
+                    return RPCError(KEY_DOES_NOT_EXIST,
+                                    f"key {key} not found").to_body()
+                return {"type": "read_ok", "value": self.store[key]}
+            if op == "write":
+                self.store[key] = body.get("value")
+                return {"type": "write_ok"}
+            if op == "cas":
+                frm, to = body.get("from"), body.get("to")
+                if key not in self.store:
+                    if body.get("create_if_not_exists"):
+                        self.store[key] = to
+                        return {"type": "cas_ok"}
+                    return RPCError(KEY_DOES_NOT_EXIST,
+                                    f"key {key} not found").to_body()
+                if self.store[key] == frm:
+                    self.store[key] = to
+                    return {"type": "cas_ok"}
+                return RPCError(
+                    PRECONDITION_FAILED,
+                    f"expected {frm!r}, had {self.store[key]!r}").to_body()
+        return RPCError(10, f"unsupported service op {op}").to_body()
+
+
+class ProcessNetwork:
+    """Router for a cluster of real node processes."""
+
+    CLIENT = "c1"
+
+    def __init__(self, *, latency: float = 0.0,
+                 drop_fn: DropFn | None = None) -> None:
+        self.latency = latency
+        self.drop_fn = drop_fn
+        self.nodes: dict[str, _ProcNode] = {}
+        self.services: dict[str, _KV] = {}
+        self._lock = threading.Lock()
+        self.total = 0
+        self.by_type: Counter = Counter()
+        self.server_to_server = 0
+        self.server_msgs_by_type: Counter = Counter()
+        self.dropped = 0
+        self._next_msg_id = 0
+        self._pending: dict[int, tuple[threading.Event, list]] = {}
+        self._last_traffic = time.monotonic()
+        self._t0 = time.monotonic()
+
+    # -- construction ------------------------------------------------------
+
+    def spawn(self, node_id: str, argv: list[str]) -> None:
+        self.nodes[node_id] = _ProcNode(self, node_id, argv)
+
+    def add_kv(self, service_id: str) -> None:
+        self.services[service_id] = _KV(service_id)
+
+    def init_cluster(self, timeout: float = 15.0) -> None:
+        node_ids = sorted(self.nodes)
+        with ThreadPoolExecutor(max_workers=len(node_ids)) as pool:
+            replies = list(pool.map(
+                lambda nid: self.rpc(nid, {"type": "init", "node_id": nid,
+                                           "node_ids": node_ids},
+                                     timeout=timeout), node_ids))
+        for reply in replies:
+            assert reply["type"] == "init_ok", reply
+
+    def set_topology(self, topology: dict[str, list[str]],
+                     timeout: float = 10.0) -> None:
+        for nid in self.nodes:
+            reply = self.rpc(nid, {"type": "topology",
+                                   "topology": topology}, timeout=timeout)
+            assert reply["type"] == "topology_ok", reply
+
+    # -- routing -----------------------------------------------------------
+
+    def _route(self, src: str, line: str) -> None:
+        try:
+            msg = json.loads(line)
+        except json.JSONDecodeError:
+            return
+        self._transmit(src, msg.get("dest", ""), msg.get("body", {}))
+
+    def _transmit(self, src: str, dest: str, body: dict) -> None:
+        """Single transmit path for EVERY message — node, service and
+        client traffic all get the same accounting, drop and latency
+        treatment.  server_to_server counts src-is-node AND dest in
+        nodes-or-services, matching harness/network.py:175-178 so
+        cross-harness ledger comparisons compare the same quantity."""
+        with self._lock:
+            self.total += 1
+            self.by_type[body.get("type", "?")] += 1
+            self._last_traffic = time.monotonic()
+            if src in self.nodes and (dest in self.nodes
+                                      or dest in self.services):
+                self.server_to_server += 1
+                self.server_msgs_by_type[body.get("type", "?")] += 1
+        now = time.monotonic() - self._t0
+        if self.drop_fn is not None and self.drop_fn(src, dest, now):
+            with self._lock:
+                self.dropped += 1
+            return
+        if self.latency > 0:
+            t = threading.Timer(self.latency, self._handoff,
+                                args=(src, dest, body))
+            t.daemon = True
+            t.start()
+        else:
+            self._handoff(src, dest, body)
+
+    def _handoff(self, src: str, dest: str, body: dict) -> None:
+        if dest in self.services:
+            reply = self.services[dest].handle(body)
+            if body.get("msg_id") is not None:
+                reply["in_reply_to"] = body["msg_id"]
+            self._transmit(dest, src, reply)
+            return
+        if dest in self.nodes:
+            self.nodes[dest].write(
+                json.dumps({"src": src, "dest": dest, "body": body}))
+            return
+        # → client
+        irt = body.get("in_reply_to")
+        if irt is not None:
+            with self._lock:
+                slot = self._pending.get(irt)
+            if slot is not None:
+                slot[1].append(body)
+                slot[0].set()
+
+    # -- client ops --------------------------------------------------------
+
+    def send(self, dest: str, body: dict) -> None:
+        self._transmit(self.CLIENT, dest, dict(body))
+
+    def rpc(self, dest: str, body: dict,
+            timeout: float = 5.0) -> dict:
+        with self._lock:
+            self._next_msg_id += 1
+            msg_id = self._next_msg_id
+            ev: tuple[threading.Event, list] = (threading.Event(), [])
+            self._pending[msg_id] = ev
+        out = dict(body)
+        out["msg_id"] = msg_id
+        self._transmit(self.CLIENT, dest, out)
+        ok = ev[0].wait(timeout)
+        with self._lock:
+            self._pending.pop(msg_id, None)
+        if not ok:
+            raise TimeoutError(f"rpc {body.get('type')} to {dest}")
+        return ev[1][0]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def quiesce(self, idle: float = 0.25, timeout: float = 10.0) -> None:
+        """Block until no message has been routed for ``idle`` seconds
+        (bounded by ``timeout``)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                last = self._last_traffic
+            if time.monotonic() - last >= idle:
+                return
+            time.sleep(0.02)
+
+    def shutdown(self) -> None:
+        for node in self.nodes.values():
+            node.stop()
+
+    def __enter__(self) -> "ProcessNetwork":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -29,10 +29,16 @@ def tree(n: int, branching: int = 4) -> list[list[int]]:
     return adj
 
 
+def grid_cols(n: int) -> int:
+    """Column count of the n-node grid — shared by the adjacency builder
+    and the structured exchange so they can never disagree."""
+    return max(1, math.isqrt(n - 1) + 1) if n > 1 else 1
+
+
 def grid(n: int) -> list[list[int]]:
     """2D grid (Maelstrom's default broadcast topology): ceil(sqrt(n))
     columns, neighbors up/down/left/right."""
-    cols = max(1, math.isqrt(n - 1) + 1) if n > 1 else 1
+    cols = grid_cols(n)
     adj: list[list[int]] = [[] for _ in range(n)]
     for i in range(n):
         r, c = divmod(i, cols)

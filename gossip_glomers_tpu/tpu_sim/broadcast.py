@@ -256,7 +256,15 @@ class BroadcastSim:
             self._state_spec = (P("nodes", "words") if has_words
                                 else P("nodes", None)) \
                 if mesh is not None else None
-        if mesh is not None:
+        if self.words_major:
+            # the structured path never reads the adjacency on device —
+            # keep it host-side (at 1M nodes it is ~6x the bitset state)
+            self.nbrs = None
+            self.nbr_mask = None
+            self.deg = (jax.device_put(jnp.asarray(deg),
+                                       NamedSharding(mesh, P("nodes")))
+                        if mesh is not None else jnp.asarray(deg))
+        elif mesh is not None:
             node_sh = NamedSharding(mesh, P("nodes", None))
             self.nbrs = jax.device_put(jnp.asarray(nbrs, jnp.int32), node_sh)
             self.nbr_mask = jax.device_put(jnp.asarray(nbr_mask), node_sh)

@@ -29,9 +29,9 @@ its row block back out.
 
 from __future__ import annotations
 
-import math
-
 import jax.numpy as jnp
+
+from ..parallel.topology import grid_cols
 
 
 def _zeros(payload: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -96,9 +96,7 @@ def make_exchange(topology: str, n: int, **kw):
         k = kw.get("branching", 4)
         return lambda p: tree_exchange(p, k)
     if topology == "grid":
-        cols = kw.get("cols")
-        if cols is None:
-            cols = max(1, math.isqrt(n - 1) + 1) if n > 1 else 1
+        cols = kw.get("cols") or grid_cols(n)
         return lambda p: grid_exchange(p, cols)
     if topology == "ring":
         return ring_exchange

@@ -1,0 +1,182 @@
+"""Cross-implementation parity: our stack vs the reference Go binaries.
+
+The reference repo checks in its compiled Maelstrom node binaries
+(broadcast, counter, kafka).  These tests execute them as opaque
+artifacts under our in-repo process harness and drive the identical
+workload into our own stdio nodes and virtual-clock harness, asserting:
+
+- identical convergence results, and
+- identical server-to-server message counts in the deterministic
+  eager-flood window (before the reference's first randomized
+  anti-entropy timer at 2 s + jitter, broadcast/main.go:45-48).
+
+This is the "bit-identical message counts vs. the Go reference"
+criterion of BASELINE.json, made executable without any Maelstrom
+install.
+"""
+
+import os
+import sys
+
+import pytest
+
+from gossip_glomers_tpu.harness.process_net import ProcessNetwork
+from gossip_glomers_tpu.parallel.topology import tree, to_name_map
+
+GO_BROADCAST = "/root/reference/broadcast/maelstrom-broadcast"
+GO_COUNTER = "/root/reference/counter/maelstrom-grow-only-counter"
+GO_KAFKA = "/root/reference/kafka/maelstrom-kafka"
+
+needs_go = pytest.mark.skipif(
+    not os.path.exists(GO_BROADCAST),
+    reason="reference binaries not mounted")
+
+PY = [sys.executable, "-m"]
+
+
+def run_broadcast_flood(argv_of, n=5, n_values=8):
+    """Spawn n nodes, flood n_values, return (server msgs by type,
+    per-node final reads)."""
+    net = ProcessNetwork()
+    try:
+        for i in range(n):
+            net.spawn(f"n{i}", argv_of(i))
+        net.init_cluster()
+        net.set_topology(to_name_map(tree(n)))
+        for v in range(n_values):
+            rep = net.rpc(f"n{v % n}", {"type": "broadcast", "message": v})
+            assert rep["type"] == "broadcast_ok", rep
+        net.quiesce(idle=0.3, timeout=5.0)
+        msgs = dict(net.server_msgs_by_type)
+        reads = {}
+        for i in range(n):
+            rep = net.rpc(f"n{i}", {"type": "read"})
+            reads[f"n{i}"] = sorted(rep.get("messages") or [])
+        return msgs, reads
+    finally:
+        net.shutdown()
+
+
+def analytic_flood_count(n=5, n_values=8):
+    """Eager flood on a tree sends deg(origin) + sum_{i != origin}
+    (deg(i)-1) value-messages per value (rebroadcastAllExcept,
+    broadcast.go:50-57) — on a tree that is exactly n-1 per value."""
+    return n_values * (n - 1)
+
+
+@needs_go
+def test_go_binaries_flood_count_and_convergence():
+    msgs, reads = run_broadcast_flood(lambda i: [GO_BROADCAST])
+    assert all(r == list(range(8)) for r in reads.values())
+    assert msgs["broadcast"] == analytic_flood_count()
+    # every server-to-server broadcast is acked (broadcast.go:69,78)
+    assert msgs["broadcast_ok"] == msgs["broadcast"]
+
+
+def test_our_stdio_nodes_match_go_flood_counts():
+    msgs, reads = run_broadcast_flood(
+        lambda i: PY + ["gossip_glomers_tpu.nodes.broadcast"])
+    assert all(r == list(range(8)) for r in reads.values())
+    assert msgs["broadcast"] == analytic_flood_count()
+    assert msgs["broadcast_ok"] == msgs["broadcast"]
+
+
+def test_virtual_harness_matches_go_flood_counts():
+    from gossip_glomers_tpu.harness.workloads import run_broadcast
+
+    res = run_broadcast(n_nodes=5, topology="tree", n_values=8,
+                        rate=100.0, quiescence=0.5, seed=0)
+    assert res.ok
+    # quiescence kept below the 2 s anti-entropy timer: eager flood only
+    by_type = res.stats["by_type"]
+    assert res.stats["server_msgs_at_quiescence"] == \
+        2 * analytic_flood_count()  # broadcast + broadcast_ok
+    assert by_type["broadcast"] - 8 == analytic_flood_count()  # -client ops
+
+
+def _counter_session(argv):
+    """2 nodes + seq-kv: three adds, wait out the 200 ms flush cadence
+    and 700 ms read-poll (add.go:62, counter/main.go:53), read both."""
+    import time
+
+    net = ProcessNetwork()
+    try:
+        net.add_kv("seq-kv")
+        for i in range(2):
+            net.spawn(f"n{i}", list(argv))
+        net.init_cluster()
+        for d in (3, 4, 5):
+            rep = net.rpc(f"n{d % 2}", {"type": "add", "delta": d})
+            assert rep["type"] == "add_ok"
+        time.sleep(1.6)
+        return [net.rpc(f"n{i}", {"type": "read"})["value"]
+                for i in range(2)]
+    finally:
+        net.shutdown()
+
+
+@needs_go
+def test_go_counter_semantics():
+    assert _counter_session([GO_COUNTER]) == [12, 12]
+
+
+def test_our_counter_matches_go_semantics():
+    assert _counter_session(
+        PY + ["gossip_glomers_tpu.nodes.counter"]) == [12, 12]
+
+
+def _kafka_session(argv, poll_field, poll_from):
+    """The checked-in Go kafka binary predates the checked-in source: it
+    replies to poll with field ``offsets`` (and returns nothing for
+    from-offset 0), where kafka/log.go:85-88 says ``msgs`` (and returns
+    everything >= the requested offset).  The source is the authoritative
+    reference; our node follows it.  The session parameterizes over the
+    dialect so both stacks are checked for the same content."""
+    net = ProcessNetwork()
+    try:
+        net.add_kv("lin-kv")
+        for i in range(2):
+            net.spawn(f"n{i}", list(argv))
+        net.init_cluster()
+        offs = [net.rpc("n0", {"type": "send", "key": "k1",
+                               "msg": 100 + j})["offset"]
+                for j in range(3)]
+        net.quiesce(idle=0.3, timeout=5.0)
+        poll0 = net.rpc("n0", {"type": "poll",
+                               "offsets": {"k1": poll_from}})[poll_field]
+        poll1 = net.rpc("n1", {"type": "poll",
+                               "offsets": {"k1": poll_from}})[poll_field]
+        net.rpc("n0", {"type": "commit_offsets",
+                       "offsets": {"k1": offs[-1]}})
+        listed = net.rpc("n0", {"type": "list_committed_offsets",
+                                "keys": ["k1"]})["offsets"]
+        return offs, poll0, poll1, listed
+    finally:
+        net.shutdown()
+
+
+@needs_go
+def test_go_kafka_semantics():
+    # The artifact is older still than its poll dialect suggests: it
+    # allocates offsets locally (no lin-kv traffic) and does not
+    # replicate to peers at all — a single-node-stage build (the 5a
+    # solution in the challenge progression).  Assert the behavior the
+    # artifact actually has; full replicated semantics are asserted
+    # against kafka/log.go via our node below.
+    offs, poll0, poll1, listed = _kafka_session(
+        [GO_KAFKA], poll_field="offsets", poll_from=1)
+    assert offs == [1, 2, 3]
+    assert poll0["k1"] == [[1, 100], [2, 101], [3, 102]]
+    assert poll1["k1"] == []  # no replication in this build
+    assert listed == {"k1": 3}
+
+
+def test_our_kafka_matches_go_semantics():
+    # our node speaks the source dialect: "msgs", from-offset 0 = all
+    offs, poll0, poll1, listed = _kafka_session(
+        PY + ["gossip_glomers_tpu.nodes.kafka"],
+        poll_field="msgs", poll_from=0)
+    assert offs == [1, 2, 3]
+    assert poll0["k1"] == [[1, 100], [2, 101], [3, 102]]
+    assert poll1["k1"] == poll0["k1"]
+    assert listed == {"k1": 3}
