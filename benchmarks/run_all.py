@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""All five BASELINE.json benchmark configs, one JSON line each.
+
+  1. broadcast: 25-node tree, no faults        (virtual harness, parity)
+  2. broadcast: 25-node grid, 100 ms + parts   (virtual harness, faults)
+  3. counter:   1k-node g-counter, partitioned (tpu_sim, all-reduce)
+  4. broadcast: 1M-node expander epidemic      (tpu_sim, structured)
+  5. kafka:     10k-key log, collective offsets(tpu_sim, rank-per-round)
+
+Usage: python benchmarks/run_all.py [--out BENCH_ALL.json]
+The headline driver metric stays in bench.py (config 4's tree variant).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+
+def config1_tree25():
+    from gossip_glomers_tpu.harness.workloads import run_broadcast
+
+    t0 = time.perf_counter()
+    res = run_broadcast(n_nodes=25, topology="tree", n_values=40,
+                        rate=10.0, quiescence=12.0, seed=0)
+    return {
+        "config": "broadcast-25-tree-nofault",
+        "ok": bool(res.ok),
+        "msgs_per_op": round(res.stats["msgs_per_op"], 2),
+        "broadcast_latency_max_s": round(
+            res.stats["broadcast_latency_max"], 3),
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
+def config2_grid25_faults():
+    from gossip_glomers_tpu.harness import random_partitions
+    from gossip_glomers_tpu.harness.workloads import run_broadcast
+
+    nodes = [f"n{i}" for i in range(25)]
+    t0 = time.perf_counter()
+    res = run_broadcast(
+        n_nodes=25, topology="grid", n_values=40, rate=10.0,
+        quiescence=15.0, latency=0.1,
+        partitions=random_partitions(nodes, t_end=16.0, seed=3), seed=3)
+    return {
+        "config": "broadcast-25-grid-100ms-partitions",
+        "ok": bool(res.ok),
+        "msgs_per_op": round(res.stats["msgs_per_op"], 2),
+        "broadcast_latency_max_s": round(
+            res.stats["broadcast_latency_max"], 3),
+        "dropped_msgs": res.stats["dropped_msgs"],
+        "wall_s": round(time.perf_counter() - t0, 2),
+        # reference claims: <500 ms op latency, <20 msgs/op (README.md:16-17)
+        "ref_latency_target_s": 0.5,
+    }
+
+
+def config3_counter_1k():
+    import jax
+    import jax.numpy as jnp
+
+    from gossip_glomers_tpu.tpu_sim.counter import CounterSim, KVReach
+
+    n = 1024
+    rng = np.random.default_rng(0)
+    deltas = rng.integers(0, 10, n).astype(np.int32)
+    blocked = np.zeros((1, n), bool)
+    blocked[0, : n // 2] = True
+    sched = KVReach(jnp.array([0], jnp.int32), jnp.array([8], jnp.int32),
+                    jnp.asarray(blocked))
+    sim = CounterSim(n, mode="allreduce", poll_every=2, kv_sched=sched)
+    st = sim.add(sim.init_state(), deltas)
+    sim.run(st, 1)  # compile
+    t0 = time.perf_counter()
+    st = sim.run(st, 16)  # 8 partitioned rounds + 8 to heal
+    jax.block_until_ready(st.kv)
+    dt = time.perf_counter() - t0
+    reads = sim.reads(st)
+    return {
+        "config": "counter-1k-partitioned",
+        "ok": bool(sim.kv_value(st) == int(deltas.sum())
+                   and (reads == int(deltas.sum())).all()),
+        "rounds": 16,
+        "wall_s": round(dt, 4),
+        "kv_msgs": int(st.msgs),
+    }
+
+
+def config4_epidemic_1m():
+    import jax
+
+    from gossip_glomers_tpu.parallel.topology import (circulant,
+                                                      expander_strides)
+    from gossip_glomers_tpu.tpu_sim.broadcast import (BroadcastSim,
+                                                      make_inject)
+    from gossip_glomers_tpu.tpu_sim.structured import make_exchange
+
+    n = 1 << 20
+    strides = expander_strides(n, degree=8, seed=0)
+    nbrs = circulant(n, strides)
+    sim = BroadcastSim(nbrs, n_values=32, sync_every=64,
+                       exchange=make_exchange("circulant", n,
+                                              strides=strides))
+    inject = make_inject(n, 32)
+    state, rounds = sim.run_fused(inject)  # compile + warm
+    jax.block_until_ready(state.received)
+    state0, target = sim.stage(inject)
+    jax.block_until_ready(state0.received)
+    t0 = time.perf_counter()
+    state = sim.run_staged(state0, target)
+    jax.block_until_ready(state.received)
+    dt = time.perf_counter() - t0
+    return {
+        "config": "broadcast-1M-expander-epidemic",
+        "ok": bool(sim.converged(state, target)),
+        "rounds": int(state.t),
+        "wall_s": round(dt, 4),
+        "msgs": int(state.msgs),
+    }
+
+
+def config5_kafka_10k():
+    import jax
+
+    from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim
+
+    n_nodes, n_keys, cap, s = 8, 10_000, 128, 64
+    sim = KafkaSim(n_nodes, n_keys, capacity=cap, max_sends=s)
+    st = sim.init_state()
+    rng = np.random.default_rng(0)
+    sk = rng.integers(0, n_keys, (n_nodes, s)).astype(np.int32)
+    sv = rng.integers(0, 1 << 20, (n_nodes, s)).astype(np.int32)
+    st = sim.step(st, sk, sv)  # compile
+    jax.block_until_ready(st.present)
+    rounds = 32
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        sk = rng.integers(0, n_keys, (n_nodes, s)).astype(np.int32)
+        st = sim.step(st, sk, sv)
+    jax.block_until_ready(st.present)
+    dt = time.perf_counter() - t0
+    sends = rounds * n_nodes * s
+    return {
+        "config": "kafka-10k-keys-collective-offsets",
+        "ok": bool(int(np.asarray(st.next_slot).sum())
+                   == sends + n_nodes * s),
+        "sends_per_s": int(sends / dt),
+        "wall_s": round(dt, 4),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated config numbers, e.g. 1,4")
+    args = ap.parse_args()
+    configs = {
+        "1": config1_tree25, "2": config2_grid25_faults,
+        "3": config3_counter_1k, "4": config4_epidemic_1m,
+        "5": config5_kafka_10k,
+    }
+    pick = (args.only.split(",") if args.only else list(configs))
+    results = []
+    for key in pick:
+        result = configs[key]()
+        results.append(result)
+        print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            for r in results:
+                f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

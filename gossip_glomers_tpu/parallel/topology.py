@@ -70,6 +70,38 @@ def full(n: int) -> list[list[int]]:
     return [[j for j in range(n) if j != i] for i in range(n)]
 
 
+def circulant(n: int, strides: list[int]) -> np.ndarray:
+    """Circulant graph: node i's neighbors are i ± s (mod n) for each
+    stride s.  With a few random-ish strides this is an expander with
+    the same O(log n) diameter as a random-regular graph — but its
+    neighbor map is pure rotations, so the tpu_sim structured exchange
+    delivers it with contiguous rolls instead of a random gather (the
+    TPU-native choice for the 1M-node epidemic benchmark,
+    BASELINE.json config 4).
+
+    Returns an (n, 2*len(strides)) int32 padded-neighbor array
+    compatible with the gather path (for cross-checking).
+    """
+    cols = []
+    for s in strides:
+        s = s % n
+        idx = np.arange(n, dtype=np.int64)
+        cols.append((idx + s) % n)
+        cols.append((idx - s) % n)
+    return np.stack(cols, axis=1).astype(np.int32)
+
+
+def expander_strides(n: int, degree: int = 8, seed: int = 0) -> list[int]:
+    """Pseudo-random distinct strides in [1, n//2) for a circulant
+    expander of the given (even) degree."""
+    rng = np.random.default_rng(seed)
+    want = max(1, degree // 2)
+    strides: set[int] = {1}
+    while len(strides) < want:
+        strides.add(int(rng.integers(2, max(3, n // 2))))
+    return sorted(strides)
+
+
 def random_regular(n: int, degree: int, seed: int = 0) -> np.ndarray:
     """Directed random graph with out-degree exactly ``degree``, built
     from ``degree`` seeded derangement-ish permutations (each permutation

@@ -82,6 +82,18 @@ def ring_exchange(payload: jnp.ndarray) -> jnp.ndarray:
             | jnp.roll(payload, -1, axis=1))
 
 
+def circulant_exchange(payload: jnp.ndarray,
+                       strides: list[int]) -> jnp.ndarray:
+    """inbox for parallel/topology.py::circulant — the epidemic
+    expander as pure rotations: one ±roll pair per stride."""
+    out = None
+    for s in strides:
+        term = (jnp.roll(payload, s, axis=1)
+                | jnp.roll(payload, -s, axis=1))
+        out = term if out is None else out | term
+    return out if out is not None else jnp.zeros_like(payload)
+
+
 def line_exchange(payload: jnp.ndarray) -> jnp.ndarray:
     """inbox for parallel/topology.py::line."""
     fwd = jnp.concatenate([payload[:, 1:], _zeros(payload, 1)], axis=1)
@@ -102,4 +114,7 @@ def make_exchange(topology: str, n: int, **kw):
         return ring_exchange
     if topology == "line":
         return line_exchange
+    if topology == "circulant":
+        strides = list(kw["strides"])
+        return lambda p: circulant_exchange(p, strides)
     return None

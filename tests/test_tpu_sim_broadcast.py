@@ -223,3 +223,25 @@ def test_structured_rejects_partitions():
     with pytest.raises(ValueError):
         BroadcastSim(to_padded_neighbors(tree(n)), n_values=4,
                      parts=parts, exchange=make_exchange("tree", n))
+
+
+def test_circulant_exchange_matches_gather():
+    from gossip_glomers_tpu.parallel.topology import (circulant,
+                                                      expander_strides)
+    from gossip_glomers_tpu.tpu_sim.structured import make_exchange
+
+    for n, seed in ((64, 0), (100, 7)):
+        strides = expander_strides(n, degree=6, seed=seed)
+        nbrs = circulant(n, strides)
+        nv = 32
+        inject = make_inject(n, nv)
+        ref = BroadcastSim(nbrs, n_values=nv)
+        fast = BroadcastSim(nbrs, n_values=nv,
+                            exchange=make_exchange("circulant", n,
+                                                   strides=strides))
+        s1, r1 = ref.run(inject)
+        s2, r2 = fast.run(inject)
+        assert r1 == r2
+        assert (ref.received_node_major(s1)
+                == fast.received_node_major(s2)).all()
+        assert int(s1.msgs) == int(s2.msgs)
