@@ -1,0 +1,74 @@
+"""Message tracing: capture + export + summarize harness traffic.
+
+The reference has no in-repo tracing — the Go client logs every message
+to stderr ("Sent %s"/"Received %s") and Maelstrom aggregates timelines
+and msgs-per-op plots (survey §5).  Here the virtual-clock network can
+record every routed message with its virtual timestamp; this module
+exports that trace as line-JSON (one ``{"t", "src", "dest", "body"}``
+object per line — the same envelope the wire uses, plus time) and
+computes the aggregate views Maelstrom publishes: counts by body type,
+counts by directed edge, and a per-op server-message accounting.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import IO
+
+from ..protocol import Message
+from .network import VirtualNetwork
+
+
+def enable_trace(net: VirtualNetwork) -> list[tuple[float, Message]]:
+    """Turn on message capture; returns the live trace list."""
+    net.trace = []
+    return net.trace
+
+
+def export_jsonl(trace: list[tuple[float, Message]], fp: IO[str]) -> int:
+    """Write one JSON object per routed message; returns the count."""
+    n = 0
+    for t, msg in trace:
+        fp.write(json.dumps({"t": round(t, 6), "src": msg.src,
+                             "dest": msg.dest, "body": msg.body}) + "\n")
+        n += 1
+    return n
+
+
+def load_jsonl(fp: IO[str]) -> list[tuple[float, Message]]:
+    out = []
+    for line in fp:
+        line = line.strip()
+        if not line:
+            continue
+        obj = json.loads(line)
+        out.append((obj["t"], Message(obj["src"], obj["dest"],
+                                      obj["body"])))
+    return out
+
+
+def summarize(trace: list[tuple[float, Message]],
+              server_prefix: str = "n") -> dict:
+    """Aggregate views over a trace: totals, by-type, by-edge, and the
+    server-to-server share (the msgs-per-op numerator,
+    reference README.md:17)."""
+    by_type: Counter = Counter()
+    by_edge: Counter = Counter()
+    server_to_server = 0
+    t_first = t_last = None
+    for t, msg in trace:
+        by_type[msg.type] += 1
+        by_edge[(msg.src, msg.dest)] += 1
+        if (msg.src.startswith(server_prefix)
+                and msg.dest.startswith(server_prefix)):
+            server_to_server += 1
+        t_first = t if t_first is None else t_first
+        t_last = t
+    return {
+        "total": len(trace),
+        "server_to_server": server_to_server,
+        "by_type": dict(by_type),
+        "busiest_edges": by_edge.most_common(10),
+        "t_span": (t_first, t_last),
+    }

@@ -538,6 +538,31 @@ class BroadcastSim:
         rec = np.asarray(state.received)
         return rec.T if self.words_major else rec
 
+    def run_stats(self, inject: np.ndarray, *, max_rounds: int = 1 << 16,
+                  ) -> tuple[BroadcastState, int, list[dict]]:
+        """Like :meth:`run` but records per-round observability stats —
+        the structured counterpart of Maelstrom's timeline plots (survey
+        §5): known-bit totals (convergence progress) and the message
+        ledger per round."""
+        target = self.target_bits(inject)
+        state = self.init_state(inject)
+        stats: list[dict] = []
+        prev_msgs = 0
+        rounds = 0
+        while rounds < max_rounds:
+            state = self.step(state)
+            rounds += 1
+            known = int(jnp.sum(
+                _popcount(state.received).astype(jnp.uint32)))
+            msgs = int(state.msgs)
+            stats.append({"round": rounds, "known_bits": known,
+                          "msgs_round": msgs - prev_msgs,
+                          "msgs_total": msgs})
+            prev_msgs = msgs
+            if self.converged(state, target):
+                break
+        return state, rounds, stats
+
     def read(self, state: BroadcastState) -> list[list[int]]:
         """Per-node sorted value lists (the ``read`` handler's reply,
         broadcast.go:124-132) — host-side, for checkers."""

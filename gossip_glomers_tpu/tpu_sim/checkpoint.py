@@ -1,0 +1,62 @@
+"""Checkpoint / resume for tpu_sim states.
+
+The reference keeps all state in memory and loses it on restart (survey
+§5 "Checkpoint / resume: none").  The vectorized backend makes durable
+state nearly free: every sim state is a NamedTuple of arrays, so a
+checkpoint is one compressed ``.npz`` per state — enough to stop a
+million-node run mid-flight and resume it bit-exactly (tests assert the
+resumed run equals the uninterrupted one).
+
+Works for every tpu_sim state class (BroadcastState, CounterState,
+KafkaState, UniqueIdsState, EchoState) and any future NamedTuple of
+arrays.  Sharded states are gathered to host on save; ``restore`` takes
+an optional ``device_put`` function to re-place arrays with their
+shardings (e.g. ``sim.init_state``-style placement).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def save(path: str, state: Any, meta: dict | None = None) -> None:
+    """Write a NamedTuple-of-arrays state as one compressed npz."""
+    fields = getattr(state, "_fields", None)
+    if fields is None:
+        raise TypeError("state must be a NamedTuple of arrays")
+    payload = {f: np.asarray(getattr(state, f)) for f in fields}
+    payload["__meta__"] = np.frombuffer(
+        json.dumps({"fields": list(fields),
+                    "class": type(state).__name__,
+                    **(meta or {})}).encode(), dtype=np.uint8)
+    np.savez_compressed(path, **payload)
+
+
+def restore(path: str, state_cls: type, *,
+            device_put: Callable[[str, np.ndarray], Any] | None = None,
+            ) -> tuple[Any, dict]:
+    """Load a state saved by :func:`save`.  Returns (state, meta).
+
+    ``device_put(field_name, host_array)`` may re-place each array (with
+    a sharding); by default arrays become ordinary device arrays.
+    """
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        if meta["class"] != state_cls.__name__:
+            raise ValueError(
+                f"checkpoint holds {meta['class']}, not "
+                f"{state_cls.__name__}")
+        vals = []
+        for f in meta["fields"]:
+            arr = z[f]
+            if device_put is not None:
+                vals.append(device_put(f, arr))
+            else:
+                vals.append(jnp.asarray(arr))
+    extra = {k: v for k, v in meta.items()
+             if k not in ("fields", "class")}
+    return state_cls(*vals), extra
