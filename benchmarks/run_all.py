@@ -102,12 +102,26 @@ def config4_epidemic_1m():
                                                       make_inject)
     from gossip_glomers_tpu.tpu_sim.structured import make_exchange
 
+    from jax.sharding import Mesh
+
+    from gossip_glomers_tpu.tpu_sim.structured import make_sharded_exchange
+
     n = 1 << 20
     strides = expander_strides(n, degree=8, seed=0)
     nbrs = circulant(n, strides)
-    sim = BroadcastSim(nbrs, n_values=32, sync_every=64,
+    devices = jax.devices()
+    mesh = sharded_ex = None
+    if len(devices) > 1:
+        n_dev = 1 << (len(devices).bit_length() - 1)
+        mesh = Mesh(np.array(devices[:n_dev]), ("nodes",))
+        # halo path: O(block) ppermutes per stride instead of an
+        # O(N) all_gather per round
+        sharded_ex = make_sharded_exchange("circulant", n, n_dev,
+                                           strides=strides)
+    sim = BroadcastSim(nbrs, n_values=32, sync_every=64, mesh=mesh,
                        exchange=make_exchange("circulant", n,
-                                              strides=strides))
+                                              strides=strides),
+                       sharded_exchange=sharded_ex)
     inject = make_inject(n, 32)
     state, rounds = sim.run_fused(inject)  # compile + warm
     jax.block_until_ready(state.received)

@@ -228,6 +228,8 @@ class BroadcastSim:
                  parts: Partitions | None = None,
                  mesh: Mesh | None = None,
                  exchange: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
+                 sharded_exchange: Callable[[jnp.ndarray], jnp.ndarray]
+                 | None = None,
                  ) -> None:
         n = nbrs.shape[0]
         self.n_nodes = n
@@ -237,6 +239,12 @@ class BroadcastSim:
         self.mesh = mesh
         self.parts = parts if parts is not None else Partitions.none(n)
         self.exchange = exchange
+        # halo path: local-block -> local-block delivery via ppermute
+        # (structured.make_sharded_exchange); requires `exchange` too for
+        # the single-device fallback and n divisible by the node axis.
+        self.sharded_exchange = sharded_exchange
+        if sharded_exchange is not None and exchange is None:
+            raise ValueError("sharded_exchange requires exchange")
         self.words_major = exchange is not None
         if self.words_major and self.parts.starts.shape[0] > 0:
             raise ValueError(
@@ -326,6 +334,13 @@ class BroadcastSim:
         (ppermute of the O(1)-wide boundary region each structured
         topology actually reads) — a follow-up, not a correctness gap."""
         mesh_axes = tuple(self.mesh.axis_names)
+        if self.sharded_exchange is not None:
+            # halo path: the exchange maps local block -> local block
+            # with O(block) ppermutes; no all_gather, no slice.
+            return _round_wm(
+                state, deg=deg, sync_every=self.sync_every,
+                exchange=self.sharded_exchange,
+                reduce_sum=lambda s: lax.psum(s, mesh_axes))
         block = state.received.shape[1]
         start = lax.axis_index("nodes") * block
         return _round_wm(

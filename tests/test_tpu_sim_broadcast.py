@@ -245,3 +245,48 @@ def test_circulant_exchange_matches_gather():
         assert (ref.received_node_major(s1)
                 == fast.received_node_major(s2)).all()
         assert int(s1.msgs) == int(s2.msgs)
+
+
+def test_halo_sharded_exchange_matches_reference():
+    # ppermute halo path: local-block -> local-block delivery with
+    # O(block) communication, vs the O(N) all_gather path
+    from gossip_glomers_tpu.parallel.topology import (circulant,
+                                                      expander_strides,
+                                                      ring)
+    from gossip_glomers_tpu.tpu_sim.structured import (make_exchange,
+                                                       make_sharded_exchange)
+
+    cases = [("ring", 64, {}),
+             ("circulant", 64, {"strides": expander_strides(64, 6, 1)}),
+             ("circulant", 128, {"strides": [1, 5, 33]})]
+    for topo, n, kw in cases:
+        nbrs = (to_padded_neighbors(ring(n)) if topo == "ring"
+                else circulant(n, kw["strides"]))
+        nv = 64
+        inject = make_inject(n, nv)
+        ref = BroadcastSim(nbrs, n_values=nv)
+        s1, r1 = ref.run(inject)
+        for mesh, pdim in ((mesh_1d(), 8), (mesh_2d(), 4)):
+            halo = BroadcastSim(
+                nbrs, n_values=nv, mesh=mesh,
+                exchange=make_exchange(topo, n, **kw),
+                sharded_exchange=make_sharded_exchange(topo, n, pdim,
+                                                       **kw))
+            s2, r2 = halo.run(inject)
+            assert r1 == r2, (topo, n, mesh.axis_names)
+            assert (ref.received_node_major(s1)
+                    == halo.received_node_major(s2)).all()
+            assert int(s1.msgs) == int(s2.msgs)
+            s3, r3 = halo.run_fused(inject)
+            assert r1 == r3
+            assert (ref.received_node_major(s1)
+                    == halo.received_node_major(s3)).all()
+
+
+def test_sharded_exchange_requires_exchange():
+    from gossip_glomers_tpu.tpu_sim.structured import make_sharded_exchange
+
+    with pytest.raises(ValueError):
+        BroadcastSim(to_padded_neighbors(tree(16)), n_values=4,
+                     sharded_exchange=make_sharded_exchange(
+                         "ring", 16, 8))
