@@ -88,6 +88,7 @@ class KafkaSim:
         self.capacity = capacity
         self.max_sends = max_sends
         self.mesh = mesh
+        self._run_rounds = None
         self._step = self._build_step()
 
     def init_state(self) -> KafkaState:
@@ -215,6 +216,43 @@ class KafkaSim:
                 reduce_max=lambda x: lax.pmax(x, "nodes"))
 
         return step
+
+    def run_rounds(self, state: KafkaState, send_key: np.ndarray,
+                   send_val: np.ndarray,
+                   commit_req: np.ndarray | None = None,
+                   repl_ok: np.ndarray | None = None) -> KafkaState:
+        """R pre-staged rounds as ONE device program (``lax.scan``):
+        send_key/send_val are (R, N, S), commit_req (R, N, K).  One
+        dispatch instead of R — per-round dispatch latency dominates the
+        stepwise driver on small rounds.  Single-device only (the
+        stepwise path covers meshes)."""
+        if self.mesh is not None:
+            raise NotImplementedError("run_rounds is single-device; "
+                                      "use step() on meshes")
+        r = send_key.shape[0]
+        if commit_req is None:
+            commit_req = np.full((r, self.n_nodes, self.n_keys), -1,
+                                 np.int32)
+        if repl_ok is None:
+            repl_ok = np.ones((self.n_nodes, self.n_nodes), bool)
+        if getattr(self, "_run_rounds", None) is None:
+            row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+
+            @jax.jit
+            def run(state, sks, svs, crs, repl):
+                def body(s, xs):
+                    sk, sv, cr = xs
+                    return self._round(
+                        s, sk, sv, cr, repl, row_ids=row_ids,
+                        widen=lambda x: x, reduce_sum=lambda x: x,
+                        reduce_max=lambda x: x), None
+                out, _ = lax.scan(body, state, (sks, svs, crs))
+                return out
+            self._run_rounds = run
+        return self._run_rounds(
+            state, jnp.asarray(send_key, jnp.int32),
+            jnp.asarray(send_val, jnp.int32),
+            jnp.asarray(commit_req, jnp.int32), jnp.asarray(repl_ok))
 
     def step(self, state: KafkaState,
              send_key: np.ndarray | None = None,

@@ -208,3 +208,28 @@ def test_echo_identity_and_ledger():
         assert (out[valid] == payload[valid]).all()
         assert (out[~valid] == -1).all()
         assert int(st.msgs) == 2 * int(valid.sum())
+
+
+def test_kafka_run_rounds_matches_stepwise():
+    n, k, cap, s, r = 4, 5, 64, 2, 6
+    rng = np.random.default_rng(2)
+    sks = rng.integers(-1, k, (r, n, s)).astype(np.int32)
+    svs = rng.integers(0, 1000, (r, n, s)).astype(np.int32)
+    crs = np.full((r, n, k), -1, np.int32)
+    crs[3, 1, 2] = 2
+
+    ref = KafkaSim(n, k, capacity=cap, max_sends=s)
+    s1 = ref.init_state()
+    for i in range(r):
+        s1 = ref.step(s1, sks[i], svs[i], crs[i])
+    jax.block_until_ready(s1)
+
+    fused = KafkaSim(n, k, capacity=cap, max_sends=s)
+    s2 = fused.run_rounds(fused.init_state(), sks, svs, crs)
+    jax.block_until_ready(s2)
+
+    for f in ("log_vals", "present", "next_slot", "committed",
+              "local_committed"):
+        assert (np.asarray(getattr(s1, f))
+                == np.asarray(getattr(s2, f))).all(), f
+    assert int(s1.msgs) == int(s2.msgs)
