@@ -1,0 +1,187 @@
+"""Linearizability checker for register histories (Wing & Gong style).
+
+Maelstrom certifies its ``lin-kv`` with Jepsen's knossos checker; this is
+the in-repo equivalent (survey §4 "checkers").  It decides whether a
+concurrent history of register operations — ``read`` / ``write`` /
+``cas`` with invocation/completion windows — is linearizable: does some
+total order exist that (a) respects real-time order (an op that
+completed before another was invoked must come first) and (b) is legal
+for a register?
+
+Algorithm: depth-first search over "minimal" candidate ops (those whose
+invocation precedes every undecided op's completion), with memoization
+on (decided-set, register value) — Wing & Gong's algorithm with the
+Lowe-style cache.  Exponential worst case, fine for the harness-scale
+histories (tens of concurrent ops) this certifies.
+
+Op record: ``(invoke, complete, op, args, result)`` where
+
+- ``read``:  args ``()``,        result the observed value (or
+  ``KEY_MISSING``)
+- ``write``: args ``(v,)``,      result ``"ok"``
+- ``cas``:   args ``(frm, to)``, result ``"ok"`` | ``"fail"`` |
+  ``"missing"``
+
+Indeterminate ops (request sent, reply never observed — timeouts,
+dropped replies) are recorded with ``complete=inf`` and ``maybe=True``:
+the checker considers both the "it took effect at some point" and the
+"it never happened" branch, per the Jepsen convention.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+KEY_MISSING = "__missing__"
+
+
+class Op(NamedTuple):
+    invoke: float
+    complete: float
+    op: str               # "read" | "write" | "cas"
+    args: tuple
+    result: Any
+    maybe: bool = False   # indeterminate: may or may not have happened
+
+
+def _apply(value: Any, op: Op) -> tuple[bool, Any]:
+    """(legal?, new register value) for running ``op`` when the register
+    holds ``value``."""
+    if op.op == "read":
+        return op.result == value, value
+    if op.op == "write":
+        return True, op.args[0]
+    if op.op == "cas":
+        frm, to = op.args
+        if value == KEY_MISSING:
+            return op.result == "missing", value
+        if value == frm:
+            return op.result == "ok", to
+        return op.result == "fail", value
+    raise ValueError(f"unknown op {op.op!r}")
+
+
+def check_linearizable(history: list[Op],
+                       initial: Any = KEY_MISSING) -> tuple[bool, dict]:
+    """Returns (ok, details).  details["order"] holds a witness
+    linearization (indices into ``history``) when ok."""
+    n = len(history)
+    if n == 0:
+        return True, {"order": []}
+    full = (1 << n) - 1
+    seen: set[tuple[int, Any]] = set()
+
+    def candidates(mask: int) -> list[int]:
+        # minimal ops: not real-time-preceded by any undecided op.
+        # Wing & Gong precedence is strict (j precedes i iff
+        # j.complete < i.invoke); equal timestamps are concurrent.
+        pending = [i for i in range(n) if not mask >> i & 1]
+        out = []
+        for i in pending:
+            if all(i == j or history[j].complete >= history[i].invoke
+                   for j in pending):
+                out.append(i)
+        return out
+
+    order: list[int] = []
+
+    def dfs(mask: int, value: Any) -> bool:
+        if mask == full:
+            return True
+        key = (mask, value)
+        if key in seen:
+            return False
+        for i in candidates(mask):
+            op = history[i]
+            if op.maybe:
+                # indeterminate: either it took effect here...
+                if op.op == "write":
+                    branches = [op.args[0]]
+                elif op.op == "cas" and value == op.args[0]:
+                    branches = [op.args[1]]
+                else:
+                    branches = []
+                # ...or it never happened (place it as a no-op)
+                branches.append(value)
+                for new_value in branches:
+                    order.append(i)
+                    if dfs(mask | 1 << i, new_value):
+                        return True
+                    order.pop()
+                continue
+            legal, new_value = _apply(value, op)
+            if not legal:
+                continue
+            order.append(i)
+            if dfs(mask | 1 << i, new_value):
+                return True
+            order.pop()
+        seen.add(key)
+        return False
+
+    ok = dfs(0, initial)
+    return ok, {"order": list(order) if ok else None, "n_ops": n,
+                "states_explored": len(seen)}
+
+
+def history_from_kv_trace(trace, service_id: str = "seq-kv",
+                          key: str | None = None) -> list[Op]:
+    """Build a checkable history for one key from a virtual-network
+    message trace (harness/tracing.py): pairs each KV request with its
+    reply by msg_id, windows = [request routed, reply routed]."""
+    pending: dict[tuple[str, int], tuple[float, dict]] = {}
+    ops: list[Op] = []
+    for t, msg in trace:
+        body = msg.body
+        if msg.dest == service_id and body.get("msg_id") is not None:
+            if key is None or str(body.get("key")) == key:
+                pending[(msg.src, body["msg_id"])] = (t, body)
+        elif msg.src == service_id and body.get("in_reply_to") is not None:
+            slot = pending.pop((msg.dest, body["in_reply_to"]), None)
+            if slot is None:
+                continue
+            t0, req = slot
+            kind = req["type"]
+            if kind == "read":
+                if body.get("type") == "error":
+                    ops.append(Op(t0, t, "read", (), KEY_MISSING))
+                else:
+                    ops.append(Op(t0, t, "read", (), body.get("value")))
+            elif kind == "write":
+                ops.append(Op(t0, t, "write", (req.get("value"),), "ok"))
+            elif kind == "cas":
+                if body.get("type") == "cas_ok":
+                    res = "ok"
+                elif body.get("code") == 20:
+                    res = "missing"
+                else:
+                    res = "fail"
+                frm, to = req.get("from"), req.get("to")
+                if req.get("create_if_not_exists") and res == "ok":
+                    # a successful create-CAS is legal both from MISSING
+                    # (creates the key) and from frm (swaps); both end at
+                    # `to`.  Model as write(to): a superset, so the
+                    # checker stays sound against impossible reads while
+                    # being permissive on the frm precondition.
+                    ops.append(Op(t0, t, "write", (to,), "ok"))
+                else:
+                    ops.append(Op(t0, t, "cas", (frm, to), res))
+    # requests whose reply was never observed (drops/timeouts) are
+    # indeterminate: they may have taken effect — record them as
+    # maybe-ops so the checker considers both branches.  Unanswered
+    # reads constrain nothing and are omitted.
+    inf = float("inf")
+    for (_, _), (t0, req) in pending.items():
+        kind = req["type"]
+        if kind == "write":
+            ops.append(Op(t0, inf, "write", (req.get("value"),), None,
+                          maybe=True))
+        elif kind == "cas":
+            if req.get("create_if_not_exists"):
+                ops.append(Op(t0, inf, "write", (req.get("to"),), None,
+                              maybe=True))
+            else:
+                ops.append(Op(t0, inf, "cas",
+                              (req.get("from"), req.get("to")), None,
+                              maybe=True))
+    return ops

@@ -110,6 +110,26 @@ def run_unique_ids(n_nodes: int = 3, n_ops: int = 200,
 # -- broadcast ----------------------------------------------------------
 
 
+def _topology_map(topology: str, n_nodes: int) -> dict[str, list[str]]:
+    adj = (tree_topology(n_nodes) if topology == "tree"
+           else grid_topology(n_nodes))
+    return to_name_map(adj)
+
+
+def _final_reads(net: VirtualNetwork, n_nodes: int,
+                 latency: float) -> dict[str, list[int]]:
+    """Fan a final ``read`` to every node from a fresh client and
+    collect the replies."""
+    reader = net.client("c2")
+    reads: dict[str, list[int]] = {}
+    for i in range(n_nodes):
+        reader.rpc(f"n{i}", {"type": "read"},
+                   lambda rep, i=i: reads.setdefault(
+                       f"n{i}", list(rep.body.get("messages", []))))
+    net.run_for(2.0 * (latency + 0.1))
+    return reads
+
+
 def run_broadcast(n_nodes: int = 25, topology: str = "tree",
                   n_values: int = 40, rate: float = 10.0,
                   quiescence: float = 12.0, latency: float = 0.0,
@@ -121,9 +141,7 @@ def run_broadcast(n_nodes: int = 25, topology: str = "tree",
     cfg = NetConfig(latency=latency, seed=seed)
     net = _make_net(n_nodes, BroadcastProgram, net_cfg=cfg,
                     partitions=partitions)
-    adj = (tree_topology(n_nodes) if topology == "tree"
-           else grid_topology(n_nodes))
-    net.set_topology(to_name_map(adj))
+    net.set_topology(_topology_map(topology, n_nodes))
 
     client = net.client("c1")
     acked: list[int] = []
@@ -144,13 +162,7 @@ def run_broadcast(n_nodes: int = 25, topology: str = "tree",
     net.run_for(quiescence)
     server_msgs = net.ledger.server_to_server
 
-    reader = net.client("c2")
-    final_reads: dict[str, list[int]] = {}
-    for i in range(n_nodes):
-        reader.rpc(f"n{i}", {"type": "read"},
-                   lambda rep, i=i: final_reads.setdefault(
-                       f"n{i}", list(rep.body.get("messages", []))))
-    net.run_for(2.0 * (latency + 0.1))
+    final_reads = _final_reads(net, n_nodes, latency)
 
     ok, details = checkers.check_broadcast_convergence(
         final_reads, set(acked))
@@ -163,6 +175,64 @@ def run_broadcast(n_nodes: int = 25, topology: str = "tree",
     stats["broadcast_latency_mean"] = (sum(op_latencies) / len(op_latencies)
                                        if op_latencies else 0.0)
     return WorkloadResult(ok, details, stats)
+
+
+def run_broadcast_mix(n_nodes: int = 25, topology: str = "tree",
+                      rate: float = 100.0, duration: float = 20.0,
+                      read_share: float = 0.5, latency: float = 0.0,
+                      quiescence: float = 8.0, seed: int = 0,
+                      ) -> WorkloadResult:
+    """Maelstrom-style mixed workload: ``rate`` ops/s split between
+    ``broadcast`` and ``read`` for ``duration`` seconds — the op mix the
+    reference's "<20 msgs/op" README claim is measured against
+    (README.md:17; Maelstrom divides server messages by ALL client ops,
+    reads included)."""
+    cfg = NetConfig(latency=latency, seed=seed)
+    net = _make_net(n_nodes, BroadcastProgram, net_cfg=cfg)
+    net.set_topology(_topology_map(topology, n_nodes))
+
+    client = net.client("c1")
+    rng = net.rng
+    acked: list[int] = []
+    n_ops = [0]
+    next_value = [0]
+    n_total = int(rate * duration)
+
+    def on_read_ok(rep: Message) -> None:
+        if rep.type == "read_ok":
+            n_ops[0] += 1
+
+    for _ in range(n_total):
+        nid = f"n{rng.randrange(n_nodes)}"
+        if rng.random() < read_share:
+            client.rpc(nid, {"type": "read"}, on_read_ok)
+        else:
+            v = next_value[0]
+            next_value[0] += 1
+
+            def on_ack(rep: Message, v=v) -> None:
+                if rep.type == "broadcast_ok":
+                    acked.append(v)
+                    n_ops[0] += 1
+
+            client.rpc(nid, {"type": "broadcast", "message": v}, on_ack)
+        net.run_for(1.0 / rate)
+
+    net.run_for(quiescence)
+    # Maelstrom accounting: whole-run server messages (quiescence-period
+    # anti-entropy included) over all completed client ops
+    server_msgs = net.ledger.server_to_server
+
+    final_reads = _final_reads(net, n_nodes, latency)
+
+    ok, details = checkers.check_broadcast_convergence(
+        final_reads, set(acked))
+    stats = _stats(net, n_ops[0])
+    stats["msgs_per_op"] = server_msgs / max(n_ops[0], 1)
+    details["n_broadcasts"] = len(acked)
+    details["n_ops"] = n_ops[0]
+    return WorkloadResult(ok and len(final_reads) == n_nodes, details,
+                          stats)
 
 
 # -- counter ------------------------------------------------------------

@@ -176,18 +176,20 @@ class KafkaSim:
         return KafkaState(log_vals, present, next_slot, committed,
                           local_committed, state.t + 1, msgs)
 
+    def _round_1dev(self, state, send_key, send_val, commit_req,
+                    repl_ok):
+        """Single-device round wiring (identity collectives) — shared by
+        the stepwise and the scanned (run_rounds) drivers."""
+        row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+        return self._round(state, send_key, send_val, commit_req,
+                           repl_ok, row_ids=row_ids,
+                           widen=lambda x: x,
+                           reduce_sum=lambda x: x,
+                           reduce_max=lambda x: x)
+
     def _build_step(self):
         if self.mesh is None:
-            row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
-
-            @jax.jit
-            def step(state, send_key, send_val, commit_req, repl_ok):
-                return self._round(state, send_key, send_val, commit_req,
-                                   repl_ok, row_ids=row_ids,
-                                   widen=lambda x: x,
-                                   reduce_sum=lambda x: x,
-                                   reduce_max=lambda x: x)
-            return step
+            return jax.jit(self._round_1dev)
 
         mesh = self.mesh
         node2 = P("nodes", None)
@@ -235,17 +237,12 @@ class KafkaSim:
                                  np.int32)
         if repl_ok is None:
             repl_ok = np.ones((self.n_nodes, self.n_nodes), bool)
-        if getattr(self, "_run_rounds", None) is None:
-            row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
-
+        if self._run_rounds is None:
             @jax.jit
             def run(state, sks, svs, crs, repl):
                 def body(s, xs):
                     sk, sv, cr = xs
-                    return self._round(
-                        s, sk, sv, cr, repl, row_ids=row_ids,
-                        widen=lambda x: x, reduce_sum=lambda x: x,
-                        reduce_max=lambda x: x), None
+                    return self._round_1dev(s, sk, sv, cr, repl), None
                 out, _ = lax.scan(body, state, (sks, svs, crs))
                 return out
             self._run_rounds = run

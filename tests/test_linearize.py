@@ -1,0 +1,155 @@
+"""Linearizability checker: synthetic histories + a live KV trace."""
+
+from gossip_glomers_tpu.harness import tracing
+from gossip_glomers_tpu.harness.linearize import (KEY_MISSING, Op,
+                                                  check_linearizable,
+                                                  history_from_kv_trace)
+
+
+def test_sequential_history_ok():
+    h = [Op(0, 1, "write", (5,), "ok"),
+         Op(2, 3, "read", (), 5),
+         Op(4, 5, "cas", (5, 7), "ok"),
+         Op(6, 7, "read", (), 7)]
+    ok, details = check_linearizable(h)
+    assert ok
+    assert details["order"] == [0, 1, 2, 3]
+
+
+def test_concurrent_reordering_ok():
+    # read of 2 overlaps both writes: legal by ordering write(2) first
+    h = [Op(0, 10, "write", (1,), "ok"),
+         Op(0, 10, "write", (2,), "ok"),
+         Op(0, 10, "read", (), 2),
+         Op(11, 12, "read", (), 1)]
+    ok, details = check_linearizable(h)
+    assert ok
+
+
+def test_stale_read_not_linearizable():
+    # write(1) completed before read invoked, but read sees the initial
+    # missing marker — no legal order
+    h = [Op(0, 1, "write", (1,), "ok"),
+         Op(2, 3, "read", (), KEY_MISSING)]
+    ok, _ = check_linearizable(h)
+    assert not ok
+
+
+def test_cas_semantics_enforced():
+    # two CAS from the same value cannot both succeed
+    h = [Op(0, 1, "write", (1,), "ok"),
+         Op(2, 10, "cas", (1, 2), "ok"),
+         Op(2, 10, "cas", (1, 3), "ok")]
+    ok, _ = check_linearizable(h)
+    assert not ok
+    # ...but one succeeding and one failing is fine either way
+    h2 = [Op(0, 1, "write", (1,), "ok"),
+          Op(2, 10, "cas", (1, 2), "ok"),
+          Op(2, 10, "cas", (1, 3), "fail")]
+    ok2, _ = check_linearizable(h2)
+    assert ok2
+
+
+def test_real_time_order_respected():
+    # value must go 1 -> 2; a later read of 1 after reading 2 is illegal
+    h = [Op(0, 1, "write", (1,), "ok"),
+         Op(2, 3, "write", (2,), "ok"),
+         Op(4, 5, "read", (), 2),
+         Op(6, 7, "read", (), 1)]
+    ok, _ = check_linearizable(h)
+    assert not ok
+
+
+def test_missing_then_create_cas():
+    h = [Op(0, 1, "cas", (0, 0), "missing"),
+         Op(2, 3, "write", (0,), "ok"),   # the create-CAS, as modeled
+         Op(4, 5, "cas", (0, 4), "ok"),
+         Op(6, 7, "read", (), 4)]
+    ok, _ = check_linearizable(h)
+    assert ok
+
+
+def test_counter_kv_trace_is_linearizable():
+    # live history: the counter workload's seq-kv traffic under latency,
+    # extracted from a traced virtual-network run
+    from gossip_glomers_tpu.harness.network import VirtualNetwork
+    from gossip_glomers_tpu.harness.services import KVService
+    from gossip_glomers_tpu.models import CounterProgram
+    from gossip_glomers_tpu.utils.config import NetConfig
+
+    net = VirtualNetwork(NetConfig(latency=0.02, seed=1))
+    for i in range(3):
+        net.spawn(f"n{i}", CounterProgram())
+    net.add_service(KVService(net, "seq-kv"))
+    trace = tracing.enable_trace(net)
+    net.init_cluster()
+    client = net.client("c1")
+    for i in range(12):
+        client.rpc(f"n{i % 3}", {"type": "add", "delta": i + 1})
+        net.run_for(0.1)
+    net.run_for(5.0)
+
+    history = history_from_kv_trace(trace, "seq-kv", key="value")
+    assert len(history) >= 6, "expected real KV traffic"
+    ok, details = check_linearizable(history)
+    assert ok, details
+
+
+def test_indeterminate_write_both_branches():
+    inf = float("inf")
+    # dropped-reply write: legal if it DID happen (read sees 9)...
+    h = [Op(0, inf, "write", (9,), None, maybe=True),
+         Op(1, 2, "read", (), 9)]
+    ok, _ = check_linearizable(h)
+    assert ok
+    # ...and legal if it did NOT happen (read sees missing)
+    h2 = [Op(0, inf, "write", (9,), None, maybe=True),
+          Op(1, 2, "read", (), KEY_MISSING)]
+    ok2, _ = check_linearizable(h2)
+    assert ok2
+    # but a read of a value nobody could have written still fails
+    h3 = [Op(0, inf, "write", (9,), None, maybe=True),
+          Op(1, 2, "read", (), 7)]
+    ok3, _ = check_linearizable(h3)
+    assert not ok3
+
+
+def test_zero_width_concurrent_windows():
+    # identical zero-width windows are concurrent, not mutually
+    # preceding — both orders must be considered
+    h = [Op(1.0, 1.0, "write", (1,), "ok"),
+         Op(1.0, 1.0, "write", (2,), "ok"),
+         Op(2.0, 3.0, "read", (), 1)]
+    ok, _ = check_linearizable(h)
+    assert ok
+
+
+def test_dropped_kv_reply_history_still_checkable():
+    # partition drops seq-kv replies mid-run: unacked CAS/writes become
+    # maybe-ops and the history must still check out
+    from gossip_glomers_tpu.harness.faults import (PartitionSchedule,
+                                                   PartitionWindow)
+    from gossip_glomers_tpu.harness.network import VirtualNetwork
+    from gossip_glomers_tpu.harness.services import KVService
+    from gossip_glomers_tpu.models import CounterProgram
+    from gossip_glomers_tpu.utils.config import NetConfig
+
+    net = VirtualNetwork(NetConfig(latency=0.02, seed=5))
+    for i in range(3):
+        net.spawn(f"n{i}", CounterProgram())
+    net.add_service(KVService(net, "seq-kv"))
+    parts = PartitionSchedule([PartitionWindow(
+        0.4, 1.2, [["n0", "n1"], ["n2", "seq-kv"]])])
+    net.drop_fn = parts.drop_fn()
+    trace = tracing.enable_trace(net)
+    net.init_cluster()
+    client = net.client("c1")
+    for i in range(10):
+        client.rpc(f"n{i % 3}", {"type": "add", "delta": 1})
+        net.run_for(0.2)
+    net.run_for(4.0)
+
+    history = history_from_kv_trace(trace, "seq-kv", key="value")
+    assert len(history) >= 6
+    ok, details = check_linearizable(history)
+    assert ok, details
