@@ -85,6 +85,10 @@ class BroadcastState(NamedTuple):
     frontier: jnp.ndarray    # (N, W) uint32
     t: jnp.ndarray           # () int32 — round counter
     msgs: jnp.ndarray        # () uint32 — value-messages sent (wraps @2^32)
+    # latency mode only: (L, N, W) ring of past full-axis payloads, so a
+    # delay-d edge delivers the payload flooded d rounds ago (Maelstrom's
+    # variable per-edge latency as data).  None when all edges are 1 hop.
+    history: jnp.ndarray | None = None
 
 
 def _popcount(x: jnp.ndarray) -> jnp.ndarray:
@@ -137,14 +141,40 @@ def _gather_or(payload: jnp.ndarray, nbrs: jnp.ndarray,
                          term(0))
 
 
+def _gather_or_delayed(history: jnp.ndarray, t: jnp.ndarray,
+                       delays: jnp.ndarray, nbrs: jnp.ndarray,
+                       live_at_send: jnp.ndarray) -> jnp.ndarray:
+    """Latency-queue delivery: edge (i, d) with delay ``delays[i, d]``
+    delivers the payload flooded at round t - (delay-1) — read from the
+    ring buffer of past full-axis payloads.  ``live_at_send`` must be
+    evaluated at each edge's send round (drops happen at send time, like
+    Maelstrom's)."""
+    ring = history.shape[0]
+
+    def term(d):
+        idx = lax.dynamic_index_in_dim(nbrs, d, axis=1, keepdims=False)
+        dly = lax.dynamic_index_in_dim(delays, d, axis=1, keepdims=False)
+        ok = lax.dynamic_index_in_dim(live_at_send, d, axis=1,
+                                      keepdims=False)
+        src_t = t - (dly - 1)
+        ok = ok & (src_t >= 0)
+        rows = history[src_t % ring,
+                       jnp.clip(idx, 0, history.shape[1] - 1)]
+        return jnp.where(ok[:, None], rows, jnp.uint32(0))
+
+    return lax.fori_loop(1, nbrs.shape[1], lambda d, acc: acc | term(d),
+                         term(0))
+
+
 def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
            nbrs: jnp.ndarray, nbr_mask: jnp.ndarray, parts: Partitions,
            sync_every: int,
            widen: Callable[[jnp.ndarray], jnp.ndarray] = lambda p: p,
            reduce_sum: Callable[[jnp.ndarray], jnp.ndarray] = lambda s: s,
+           delays: jnp.ndarray | None = None,
            ) -> BroadcastState:
-    """One simulation round == one network hop — the single source of the
-    node-major (adjacency-gather) round semantics, shared by the
+    """One simulation round == one base network hop — the single source
+    of the node-major (adjacency-gather) round semantics, shared by the
     single-device and sharded paths.  (Structured topologies use the
     words-major :func:`_round_wm` instead.)
 
@@ -153,33 +183,48 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
     ``widen`` maps the local payload block to the full node axis (identity
     single-device; ``all_gather`` along 'nodes' under shard_map) and
     ``reduce_sum`` globalizes the message count (identity / ``psum``).
+    With ``delays`` ((N, D) rounds >= 1, static per edge), delivery reads
+    the payload-history ring instead of the current payload.
     """
     is_sync = (state.t % jnp.int32(sync_every) == 0) & (state.t > 0)
     # frontier ⊆ received, so the anti-entropy payload is just `received`.
     payload = jnp.where(is_sync, state.received, state.frontier)
     payload_full = widen(payload)
-    live = _edge_live(state.t, row_ids, nbrs, nbr_mask, parts)
+    live_now = _edge_live(state.t, row_ids, nbrs, nbr_mask, parts)
     # ledger: the reference sends one message per (value, edge) —
-    # broadcast.go:50-57 fans each value out separately.
+    # broadcast.go:50-57 fans each value out separately.  Counted at
+    # send time regardless of delivery delay.
     sent = reduce_sum(jnp.sum(
         _popcount(payload).sum(axis=1).astype(jnp.uint32)
-        * live.sum(axis=1).astype(jnp.uint32), dtype=jnp.uint32))
-    inbox = _gather_or(payload_full, nbrs, live)
+        * live_now.sum(axis=1).astype(jnp.uint32), dtype=jnp.uint32))
+    if delays is None:
+        inbox = _gather_or(payload_full, nbrs, live_now)
+        history = state.history
+    else:
+        ring = state.history.shape[0]
+        history = lax.dynamic_update_index_in_dim(
+            state.history, payload_full, state.t % ring, axis=0)
+        t_send = state.t - (delays - 1)
+        live_send = _edge_live(t_send, row_ids, nbrs, nbr_mask, parts)
+        inbox = _gather_or_delayed(history, state.t, delays, nbrs,
+                                   live_send)
     new = inbox & ~state.received
     return BroadcastState(received=state.received | new,
                           frontier=new,
                           t=state.t + 1,
-                          msgs=state.msgs + sent)
+                          msgs=state.msgs + sent,
+                          history=history)
 
 
 def flood_step(state: BroadcastState, *, nbrs: jnp.ndarray,
                nbr_mask: jnp.ndarray, parts: Partitions,
-               sync_every: int) -> BroadcastState:
+               sync_every: int,
+               delays: jnp.ndarray | None = None) -> BroadcastState:
     """Single-device node-major round (the ``entry()`` compile-check
     target)."""
     row_ids = jnp.arange(nbrs.shape[0], dtype=jnp.int32)
     return _round(state, row_ids=row_ids, nbrs=nbrs, nbr_mask=nbr_mask,
-                  parts=parts, sync_every=sync_every)
+                  parts=parts, sync_every=sync_every, delays=delays)
 
 
 def _round_wm(state: BroadcastState, *, deg: jnp.ndarray, sync_every: int,
@@ -230,6 +275,7 @@ class BroadcastSim:
                  exchange: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
                  sharded_exchange: Callable[[jnp.ndarray], jnp.ndarray]
                  | None = None,
+                 delays: np.ndarray | None = None,
                  ) -> None:
         n = nbrs.shape[0]
         self.n_nodes = n
@@ -250,6 +296,16 @@ class BroadcastSim:
             raise ValueError(
                 "structured exchange cannot apply per-edge partition "
                 "masks; use the adjacency-gather path for faulted runs")
+        if delays is not None:
+            if exchange is not None:
+                raise ValueError("per-edge delays need the gather path")
+            if delays.shape != nbrs.shape:
+                raise ValueError("delays must match nbrs shape")
+            if delays.min() < 1:
+                raise ValueError("edge delays are rounds >= 1")
+        self.delays = (None if delays is None
+                       else jnp.asarray(delays, jnp.int32))
+        self.ring = 1 if delays is None else int(delays.max())
         self._fused = None
         self._fused_max_rounds = None
 
@@ -278,6 +334,8 @@ class BroadcastSim:
             self.nbr_mask = jax.device_put(jnp.asarray(nbr_mask), node_sh)
             self.deg = jax.device_put(jnp.asarray(deg),
                                       NamedSharding(mesh, P("nodes")))
+            if self.delays is not None:
+                self.delays = jax.device_put(self.delays, node_sh)
         else:
             self.nbrs = jnp.asarray(nbrs, jnp.int32)
             self.nbr_mask = jnp.asarray(nbr_mask)
@@ -294,8 +352,19 @@ class BroadcastSim:
         if self.mesh is not None:
             received = jax.device_put(
                 received, NamedSharding(self.mesh, self._state_spec))
+        history = None
+        if self.delays is not None:
+            # full-axis ring so any edge can read any past payload;
+            # replicated across shards (latency mode targets the small
+            # fault-fidelity configs, not the million-node path)
+            history = jnp.zeros(
+                (self.ring, self.n_nodes, self.n_words), jnp.uint32)
+            if self.mesh is not None:
+                history = jax.device_put(
+                    history, NamedSharding(self.mesh, P(None, None, None)))
         return BroadcastState(received=received, frontier=received,
-                              t=jnp.int32(0), msgs=jnp.uint32(0))
+                              t=jnp.int32(0), msgs=jnp.uint32(0),
+                              history=history)
 
     def target_bits(self, inject: np.ndarray) -> jnp.ndarray:
         """(W,) uint32 — union of all injected values: the convergence
@@ -306,7 +375,8 @@ class BroadcastSim:
     # -- round/step builders ----------------------------------------------
 
     def _sharded_round(self, state: BroadcastState, nbrs, nbr_mask,
-                       parts: Partitions) -> BroadcastState:
+                       parts: Partitions,
+                       delays=None) -> BroadcastState:
         """The node-major round inside shard_map: global row ids from the
         shard index, payload all_gather-ed along 'nodes' (the gossip
         collective riding ICI), ledger psum-ed."""
@@ -318,7 +388,8 @@ class BroadcastSim:
             state, row_ids=row_ids, nbrs=nbrs, nbr_mask=nbr_mask,
             parts=parts, sync_every=self.sync_every,
             widen=lambda p: lax.all_gather(p, "nodes", axis=0, tiled=True),
-            reduce_sum=lambda s: lax.psum(s, mesh_axes))
+            reduce_sum=lambda s: lax.psum(s, mesh_axes),
+            delays=delays)
 
     def _sharded_round_wm(self, state: BroadcastState,
                           deg) -> BroadcastState:
@@ -353,7 +424,10 @@ class BroadcastSim:
 
     def _specs(self):
         state_spec = self._state_spec
-        return (BroadcastState(state_spec, state_spec, P(), P()),
+        hist_spec = (None if self.delays is None
+                     else P(None, None, None))   # replicated ring
+        return (BroadcastState(state_spec, state_spec, P(), P(),
+                               hist_spec),
                 P("nodes", None), Partitions(P(), P(), P(None, None)))
 
     def _build_step(self):
@@ -372,7 +446,8 @@ class BroadcastSim:
             @jax.jit
             def step(state: BroadcastState, nbrs, nbr_mask) -> BroadcastState:
                 return flood_step(state, nbrs=nbrs, nbr_mask=nbr_mask,
-                                  parts=parts, sync_every=sync_every)
+                                  parts=parts, sync_every=sync_every,
+                                  delays=self.delays)
             return step
 
         state_spec, node_spec, part_spec = self._specs()
@@ -388,6 +463,25 @@ class BroadcastSim:
                 return self._sharded_round_wm(state, deg)
 
             return lambda state, nbrs, nbr_mask: step_wm(state, self.deg)
+
+        if self.delays is not None:
+            # the history ring is replicated while payloads are gathered
+            # from varying blocks — provably identical on every shard,
+            # but beyond the static replication checker (see kafka.py)
+            @jax.jit
+            @functools.partial(
+                jax.shard_map, mesh=self.mesh,
+                in_specs=(state_spec, node_spec, node_spec, part_spec,
+                          node_spec),
+                out_specs=state_spec, check_vma=False,
+            )
+            def step_d(state: BroadcastState, nbrs, nbr_mask,
+                       parts: Partitions, delays) -> BroadcastState:
+                return self._sharded_round(state, nbrs, nbr_mask, parts,
+                                           delays)
+
+            return lambda state, nbrs, nbr_mask: step_d(
+                state, nbrs, nbr_mask, self.parts, self.delays)
 
         @jax.jit
         @functools.partial(
@@ -432,7 +526,8 @@ class BroadcastSim:
                                          sync_every=sync_every,
                                          exchange=self.exchange)
                     return flood_step(s, nbrs=nbrs, nbr_mask=nbr_mask,
-                                      parts=parts, sync_every=sync_every)
+                                      parts=parts, sync_every=sync_every,
+                                      delays=self.delays)
 
                 return lax.while_loop(cond, body, state)
             return run
@@ -476,6 +571,24 @@ class BroadcastSim:
 
             return lambda state, nbrs, nbr_mask, target: run_wm(
                 state, self.deg, target)
+
+        if self.delays is not None:
+            @jax.jit
+            @functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(state_spec, node_spec, node_spec, target_spec,
+                          part_spec, node_spec),
+                out_specs=state_spec, check_vma=False,
+            )
+            def run_d(state: BroadcastState, nbrs, nbr_mask, target,
+                      parts: Partitions, delays) -> BroadcastState:
+                return while_converge(
+                    state, target,
+                    lambda s: self._sharded_round(s, nbrs, nbr_mask,
+                                                  parts, delays))
+
+            return lambda state, nbrs, nbr_mask, target: run_d(
+                state, nbrs, nbr_mask, target, self.parts, self.delays)
 
         @jax.jit
         @functools.partial(

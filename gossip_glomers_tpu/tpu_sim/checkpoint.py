@@ -28,9 +28,12 @@ def save(path: str, state: Any, meta: dict | None = None) -> None:
     fields = getattr(state, "_fields", None)
     if fields is None:
         raise TypeError("state must be a NamedTuple of arrays")
-    payload = {f: np.asarray(getattr(state, f)) for f in fields}
+    present = [f for f in fields if getattr(state, f) is not None]
+    payload = {f: np.asarray(getattr(state, f)) for f in present}
     payload["__meta__"] = np.frombuffer(
-        json.dumps({"fields": list(fields),
+        json.dumps({"fields": present,
+                    "none_fields": [f for f in fields
+                                    if f not in present],
                     "class": type(state).__name__,
                     **(meta or {})}).encode(), dtype=np.uint8)
     np.savez_compressed(path, **payload)
@@ -50,13 +53,13 @@ def restore(path: str, state_cls: type, *,
             raise ValueError(
                 f"checkpoint holds {meta['class']}, not "
                 f"{state_cls.__name__}")
-        vals = []
+        vals = {}
         for f in meta["fields"]:
             arr = z[f]
-            if device_put is not None:
-                vals.append(device_put(f, arr))
-            else:
-                vals.append(jnp.asarray(arr))
+            vals[f] = (device_put(f, arr) if device_put is not None
+                       else jnp.asarray(arr))
+        for f in meta.get("none_fields", []):
+            vals[f] = None
     extra = {k: v for k, v in meta.items()
-             if k not in ("fields", "class")}
-    return state_cls(*vals), extra
+             if k not in ("fields", "none_fields", "class")}
+    return state_cls(**vals), extra

@@ -290,3 +290,86 @@ def test_sharded_exchange_requires_exchange():
         BroadcastSim(to_padded_neighbors(tree(16)), n_values=4,
                      sharded_exchange=make_sharded_exchange(
                          "ring", 16, 8))
+
+
+# -- per-edge latency queues --------------------------------------------
+
+
+def test_delay_one_equals_plain_path():
+    n, nv = 25, 32
+    nbrs = to_padded_neighbors(grid(n))
+    inject = make_inject(n, nv)
+    ref = BroadcastSim(nbrs, n_values=nv)
+    s1, r1 = ref.run(inject)
+    d1 = BroadcastSim(nbrs, n_values=nv,
+                      delays=np.ones(nbrs.shape, np.int32))
+    s2, r2 = d1.run(inject)
+    assert r1 == r2
+    assert (np.asarray(s1.received) == np.asarray(s2.received)).all()
+    assert int(s1.msgs) == int(s2.msgs)
+
+
+def test_uniform_delay_scales_eccentricity():
+    # line with delay 3 on every edge: end-to-end takes 3*(n-1) rounds
+    n = 6
+    nbrs = to_padded_neighbors(line(n))
+    sim = BroadcastSim(nbrs, n_values=1, sync_every=1 << 20,
+                       delays=np.full(nbrs.shape, 3, np.int32))
+    state, rounds = sim.run(make_inject(n, 1, origins=np.array([0])))
+    assert rounds == 3 * (n - 1)
+    assert all(sorted(r) == [0] for r in sim.read(state))
+
+
+def test_delays_with_partitions_heal():
+    # drops are decided at SEND time (like Maelstrom); anti-entropy
+    # repairs after the window lifts
+    n = 6
+    nbrs = to_padded_neighbors(line(n))
+    group = np.zeros((1, n), np.int8)
+    group[0, :3] = 1
+    parts = Partitions(jnp.array([0], jnp.int32),
+                       jnp.array([6], jnp.int32), jnp.asarray(group))
+    sim = BroadcastSim(nbrs, n_values=1, sync_every=4, parts=parts,
+                       delays=np.full(nbrs.shape, 2, np.int32))
+    state, rounds = sim.run(make_inject(n, 1, origins=np.array([0])))
+    assert rounds > 6
+    assert all(sorted(r) == [0] for r in sim.read(state))
+
+
+def test_delays_sharded_matches_single_device():
+    n, nv = 64, 48
+    nbrs = to_padded_neighbors(tree(n))
+    delays = np.random.default_rng(0).integers(
+        1, 4, nbrs.shape).astype(np.int32)
+    inject = make_inject(n, nv)
+    ref = BroadcastSim(nbrs, n_values=nv, delays=delays)
+    s1, r1 = ref.run(inject)
+    shd = BroadcastSim(nbrs, n_values=nv, delays=delays, mesh=mesh_1d())
+    s2, r2 = shd.run(inject)
+    assert r1 == r2
+    assert (np.asarray(s1.received) == np.asarray(s2.received)).all()
+    assert int(s1.msgs) == int(s2.msgs)
+    s3, r3 = shd.run_fused(inject)
+    assert r1 == r3
+
+
+def test_delays_checkpoint_roundtrip(tmp_path):
+    from gossip_glomers_tpu.tpu_sim import checkpoint
+    from gossip_glomers_tpu.tpu_sim.broadcast import BroadcastState
+
+    n = 16
+    nbrs = to_padded_neighbors(tree(n))
+    delays = np.full(nbrs.shape, 2, np.int32)
+    sim = BroadcastSim(nbrs, n_values=8, delays=delays)
+    st = sim.init_state(make_inject(n, 8))
+    for _ in range(3):
+        st = sim.step(st)
+    path = str(tmp_path / "d.npz")
+    checkpoint.save(path, st)
+    restored, _ = checkpoint.restore(path, BroadcastState)
+    assert (np.asarray(restored.history) == np.asarray(st.history)).all()
+    ref = st
+    for _ in range(3):
+        ref = sim.step(ref)
+        restored = sim.step(restored)
+    assert (np.asarray(restored.received) == np.asarray(ref.received)).all()
