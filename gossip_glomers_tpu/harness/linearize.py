@@ -21,6 +21,11 @@ Op record: ``(invoke, complete, op, args, result)`` where
 - ``write``: args ``(v,)``,      result ``"ok"``
 - ``cas``:   args ``(frm, to)``, result ``"ok"`` | ``"fail"`` |
   ``"missing"``
+- ``ccas``:  args ``(frm, to)``, result ``"ok"`` | ``"fail"`` —
+  CAS with ``create_if_not_exists``: succeeds from ``KEY_MISSING``
+  (creating the key at ``to``) or from ``frm`` (normal swap), fails
+  otherwise.  This models Maelstrom's create-CAS exactly instead of
+  the permissive ``write(to)`` over-approximation.
 
 Indeterminate ops (request sent, reply never observed — timeouts,
 dropped replies) are recorded with ``complete=inf`` and ``maybe=True``:
@@ -58,6 +63,11 @@ def _apply(value: Any, op: Op) -> tuple[bool, Any]:
         if value == frm:
             return op.result == "ok", to
         return op.result == "fail", value
+    if op.op == "ccas":
+        frm, to = op.args
+        if value == KEY_MISSING or value == frm:
+            return op.result == "ok", to
+        return op.result == "fail", value
     raise ValueError(f"unknown op {op.op!r}")
 
 
@@ -85,41 +95,53 @@ def check_linearizable(history: list[Op],
 
     order: list[int] = []
 
-    def dfs(mask: int, value: Any) -> bool:
-        if mask == full:
-            return True
-        key = (mask, value)
-        if key in seen:
-            return False
+    def moves(mask: int, value: Any):
+        """Yield (op index, resulting register value) for every legal way
+        to linearize one more op from state (mask, value)."""
         for i in candidates(mask):
             op = history[i]
             if op.maybe:
                 # indeterminate: either it took effect here...
                 if op.op == "write":
-                    branches = [op.args[0]]
+                    yield i, op.args[0]
                 elif op.op == "cas" and value == op.args[0]:
-                    branches = [op.args[1]]
-                else:
-                    branches = []
+                    yield i, op.args[1]
+                elif op.op == "ccas" and (value == op.args[0]
+                                          or value == KEY_MISSING):
+                    yield i, op.args[1]
                 # ...or it never happened (place it as a no-op)
-                branches.append(value)
-                for new_value in branches:
-                    order.append(i)
-                    if dfs(mask | 1 << i, new_value):
-                        return True
-                    order.pop()
+                yield i, value
                 continue
             legal, new_value = _apply(value, op)
-            if not legal:
-                continue
-            order.append(i)
-            if dfs(mask | 1 << i, new_value):
-                return True
-            order.pop()
-        seen.add(key)
-        return False
+            if legal:
+                yield i, new_value
 
-    ok = dfs(0, initial)
+    # Explicit-stack DFS (one frame per decided op, not one Python frame
+    # per op) so histories far beyond the recursion limit check cleanly.
+    # Frame: (mask, value, move iterator, did-a-move-create-this-frame).
+    ok = False
+    stack = [(0, initial, moves(0, initial), False)]
+    while stack:
+        mask, value, it, via_move = stack[-1]
+        nxt = next(it, None)
+        if nxt is None:
+            # exhausted: memoize the dead state, backtrack
+            seen.add((mask, value))
+            stack.pop()
+            if via_move:
+                order.pop()
+            continue
+        i, new_value = nxt
+        new_mask = mask | 1 << i
+        if (new_mask, new_value) in seen:
+            continue
+        order.append(i)
+        if new_mask == full:
+            ok = True
+            break
+        stack.append((new_mask, new_value, moves(new_mask, new_value),
+                      True))
+
     return ok, {"order": list(order) if ok else None, "n_ops": n,
                 "states_explored": len(seen)}
 
@@ -157,13 +179,12 @@ def history_from_kv_trace(trace, service_id: str = "seq-kv",
                 else:
                     res = "fail"
                 frm, to = req.get("from"), req.get("to")
-                if req.get("create_if_not_exists") and res == "ok":
-                    # a successful create-CAS is legal both from MISSING
-                    # (creates the key) and from frm (swaps); both end at
-                    # `to`.  Model as write(to): a superset, so the
-                    # checker stays sound against impossible reads while
-                    # being permissive on the frm precondition.
-                    ops.append(Op(t0, t, "write", (to,), "ok"))
+                if req.get("create_if_not_exists"):
+                    # create-CAS: legal from MISSING (creates at `to`) or
+                    # from frm (swaps) — modeled exactly as its own op so
+                    # a successful ccas with a mismatched frm on an
+                    # existing key is correctly rejected.
+                    ops.append(Op(t0, t, "ccas", (frm, to), res))
                 else:
                     ops.append(Op(t0, t, "cas", (frm, to), res))
     # requests whose reply was never observed (drops/timeouts) are
@@ -177,11 +198,8 @@ def history_from_kv_trace(trace, service_id: str = "seq-kv",
             ops.append(Op(t0, inf, "write", (req.get("value"),), None,
                           maybe=True))
         elif kind == "cas":
-            if req.get("create_if_not_exists"):
-                ops.append(Op(t0, inf, "write", (req.get("to"),), None,
-                              maybe=True))
-            else:
-                ops.append(Op(t0, inf, "cas",
-                              (req.get("from"), req.get("to")), None,
-                              maybe=True))
+            kind2 = "ccas" if req.get("create_if_not_exists") else "cas"
+            ops.append(Op(t0, inf, kind2,
+                          (req.get("from"), req.get("to")), None,
+                          maybe=True))
     return ops

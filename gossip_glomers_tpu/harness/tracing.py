@@ -49,10 +49,24 @@ def load_jsonl(fp: IO[str]) -> list[tuple[float, Message]]:
 
 
 def summarize(trace: list[tuple[float, Message]],
-              server_prefix: str = "n") -> dict:
+              server_prefix: str = "n",
+              nodes: set[str] | None = None,
+              services: set[str] | None = None) -> dict:
     """Aggregate views over a trace: totals, by-type, by-edge, and the
     server-to-server share (the msgs-per-op numerator,
-    reference README.md:17)."""
+    reference README.md:17).
+
+    Pass the harness's ``nodes``/``services`` id sets to classify
+    server-to-server traffic the way the network ledgers do (src is a
+    node AND dest is a node or service — network.py ``submit`` /
+    process_net.py ``_transmit``).  Without them the prefix heuristic is
+    used, which matches the ledger classification only for service-free
+    workloads (no seq-kv/lin-kv traffic).  Note the ledger counts a
+    message *before* the drop check while the trace records only
+    delivered messages, so under an active ``drop_fn`` the ledger is the
+    superset: trace counts == ledger counts − drops.
+    """
+    services = services or set()
     by_type: Counter = Counter()
     by_edge: Counter = Counter()
     server_to_server = 0
@@ -60,8 +74,13 @@ def summarize(trace: list[tuple[float, Message]],
     for t, msg in trace:
         by_type[msg.type] += 1
         by_edge[(msg.src, msg.dest)] += 1
-        if (msg.src.startswith(server_prefix)
-                and msg.dest.startswith(server_prefix)):
+        if nodes is not None:
+            s2s = msg.src in nodes and (msg.dest in nodes
+                                        or msg.dest in services)
+        else:
+            s2s = (msg.src.startswith(server_prefix)
+                   and msg.dest.startswith(server_prefix))
+        if s2s:
             server_to_server += 1
         t_first = t if t_first is None else t_first
         t_last = t

@@ -95,10 +95,15 @@ def expander_strides(n: int, degree: int = 8, seed: int = 0) -> list[int]:
     """Pseudo-random distinct strides in [1, n//2) for a circulant
     expander of the given (even) degree."""
     rng = np.random.default_rng(seed)
-    want = max(1, degree // 2)
+    # Distinct useful strides live in [1, n//2] (larger ones alias via
+    # i-s ≡ i+(n-s)); clamp so small n can't make the sampling loop
+    # unsatisfiable (e.g. n=8, degree=8 has only 4 strides) and never
+    # emit a stride that would be a self-loop or duplicate edge.
+    max_stride = max(1, n // 2)
+    want = min(max(1, degree // 2), max_stride)
     strides: set[int] = {1}
     while len(strides) < want:
-        strides.add(int(rng.integers(2, max(3, n // 2))))
+        strides.add(int(rng.integers(2, max_stride + 1)))
     return sorted(strides)
 
 

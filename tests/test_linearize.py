@@ -153,3 +153,42 @@ def test_dropped_kv_reply_history_still_checkable():
     assert len(history) >= 6
     ok, details = check_linearizable(history)
     assert ok, details
+
+
+def test_create_cas_exact_semantics():
+    # ccas succeeds from MISSING (creating at `to`)...
+    h = [Op(0, 1, "ccas", (1, 1), "ok"),
+         Op(2, 3, "read", (), 1)]
+    ok, _ = check_linearizable(h)
+    assert ok
+    # ...and from a matching frm on an existing key
+    h2 = [Op(0, 1, "write", (3,), "ok"),
+          Op(2, 3, "ccas", (3, 4), "ok"),
+          Op(4, 5, "read", (), 4)]
+    ok2, _ = check_linearizable(h2)
+    assert ok2
+    # but a successful ccas with a mismatched frm on an existing key is
+    # now rejected (the old write(to) model wrongly accepted this)
+    h3 = [Op(0, 1, "write", (3,), "ok"),
+          Op(2, 3, "ccas", (99, 4), "ok")]
+    ok3, _ = check_linearizable(h3)
+    assert not ok3
+    # a failing ccas must have seen a mismatched existing value
+    h4 = [Op(0, 1, "write", (3,), "ok"),
+          Op(2, 3, "ccas", (99, 4), "fail"),
+          Op(4, 5, "read", (), 3)]
+    ok4, _ = check_linearizable(h4)
+    assert ok4
+
+
+def test_long_history_no_recursion_limit():
+    # thousands of sequential ops: the explicit-stack DFS must decide
+    # this cleanly where Python-frame recursion would blow the limit
+    h = []
+    v = KEY_MISSING
+    for i in range(3000):
+        h.append(Op(2 * i, 2 * i + 1, "write", (i,), "ok"))
+    h.append(Op(6002, 6003, "read", (), 2999))
+    ok, details = check_linearizable(h)
+    assert ok
+    assert details["order"] is not None and len(details["order"]) == 3001

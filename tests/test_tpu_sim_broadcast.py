@@ -373,3 +373,16 @@ def test_delays_checkpoint_roundtrip(tmp_path):
         ref = sim.step(ref)
         restored = sim.step(restored)
     assert (np.asarray(restored.received) == np.asarray(ref.received)).all()
+
+
+def test_expander_strides_small_n_terminates():
+    # n too small for the requested degree must clamp, not loop forever
+    from gossip_glomers_tpu.parallel.topology import expander_strides
+    for n in (2, 3, 4, 8):
+        s = expander_strides(n, degree=8)
+        assert s == sorted(set(s))
+        # no self-loop (s ≡ 0 mod n) or duplicate-edge strides
+        assert all(1 <= x <= max(1, n // 2) for x in s)
+    assert expander_strides(2, degree=8) == [1]
+    assert expander_strides(3, degree=8) == [1]
+    assert expander_strides(1024, degree=8)[0] == 1
