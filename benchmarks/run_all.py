@@ -15,12 +15,9 @@ from __future__ import annotations
 
 import argparse
 import json
-import sys
 import time
 
-sys.path.insert(0, ".")
-
-import numpy as np  # noqa: E402
+import numpy as np
 
 
 def config1_tree25():

@@ -14,3 +14,26 @@ def run_program(name: str) -> None:
     node = StdioNode()
     PROGRAMS[name]().install(node)
     node.run()
+
+
+# Console-script entry points (pyproject [project.scripts]) — one per
+# challenge, mirroring the reference's one-binary-per-challenge layout.
+
+def main_echo() -> None:
+    run_program("echo")
+
+
+def main_unique_ids() -> None:
+    run_program("unique_ids")
+
+
+def main_broadcast() -> None:
+    run_program("broadcast")
+
+
+def main_counter() -> None:
+    run_program("counter")
+
+
+def main_kafka() -> None:
+    run_program("kafka")

@@ -22,4 +22,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# The package is installed (pip install -e ., see pyproject.toml); fall
+# back to the repo checkout only if running against a bare tree.
+try:
+    import gossip_glomers_tpu  # noqa: F401
+except ImportError:  # pragma: no cover
+    sys.path.insert(0,
+                    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

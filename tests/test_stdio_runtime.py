@@ -98,3 +98,15 @@ def test_unique_ids_node_over_pipes():
     finally:
         proc.stdin.close()
         proc.wait(timeout=5)
+
+
+def test_console_script_entry_points_registered():
+    """Packaging (pyproject [project.scripts]): one Maelstrom-style
+    executable per challenge, like the reference's checked-in binaries."""
+    from importlib.metadata import entry_points
+    eps = {ep.name for ep in entry_points(group="console_scripts")
+           if ep.module.startswith("gossip_glomers_tpu")}
+    expected = {"maelstrom-echo", "maelstrom-unique-ids",
+                "maelstrom-broadcast", "maelstrom-counter",
+                "maelstrom-kafka"}
+    assert expected <= eps, eps
