@@ -41,13 +41,22 @@ def main() -> None:
         n_dev = 1 << (len(devices).bit_length() - 1)
         mesh = Mesh(np.array(devices[:n_dev]), ("nodes",))
 
-    from gossip_glomers_tpu.tpu_sim.structured import make_exchange
+    from gossip_glomers_tpu.tpu_sim.structured import (make_exchange,
+                                                       make_sharded_exchange)
 
     nbrs = to_padded_neighbors(tree(N_NODES, branching=BRANCHING))
     inject = make_inject(N_NODES, N_VALUES)
+    sharded = None
+    if mesh is not None:
+        # halo path: parent/child slice ppermutes, O(block) ICI traffic
+        # per round — no all_gather, no redundant full-axis compute
+        sharded = make_sharded_exchange(
+            "tree", N_NODES, int(np.prod(mesh.devices.shape)),
+            branching=BRANCHING)
     sim = BroadcastSim(nbrs, n_values=N_VALUES, sync_every=64, mesh=mesh,
                        exchange=make_exchange("tree", N_NODES,
-                                              branching=BRANCHING))
+                                              branching=BRANCHING),
+                       sharded_exchange=sharded)
 
     # Warmup: compile the fused runner and run one full convergence.
     state, rounds = sim.run_fused(inject)

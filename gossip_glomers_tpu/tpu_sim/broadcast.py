@@ -263,9 +263,12 @@ class BroadcastSim:
     no tile-granularity random reads).  No partitions.
 
     Single-device: plain ``jax.jit``.  Multi-device: ``shard_map`` over
-    ``Mesh(axis 'nodes' [, 'words'])`` — the node axis block-sharded over
-    'nodes', bitset words over 'words'; each round all_gathers the payload
-    along 'nodes' (ICI), then gathers/exchanges locally.
+    ``Mesh(axis 'nodes' [, 'words'])`` — the node axis block-sharded
+    over 'nodes', bitset words over 'words'.  Words-major rounds
+    deliver via the **halo path** when a ``sharded_exchange`` is given
+    (O(boundary) ppermutes over ICI, every named topology); otherwise,
+    and always for the node-major gather path, each round all_gathers
+    the payload along 'nodes' first.
     """
 
     def __init__(self, nbrs: np.ndarray, *, n_values: int,
@@ -393,17 +396,17 @@ class BroadcastSim:
 
     def _sharded_round_wm(self, state: BroadcastState,
                           deg) -> BroadcastState:
-        """The words-major round inside shard_map: payload all_gather-ed
-        along the node axis (axis 1), the full-axis structured exchange
-        computed per shard, and the local node block sliced back out.
+        """The words-major round inside shard_map.
 
-        Known scale-out refinement: the exchange runs over the full node
-        axis on every shard (n_shards-fold redundant compute), but the
-        all_gather already moves the full axis to each shard, so this
-        does not change the per-round asymptotics.  Eliminating both
-        costs requires replacing the all_gather with a halo exchange
-        (ppermute of the O(1)-wide boundary region each structured
-        topology actually reads) — a follow-up, not a correctness gap."""
+        Preferred: the **halo path** (``sharded_exchange`` from
+        structured.make_sharded_exchange) — local block -> local block
+        delivery via O(boundary) slice ppermutes, available for every
+        named topology (ring/circulant rotations, tree parent/child
+        multicast, grid/line boundary shifts).  Fallback for shapes
+        without a halo decomposition: all_gather the payload along the
+        node axis, run the full-axis exchange per shard, slice the
+        local block back out (n_shards-fold redundant compute and
+        O(N) ICI traffic per round)."""
         mesh_axes = tuple(self.mesh.axis_names)
         if self.sharded_exchange is not None:
             # halo path: the exchange maps local block -> local block

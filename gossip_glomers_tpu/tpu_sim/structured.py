@@ -126,42 +126,210 @@ def sharded_roll(x_local: jnp.ndarray, s: int, n: int, n_shards: int,
     # out_local[:, c] = global[:, (p*B + c - s) mod N]:
     #   c in [r, B) -> cols [0, B-r) of block (p - q);
     #   c in [0, r) -> cols [B-r, B) of block (p - q - 1).
-    def from_block_offset(off: int) -> jnp.ndarray:
-        if off % n_shards == 0:
-            return x_local
-        perm = [((p - off) % n_shards, p) for p in range(n_shards)]
-        return jax.lax.ppermute(x_local, axis_name, perm)
+    # Each contribution is sliced BEFORE the ppermute, so total ICI
+    # traffic is exactly B columns per shard for any stride (r columns
+    # when the rotation stays within one block, q == 0).
 
-    block_b = from_block_offset(q)
+    def send(sl: jnp.ndarray, off: int) -> jnp.ndarray:
+        if off % n_shards == 0:
+            return sl
+        perm = [((p - off) % n_shards, p) for p in range(n_shards)]
+        return jax.lax.ppermute(sl, axis_name, perm)
+
     if r == 0:
-        return block_b
-    block_a = from_block_offset(q + 1)
-    return jnp.concatenate([block_a[:, block - r:],
-                            block_b[:, : block - r]], axis=1)
+        return send(x_local, q)
+    head = send(x_local[:, : block - r], q)        # dest cols [r, B)
+    tail = send(x_local[:, block - r:], q + 1)     # dest cols [0, r)
+    return jnp.concatenate([tail, head], axis=1)
+
+
+def sharded_shift(x_local: jnp.ndarray, s: int, n_shards: int,
+                  axis_name: str = "nodes") -> jnp.ndarray:
+    """Distributed zero-fill shift for a words-major (W, N) array
+    block-sharded over ``axis_name``: out[:, g] = x[:, g + s] for
+    0 <= g + s < N, else 0 (s > 0 shifts left, s < 0 shifts right;
+    g is the global column).
+
+    Unlike :func:`sharded_roll` nothing wraps, so the boundary shards
+    take ppermute's missing-source zeros as the fill — exactly the
+    zero-padding the single-device shift exchanges use.  Communicates
+    only the |s|-column halo per shard.  Requires |s| < block.
+    """
+    block = x_local.shape[1]
+    a = abs(s)
+    assert a < block, "halo shift needs |s| < block; use sharded_roll"
+    if a == 0:
+        return x_local
+    if s > 0:
+        halo = jax.lax.ppermute(
+            x_local[:, :a], axis_name,
+            [(p + 1, p) for p in range(n_shards - 1)])
+        return jnp.concatenate([x_local[:, a:], halo], axis=1)
+    halo = jax.lax.ppermute(
+        x_local[:, block - a:], axis_name,
+        [(p, p + 1) for p in range(n_shards - 1)])
+    return jnp.concatenate([halo, x_local[:, : block - a]], axis=1)
+
+
+def tree_sharded_exchange(p_local: jnp.ndarray, n: int, n_shards: int,
+                          branching: int = 4,
+                          axis_name: str = "nodes") -> jnp.ndarray:
+    """Halo exchange for the heap-ordered k-ary tree: local payload
+    block -> local inbox block, bit-exact with :func:`tree_exchange`.
+
+    Key structure (B = block size, shard s owns global nodes
+    [sB, (s+1)B), k | B): the parents of shard d's nodes occupy the
+    contiguous global range [lo_d, lo_d + B/k] with lo_d =
+    (dB-1)//k = (d//k)B + (d%k)(B/k) - 1 — i.e. ONE (B/k+1)-wide slice
+    of shard d//k's block (its first column reaching one node into
+    shard d//k - 1 when d%k == 0, covered by a 1-column left halo).
+    Children flow the same map in reverse, pre-reduced by parent group
+    on the child shard so only (B/k+1)-wide partial ORs travel.
+
+    Communication per shard per round: a 1-column halo each way plus
+    2k slice ppermutes of B/k+1 columns ≈ 2B columns total — versus
+    (n_shards-1)·B for the all_gather path, with no redundant
+    full-axis exchange compute.
+    """
+    w, block = p_local.shape
+    k = branching
+    assert block * n_shards == n, "node axis must shard evenly"
+    assert block % k == 0 and block >= k, "tree halo needs k | block"
+    sub = block // k
+    zcol = jnp.zeros((w, 1), p_local.dtype)
+
+    # ---- from_parent: inbox[i] |= payload[(i-1)//k] ------------------
+    # ext covers global columns [sB-1, sB+B): shard 0's missing left
+    # halo arrives as ppermute zeros == "parent of node 0" == none.
+    left = jax.lax.ppermute(
+        p_local[:, -1:], axis_name,
+        [(p, p + 1) for p in range(n_shards - 1)]) \
+        if n_shards > 1 else zcol
+    ext = jnp.concatenate([left, p_local], axis=1)
+    # k multicast rounds: in round m, source shard q sends the parent
+    # slice for destination shard d = qk + m.  Dests absent from a
+    # round receive zeros, so OR-ing the rounds selects each dest's
+    # single buffer.
+    buf = None
+    for m in range(k):
+        sl = ext[:, m * sub: m * sub + sub + 1]
+        pairs = [(q, q * k + m) for q in range(n_shards)
+                 if q * k + m < n_shards]
+        rv = jax.lax.ppermute(sl, axis_name, pairs)
+        buf = rv if buf is None else buf | rv
+    # local col c's parent sits at buf[ceil(c/k)] (buf[0] is the
+    # left-halo column: zero on the shard owning node 0).
+    from_parent = jnp.concatenate(
+        [buf[:, :1], jnp.repeat(buf[:, 1:], k, axis=1)], axis=1)[:, :block]
+
+    # ---- from_kids: inbox[j] |= OR payload[kj+1 .. kj+k] -------------
+    # Pre-reduce on the child shard: group local cols by parent.
+    # Col 0 (i = sB) is the LAST child of parent (sB-1)//k; cols
+    # [k(o-1)+1, ko] form parent group o.
+    body = p_local[:, 1:]
+    if body.shape[1] < sub * k:
+        body = jnp.concatenate(
+            [body, jnp.zeros((w, sub * k - body.shape[1]),
+                             p_local.dtype)], axis=1)
+    groups = jnp.bitwise_or.reduce(body.reshape(w, sub, k), axis=2)
+    partial = jnp.concatenate([p_local[:, :1], groups], axis=1)  # (w, sub+1)
+    # reverse multicast: child shard s = qk + m sends its partial to
+    # parent shard q, landing at ext_kids cols [m·sub, m·sub + sub].
+    ek = jnp.zeros((w, block + 1), p_local.dtype)
+    for m in range(k):
+        pairs = [(q * k + m, q) for q in range(n_shards)
+                 if q * k + m < n_shards]
+        rv = jax.lax.ppermute(partial, axis_name, pairs)
+        sl = slice(m * sub, m * sub + sub + 1)
+        ek = ek.at[:, sl].set(ek[:, sl] | rv)
+    # ext_kids col 0 is a partial OR for parent sB-1 — owned by the
+    # shard to the left; hand it back and fold into that shard's last
+    # parent column (which is its own ek col B).
+    if n_shards > 1:
+        back = jax.lax.ppermute(
+            ek[:, :1], axis_name,
+            [(p + 1, p) for p in range(n_shards - 1)])
+        ek = ek.at[:, block:].set(ek[:, block:] | back)
+    from_kids = ek[:, 1:]
+
+    return from_parent | from_kids
+
+
+def grid_sharded_exchange(p_local: jnp.ndarray, n: int, n_shards: int,
+                          cols: int,
+                          axis_name: str = "nodes") -> jnp.ndarray:
+    """Halo exchange for the row-major 2D grid: up/down are zero-fill
+    shifts by ±cols, left/right by ±1 with a global column mask killing
+    the row wrap — bit-exact with :func:`grid_exchange`, communicating
+    only a (cols+1)-column halo per direction per shard."""
+    block = p_local.shape[1]
+    assert block * n_shards == n, "node axis must shard evenly"
+    up = sharded_shift(p_local, cols, n_shards, axis_name)
+    down = sharded_shift(p_local, -cols, n_shards, axis_name)
+    lf = sharded_shift(p_local, 1, n_shards, axis_name)
+    rt = sharded_shift(p_local, -1, n_shards, axis_name)
+    start = jax.lax.axis_index(axis_name) * block
+    col_idx = (start + jnp.arange(block, dtype=jnp.int32)) % cols
+    lf = jnp.where((col_idx < cols - 1)[None, :], lf, 0)
+    rt = jnp.where((col_idx > 0)[None, :], rt, 0)
+    return up | down | lf | rt
+
+
+def line_sharded_exchange(p_local: jnp.ndarray, n: int, n_shards: int,
+                          axis_name: str = "nodes") -> jnp.ndarray:
+    """Halo exchange for the line: ±1 zero-fill shifts (1-column
+    halos), bit-exact with :func:`line_exchange`."""
+    assert p_local.shape[1] * n_shards == n
+    return (sharded_shift(p_local, 1, n_shards, axis_name)
+            | sharded_shift(p_local, -1, n_shards, axis_name))
 
 
 def make_sharded_exchange(topology: str, n: int, n_shards: int,
                           axis_name: str = "nodes", **kw):
-    """Halo (ppermute-based) sharded exchange for rotation topologies:
-    maps the LOCAL payload block directly to the LOCAL inbox block with
-    O(block) communication.  Returns None for topologies without a
-    rotation decomposition (tree/grid/line use the all_gather path)."""
-    if topology == "ring":
-        strides = [1]
-    elif topology == "circulant":
-        strides = list(kw["strides"])
-    else:
+    """Halo (ppermute-based) sharded exchange: maps the LOCAL payload
+    block directly to the LOCAL inbox block with O(block)
+    communication — no all_gather, no redundant full-axis compute.
+
+    Supported: ring and circulant (rotations), tree (parent/child
+    slice multicast), grid and line (boundary shifts).  Returns None
+    when the topology/shape has no halo decomposition (fall back to
+    the all_gather path): node axis not evenly sharded, tree blocks
+    not divisible by the branching factor, or grid rows wider than a
+    block.
+    """
+    if n % n_shards != 0:
         return None
+    block = n // n_shards
+    if topology in ("ring", "circulant"):
+        strides = [1] if topology == "ring" else list(kw["strides"])
 
-    def exchange_local(p_local: jnp.ndarray) -> jnp.ndarray:
-        out = None
-        for s in strides:
-            term = (sharded_roll(p_local, s, n, n_shards, axis_name)
-                    | sharded_roll(p_local, -s, n, n_shards, axis_name))
-            out = term if out is None else out | term
-        return out
+        def exchange_local(p_local: jnp.ndarray) -> jnp.ndarray:
+            out = None
+            for s in strides:
+                term = (sharded_roll(p_local, s, n, n_shards, axis_name)
+                        | sharded_roll(p_local, -s, n, n_shards,
+                                       axis_name))
+                out = term if out is None else out | term
+            return out
 
-    return exchange_local
+        return exchange_local
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        if block % k != 0 or block < k:
+            return None
+        return lambda p: tree_sharded_exchange(p, n, n_shards, k,
+                                               axis_name)
+    if topology == "grid":
+        cols = kw.get("cols") or grid_cols(n)
+        if cols >= block:
+            return None
+        return lambda p: grid_sharded_exchange(p, n, n_shards, cols,
+                                               axis_name)
+    if topology == "line":
+        if block < 2:
+            return None
+        return lambda p: line_sharded_exchange(p, n, n_shards, axis_name)
+    return None
 
 
 def make_exchange(topology: str, n: int, **kw):

@@ -258,10 +258,19 @@ def test_halo_sharded_exchange_matches_reference():
 
     cases = [("ring", 64, {}),
              ("circulant", 64, {"strides": expander_strides(64, 6, 1)}),
-             ("circulant", 128, {"strides": [1, 5, 33]})]
+             ("circulant", 128, {"strides": [1, 5, 33]}),
+             ("tree", 64, {}),          # B=8 (1d) / 16 (2d), k=4
+             ("tree", 256, {"branching": 2}),
+             ("grid", 256, {}),         # cols=16 < B=32 (1d) / 64 (2d)
+             ("line", 64, {})]
+    builders = {"ring": lambda n, kw: to_padded_neighbors(ring(n)),
+                "circulant": lambda n, kw: circulant(n, kw["strides"]),
+                "tree": lambda n, kw: to_padded_neighbors(
+                    tree(n, kw.get("branching", 4))),
+                "grid": lambda n, kw: to_padded_neighbors(grid(n)),
+                "line": lambda n, kw: to_padded_neighbors(line(n))}
     for topo, n, kw in cases:
-        nbrs = (to_padded_neighbors(ring(n)) if topo == "ring"
-                else circulant(n, kw["strides"]))
+        nbrs = builders[topo](n, kw)
         nv = 64
         inject = make_inject(n, nv)
         ref = BroadcastSim(nbrs, n_values=nv)
@@ -281,6 +290,41 @@ def test_halo_sharded_exchange_matches_reference():
             assert r1 == r3
             assert (ref.received_node_major(s1)
                     == halo.received_node_major(s3)).all()
+
+
+def test_halo_step_hlo_has_no_all_gather():
+    # the point of the halo path: tree and grid sharded rounds move only
+    # O(boundary) ppermutes over ICI — no all_gather anywhere in the
+    # compiled step, and no redundant full-axis exchange compute
+    from gossip_glomers_tpu.tpu_sim.structured import (make_exchange,
+                                                       make_sharded_exchange)
+
+    for topo, n, pdim, mesh in (("tree", 64, 8, mesh_1d()),
+                                ("grid", 256, 4, mesh_2d())):
+        nbrs = to_padded_neighbors(tree(n) if topo == "tree" else grid(n))
+        sim = BroadcastSim(
+            nbrs, n_values=64, mesh=mesh,
+            exchange=make_exchange(topo, n),
+            sharded_exchange=make_sharded_exchange(topo, n, pdim))
+        state = sim.init_state(make_inject(n, 64))
+        hlo = jax.jit(lambda s: sim._step(s, None, None)).lower(
+            state).compile().as_text()
+        assert "all-gather" not in hlo, topo
+        assert "collective-permute" in hlo, topo
+
+
+def test_make_sharded_exchange_shape_gates():
+    # topologies/shapes without a halo decomposition return None (the
+    # caller falls back to the all_gather path) instead of miscompiling
+    from gossip_glomers_tpu.tpu_sim.structured import make_sharded_exchange
+
+    assert make_sharded_exchange("tree", 24, 8) is None    # B=3, k=4
+    assert make_sharded_exchange("grid", 64, 8) is None    # cols=8 >= B=8
+    assert make_sharded_exchange("tree", 30, 8) is None    # uneven shards
+    assert make_sharded_exchange("full", 64, 8) is None    # no halo form
+    assert make_sharded_exchange("tree", 64, 8) is not None
+    assert make_sharded_exchange("grid", 256, 8) is not None
+    assert make_sharded_exchange("line", 64, 8) is not None
 
 
 def test_sharded_exchange_requires_exchange():
