@@ -35,6 +35,10 @@ class Ledger:
         self.total = 0
         self.by_type: Counter = Counter()
         self.server_to_server = 0
+        # server-to-server counts split by body type — same accounting
+        # as ProcessNetwork.server_msgs_by_type, for cross-harness
+        # message-count parity assertions
+        self.server_msgs_by_type: Counter = Counter()
         self.dropped = 0
         self.client_ops = 0
         self.op_latencies: list[float] = []
@@ -176,6 +180,7 @@ class VirtualNetwork:
         dest_is_server = msg.dest in self.nodes or msg.dest in self.services
         if src_is_server and dest_is_server:
             self.ledger.server_to_server += 1
+            self.ledger.server_msgs_by_type[msg.type] += 1
         if self.drop_fn is not None and self.drop_fn(msg.src, msg.dest,
                                                      self.now):
             self.ledger.dropped += 1

@@ -46,13 +46,16 @@ DropFn = Callable[[str, str, float], bool]
 
 class _ProcNode:
     def __init__(self, net: "ProcessNetwork", node_id: str,
-                 argv: list[str]) -> None:
+                 argv: list[str],
+                 extra_env: dict[str, str] | None = None) -> None:
         self.id = node_id
         # Scrub the env trigger that makes this image's sitecustomize
         # register the TPU plugin in every child interpreter — node
         # processes are pure-stdlib and would pay ~2 s of startup each.
         env = {k: v for k, v in os.environ.items()
                if k != "PALLAS_AXON_POOL_IPS"}
+        if extra_env:
+            env.update(extra_env)
         self.proc = subprocess.Popen(
             argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, text=True, bufsize=1, env=env)
@@ -148,8 +151,13 @@ class ProcessNetwork:
 
     # -- construction ------------------------------------------------------
 
-    def spawn(self, node_id: str, argv: list[str]) -> None:
-        self.nodes[node_id] = _ProcNode(self, node_id, argv)
+    def spawn(self, node_id: str, argv: list[str],
+              extra_env: dict[str, str] | None = None) -> None:
+        """Start one node process (the role Maelstrom's ``--bin`` spawn
+        plays).  ``extra_env`` lets a run pin child-process knobs, e.g.
+        ``GODEBUG=randautoseed=0`` for deterministic Go timer jitter or
+        ``GG_RNG_SEED`` for our stdio nodes."""
+        self.nodes[node_id] = _ProcNode(self, node_id, argv, extra_env)
 
     def add_kv(self, service_id: str) -> None:
         self.services[service_id] = _KV(service_id)

@@ -12,8 +12,30 @@ from ..runtime import StdioNode
 
 def run_program(name: str) -> None:
     node = StdioNode()
-    PROGRAMS[name]().install(node)
+    PROGRAMS[name](config=_config_from_env(name)).install(node)
     node.run()
+
+
+def _config_from_env(name: str):
+    """Optional env overrides for the reference's compile-time constants
+    (utils/config.py) — the knob deterministic cross-implementation
+    parity runs use to pin timer behavior (e.g. GG_SYNC_JITTER=0 makes
+    anti-entropy fire at exact 2 s multiples, test_process_parity.py).
+    Returns None (program defaults) when nothing is set."""
+    import os
+    if name == "broadcast":
+        interval = os.environ.get("GG_SYNC_INTERVAL")
+        jitter = os.environ.get("GG_SYNC_JITTER")
+        if interval is None and jitter is None:
+            return None
+        from ..utils.config import BroadcastConfig
+        cfg = BroadcastConfig()
+        if interval is not None:
+            cfg.sync_interval = float(interval)
+        if jitter is not None:
+            cfg.sync_jitter = float(jitter)
+        return cfg
+    return None
 
 
 # Console-script entry points (pyproject [project.scripts]) — one per

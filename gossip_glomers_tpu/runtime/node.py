@@ -181,7 +181,12 @@ class StdioNode(NodeCore):
         self._err = err_stream if err_stream is not None else sys.stderr
         self._out_lock = threading.Lock()
         self._threads: list[threading.Thread] = []
-        self.rng = random.Random()
+        # GG_RNG_SEED pins all timer jitter for deterministic parity
+        # runs (the stdio analogue of GODEBUG=randautoseed=0 pinning a
+        # Go binary's global math/rand).
+        import os
+        seed = os.environ.get("GG_RNG_SEED")
+        self.rng = random.Random(int(seed)) if seed else random.Random()
 
     def _transmit(self, msg: Message) -> None:
         line = encode_line(msg)

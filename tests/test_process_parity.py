@@ -34,13 +34,17 @@ needs_go = pytest.mark.skipif(
 PY = [sys.executable, "-m"]
 
 
-def run_broadcast_flood(argv_of, n=5, n_values=8):
+def run_broadcast_flood(argv_of, n=5, n_values=8, extra_env=None):
     """Spawn n nodes, flood n_values, return (server msgs by type,
     per-node final reads)."""
+    from concurrent.futures import ThreadPoolExecutor
+
     net = ProcessNetwork()
     try:
-        for i in range(n):
-            net.spawn(f"n{i}", argv_of(i))
+        with ThreadPoolExecutor(max_workers=min(n, 16)) as pool:
+            list(pool.map(lambda i: net.spawn(f"n{i}", argv_of(i),
+                                              extra_env=extra_env),
+                          range(n)))
         net.init_cluster()
         net.set_topology(to_name_map(tree(n)))
         for v in range(n_values):
@@ -62,6 +66,55 @@ def analytic_flood_count(n=5, n_values=8):
     (deg(i)-1) value-messages per value (rebroadcastAllExcept,
     broadcast.go:50-57) — on a tree that is exactly n-1 per value."""
     return n_values * (n - 1)
+
+
+@needs_go
+def test_25_node_flood_parity_go_vs_ours():
+    """BASELINE config 1 at full size: 25-node tree, no faults —
+    bit-identical server message counts, Go binary vs our stdio nodes.
+    (Our nodes' anti-entropy timer is pushed out of the window so both
+    stacks are in the pure eager-flood regime; the checked-in Go
+    artifact has no anti-entropy at all, see
+    test_go_binary_has_no_anti_entropy.)"""
+    want = analytic_flood_count(25, 13)
+    msgs_go, reads_go = run_broadcast_flood(lambda i: [GO_BROADCAST],
+                                            n=25, n_values=13)
+    msgs_py, reads_py = run_broadcast_flood(
+        lambda i: PY + ["gossip_glomers_tpu.nodes.broadcast"],
+        n=25, n_values=13, extra_env={"GG_SYNC_INTERVAL": "600"})
+    assert all(r == list(range(13)) for r in reads_go.values())
+    assert reads_go == reads_py
+    assert msgs_go["broadcast"] == msgs_py["broadcast"] == want
+    assert msgs_go["broadcast_ok"] == msgs_py["broadcast_ok"] == want
+    assert msgs_go == msgs_py
+
+
+@needs_go
+def test_go_binary_has_no_anti_entropy():
+    """Artifact/source discrepancy, pinned: the checked-in
+    maelstrom-broadcast binary never runs the SyncBroadcast timer the
+    checked-in source has (broadcast/main.go:42-51) — two diverged sets
+    stay diverged past several 2-3 s timer periods with zero read
+    traffic.  Like the kafka binary (see test_go_kafka_semantics), the
+    artifact predates its source; the SOURCE is the authoritative
+    reference for anti-entropy, certified against our two stacks in
+    test_sync_waves_process_vs_virtual_vs_analytic."""
+    import time
+
+    net = ProcessNetwork()
+    try:
+        for i in range(2):
+            net.spawn(f"n{i}", [GO_BROADCAST])
+        net.init_cluster()
+        net.set_topology({"n0": [], "n1": []})   # keep the value local
+        net.rpc("n0", {"type": "broadcast", "message": 42})
+        from gossip_glomers_tpu.parallel.topology import line
+        net.set_topology(to_name_map(line(2)))   # reconnect
+        time.sleep(6.5)                          # > 2 full timer periods
+        assert net.server_msgs_by_type.get("read", 0) == 0
+        assert not net.rpc("n1", {"type": "read"}).get("messages")
+    finally:
+        net.shutdown()
 
 
 @needs_go
@@ -92,6 +145,133 @@ def test_virtual_harness_matches_go_flood_counts():
     assert res.stats["server_msgs_at_quiescence"] == \
         2 * analytic_flood_count()  # broadcast + broadcast_ok
     assert by_type["broadcast"] - 8 == analytic_flood_count()  # -client ops
+
+
+# -- anti-entropy regime: process vs virtual vs analytic ----------------
+#
+# The reference's sync (SyncBroadcast, broadcast.go:81-122 + the 2 s
+# timer, main.go:42-51) decides msgs/op in steady state.  The checked-in
+# Go binary predates that code (test_go_binary_has_no_anti_entropy), so
+# the source-derived analytic count is the reference line, and both our
+# stacks must hit it exactly on a pinned-timer, staggered-anchor
+# schedule:
+#
+#   - 25-node 4-ary tree, sync_jitter=0 -> node i's waves fire at
+#     init_i + 2k.  n24 (a leaf) is initialized 0.35 s after the rest,
+#     so its parent n5 always syncs first.
+#   - values 0..9 flood healthy; value 10 floods while n24 is
+#     partitioned off (its copy drops in-network); heal before the
+#     first wave.
+#   - wave 1: every node reads every neighbor (read/read_ok = sum of
+#     degrees = 48).  n5 sees n24 lacks 10 -> one targeted push
+#     (broadcast + broadcast_ok, broadcast.go:104-108); n24 is a leaf
+#     so its own learn re-floods nothing (:97-102 fans to zero other
+#     neighbors).  wave 2: all sets equal -> reads only.
+#
+# Expected server-to-server counts over floods + exactly 2 waves:
+#   broadcast     11*24 + 1  = 265   (flood sends count even when
+#   broadcast_ok  10*24+23+1 = 264    dropped; delivered ones are acked)
+#   read/read_ok  2 * 48     = 96 each
+
+SYNC_WAVE_EXPECT = {"broadcast": 265, "broadcast_ok": 264,
+                    "read": 96, "read_ok": 96}
+
+
+def _sync_wave_scenario_process():
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    env = {"GG_SYNC_INTERVAL": "2", "GG_SYNC_JITTER": "0"}
+    blocked = {"on": False}
+    net = ProcessNetwork(
+        drop_fn=lambda src, dest, now: (blocked["on"]
+                                        and "n24" in (src, dest)))
+    try:
+        ids = [f"n{i}" for i in range(25)]
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            list(pool.map(
+                lambda i: net.spawn(
+                    f"n{i}", PY + ["gossip_glomers_tpu.nodes.broadcast"],
+                    extra_env=env), range(25)))
+        # anchors: n0..n23 now, n24 later -> n5's waves precede n24's
+        for i in range(24):
+            rep = net.rpc(f"n{i}", {"type": "init", "node_id": f"n{i}",
+                                    "node_ids": ids})
+            assert rep["type"] == "init_ok"
+        time.sleep(0.35)
+        rep = net.rpc("n24", {"type": "init", "node_id": "n24",
+                              "node_ids": ids})
+        assert rep["type"] == "init_ok"
+        t24 = time.monotonic()
+        net.set_topology(to_name_map(tree(25)))
+        for v in range(10):
+            rep = net.rpc(f"n{v % 25}", {"type": "broadcast",
+                                         "message": v})
+            assert rep["type"] == "broadcast_ok"
+        net.quiesce(idle=0.15, timeout=3.0)
+        blocked["on"] = True
+        rep = net.rpc("n0", {"type": "broadcast", "message": 10})
+        assert rep["type"] == "broadcast_ok"
+        time.sleep(0.2)                       # flood done, n24's copy lost
+        blocked["on"] = False                 # heal before the first wave
+        assert not net.rpc("n24", {"type": "read"}).get("messages",
+                                                        []).count(10)
+        # wait past n24's wave 2 (t24+4) but before anyone's wave 3 (>= +6)
+        time.sleep(max(0.0, t24 + 4.7 - time.monotonic()))
+        snap = dict(net.server_msgs_by_type)
+        r24 = sorted(net.rpc("n24", {"type": "read"})["messages"])
+        return snap, r24
+    finally:
+        net.shutdown()
+
+
+def _sync_wave_scenario_virtual():
+    from gossip_glomers_tpu.harness.network import VirtualNetwork
+    from gossip_glomers_tpu.models import BroadcastProgram
+    from gossip_glomers_tpu.utils.config import (BroadcastConfig,
+                                                 NetConfig)
+
+    net = VirtualNetwork(NetConfig(latency=0.0, seed=0))
+    for i in range(25):
+        net.spawn(f"n{i}",
+                  BroadcastProgram(BroadcastConfig(sync_jitter=0.0)))
+    blocked = {"on": False}
+    net.drop_fn = (lambda src, dest, now: blocked["on"]
+                   and "n24" in (src, dest))
+    ids = sorted(net.nodes)
+    ctl = net.client("c0")
+    for i in range(24):
+        ctl.rpc(f"n{i}", {"type": "init", "node_id": f"n{i}",
+                          "node_ids": ids})
+    net.run_for(0.35)
+    ctl.rpc("n24", {"type": "init", "node_id": "n24", "node_ids": ids})
+    net.run_for(0.0)
+    net.set_topology(to_name_map(tree(25)))
+    client = net.client("c1")
+    for v in range(10):
+        client.rpc(f"n{v % 25}", {"type": "broadcast", "message": v})
+        net.run_for(0.01)
+    blocked["on"] = True
+    client.rpc("n0", {"type": "broadcast", "message": 10})
+    net.run_for(0.05)
+    blocked["on"] = False
+    # waves: n0..n23 at t=2, 4; n24 at 2.35, 4.35; cut before t=6
+    net.run_for(4.8 - net.now)
+    snap = dict(net.ledger.server_msgs_by_type)
+    got: dict[str, list] = {}
+    client.rpc("n24", {"type": "read"},
+               lambda rep: got.__setitem__("m", rep.body["messages"]))
+    net.run_for(0.0)
+    return snap, sorted(got["m"])
+
+
+def test_sync_waves_process_vs_virtual_vs_analytic():
+    snap_v, r24_v = _sync_wave_scenario_virtual()
+    assert r24_v == list(range(11))          # the hole was repaired
+    assert snap_v == SYNC_WAVE_EXPECT
+    snap_p, r24_p = _sync_wave_scenario_process()
+    assert r24_p == list(range(11))
+    assert snap_p == snap_v == SYNC_WAVE_EXPECT
 
 
 def _counter_session(argv):
