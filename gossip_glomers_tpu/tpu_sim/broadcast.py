@@ -89,6 +89,16 @@ class BroadcastState(NamedTuple):
     # delay-d edge delivers the payload flooded d rounds ago (Maelstrom's
     # variable per-edge latency as data).  None when all edges are 1 hop.
     history: jnp.ndarray | None = None
+    # gather path only: reference-accounted server-to-server message
+    # total — what Maelstrom's ledger would read for the same run.
+    # Floods: one `broadcast` per (value, topology neighbor) minus the
+    # sender exclusion (rebroadcastAllExcept, broadcast.go:50-57) plus
+    # one `broadcast_ok` per delivery; sync rounds: `read` per topology
+    # neighbor + `read_ok` per live neighbor + the targeted diff pushes
+    # and their acks (SyncBroadcast, broadcast.go:81-122).  None on the
+    # words-major structured path, whose `msgs` stays the throughput
+    # (value-message) ledger.
+    srv_msgs: jnp.ndarray | None = None
 
 
 def _popcount(x: jnp.ndarray) -> jnp.ndarray:
@@ -166,12 +176,34 @@ def _gather_or_delayed(history: jnp.ndarray, t: jnp.ndarray,
                          term(0))
 
 
+def _sync_diff_pc(payload_full: jnp.ndarray, recv_local: jnp.ndarray,
+                  nbrs: jnp.ndarray, live: jnp.ndarray) -> jnp.ndarray:
+    """() uint32 — total targeted-push volume of one reference sync
+    wave: sum over live ordered neighbor pairs (j, i) of
+    |recv_j \\ recv_i| (the ``mine minus peer's`` sends of
+    broadcast.go:104-108), computed at each destination i against the
+    payload rows its live neighbors hold."""
+
+    def term(d):
+        idx = lax.dynamic_index_in_dim(nbrs, d, axis=1, keepdims=False)
+        ok = lax.dynamic_index_in_dim(live, d, axis=1, keepdims=False)
+        rows = payload_full[jnp.clip(idx, 0, payload_full.shape[0] - 1)]
+        per_node = _popcount(rows & ~recv_local).sum(
+            axis=1).astype(jnp.uint32)
+        return jnp.sum(jnp.where(ok, per_node, 0), dtype=jnp.uint32)
+
+    return lax.fori_loop(1, nbrs.shape[1], lambda d, acc: acc + term(d),
+                         term(0))
+
+
 def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
            nbrs: jnp.ndarray, nbr_mask: jnp.ndarray, parts: Partitions,
            sync_every: int,
            widen: Callable[[jnp.ndarray], jnp.ndarray] = lambda p: p,
            reduce_sum: Callable[[jnp.ndarray], jnp.ndarray] = lambda s: s,
            delays: jnp.ndarray | None = None,
+           sync_base_once: Callable[[jnp.ndarray], jnp.ndarray]
+           = lambda x: x,
            ) -> BroadcastState:
     """One simulation round == one base network hop — the single source
     of the node-major (adjacency-gather) round semantics, shared by the
@@ -191,12 +223,40 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
     payload = jnp.where(is_sync, state.received, state.frontier)
     payload_full = widen(payload)
     live_now = _edge_live(state.t, row_ids, nbrs, nbr_mask, parts)
-    # ledger: the reference sends one message per (value, edge) —
-    # broadcast.go:50-57 fans each value out separately.  Counted at
-    # send time regardless of delivery delay.
+    # throughput ledger: one value-message per (value, live edge) —
+    # counted at send time regardless of delivery delay.
     sent = reduce_sum(jnp.sum(
         _popcount(payload).sum(axis=1).astype(jnp.uint32)
         * live_now.sum(axis=1).astype(jnp.uint32), dtype=jnp.uint32))
+    # reference-accounted server-message ledger (Maelstrom parity):
+    # floods charge `broadcast` sends to every TOPOLOGY neighbor minus
+    # the sender exclusion (drops still count as sends) plus one
+    # `broadcast_ok` per live delivery; t == 0 frontier rows are
+    # origins (client-injected, no sender to exclude).  Sync rounds
+    # charge read-per-topo-neighbor + read_ok-per-live-neighbor + the
+    # targeted diff pushes and their acks.  Under `delays`, sends are
+    # still charged at send time and the sync diff is computed against
+    # current (not RTT-stale) peer state — exact at zero delay.
+    if state.srv_msgs is None:
+        srv = None
+    else:
+        deg_topo = nbr_mask.sum(axis=1).astype(jnp.int32)
+        live_deg = live_now.sum(axis=1).astype(jnp.int32)
+        pcf = _popcount(state.frontier).sum(axis=1).astype(jnp.uint32)
+        coef = jnp.where(state.t == 0, deg_topo + live_deg,
+                         jnp.maximum(deg_topo + live_deg - 2, 0))
+        flood = jnp.sum(pcf * coef.astype(jnp.uint32), dtype=jnp.uint32)
+        base = sync_base_once(
+            jnp.sum(deg_topo + live_deg, dtype=jnp.int32).astype(
+                jnp.uint32))
+        # computed every round and masked (a lax.cond would need equal
+        # sharding types across branches under shard_map); on sync
+        # rounds payload_full IS the widened received set
+        diff = _sync_diff_pc(payload_full, state.received, nbrs,
+                             live_now)
+        srv_inc = flood + jnp.where(is_sync, base + 2 * diff,
+                                    jnp.uint32(0))
+        srv = state.srv_msgs + reduce_sum(srv_inc)
     if delays is None:
         inbox = _gather_or(payload_full, nbrs, live_now)
         history = state.history
@@ -213,7 +273,8 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
                           frontier=new,
                           t=state.t + 1,
                           msgs=state.msgs + sent,
-                          history=history)
+                          history=history,
+                          srv_msgs=srv)
 
 
 def flood_step(state: BroadcastState, *, nbrs: jnp.ndarray,
@@ -367,7 +428,9 @@ class BroadcastSim:
                     history, NamedSharding(self.mesh, P(None, None, None)))
         return BroadcastState(received=received, frontier=received,
                               t=jnp.int32(0), msgs=jnp.uint32(0),
-                              history=history)
+                              history=history,
+                              srv_msgs=(None if self.words_major
+                                        else jnp.uint32(0)))
 
     def target_bits(self, inject: np.ndarray) -> jnp.ndarray:
         """(W,) uint32 — union of all injected values: the convergence
@@ -387,12 +450,19 @@ class BroadcastSim:
         block = nbrs.shape[0]
         start = lax.axis_index("nodes") * block
         row_ids = start + jnp.arange(block, dtype=jnp.int32)
+        if "words" in mesh_axes:
+            # per-word-shard quantities (popcounts) psum linearly; the
+            # per-node sync base (reads/read_oks) must count once
+            sync_base_once = lambda b: jnp.where(  # noqa: E731
+                lax.axis_index("words") == 0, b, jnp.uint32(0))
+        else:
+            sync_base_once = lambda b: b  # noqa: E731
         return _round(
             state, row_ids=row_ids, nbrs=nbrs, nbr_mask=nbr_mask,
             parts=parts, sync_every=self.sync_every,
             widen=lambda p: lax.all_gather(p, "nodes", axis=0, tiled=True),
             reduce_sum=lambda s: lax.psum(s, mesh_axes),
-            delays=delays)
+            delays=delays, sync_base_once=sync_base_once)
 
     def _sharded_round_wm(self, state: BroadcastState,
                           deg) -> BroadcastState:
@@ -429,8 +499,9 @@ class BroadcastSim:
         state_spec = self._state_spec
         hist_spec = (None if self.delays is None
                      else P(None, None, None))   # replicated ring
+        srv_spec = None if self.words_major else P()
         return (BroadcastState(state_spec, state_spec, P(), P(),
-                               hist_spec),
+                               hist_spec, srv_spec),
                 P("nodes", None), Partitions(P(), P(), P(None, None)))
 
     def _build_step(self):
@@ -668,6 +739,32 @@ class BroadcastSim:
         """(N, W) received bitset regardless of the internal layout."""
         rec = np.asarray(state.received)
         return rec.T if self.words_major else rec
+
+    def server_msgs(self, state: BroadcastState) -> int:
+        """Reference-accounted server-to-server message total (what the
+        Maelstrom/harness ledger reads for the same run); gather path
+        only."""
+        if state.srv_msgs is None:
+            raise ValueError("server-message ledger exists only on the "
+                             "adjacency-gather path")
+        return int(state.srv_msgs)
+
+    def inject_mid(self, state: BroadcastState, node: int,
+                   value: int) -> BroadcastState:
+        """Mid-run client broadcast: set ``value`` at ``node`` so the
+        next round floods it.  Charges the origin correction to the
+        server ledger (an origin sends to ALL topology neighbors and is
+        acked by every live one — one send + one ack more than the
+        (deg-1)-charged learner the next flood round accounts it as)."""
+        if self.words_major:
+            raise ValueError("inject_mid targets the gather path")
+        w, b = value // WORD, jnp.uint32(1 << (value % WORD))
+        received = state.received.at[node, w].set(
+            state.received[node, w] | b)
+        frontier = state.frontier.at[node, w].set(
+            state.frontier[node, w] | b)
+        return state._replace(received=received, frontier=frontier,
+                              srv_msgs=state.srv_msgs + jnp.uint32(2))
 
     def run_stats(self, inject: np.ndarray, *, max_rounds: int = 1 << 16,
                   ) -> tuple[BroadcastState, int, list[dict]]:
