@@ -340,7 +340,12 @@ class BroadcastSim:
                  sharded_exchange: Callable[[jnp.ndarray], jnp.ndarray]
                  | None = None,
                  delays: np.ndarray | None = None,
+                 srv_ledger: bool = True,
                  ) -> None:
+        """``srv_ledger``: keep the reference-accounted server-message
+        ledger (default).  It costs a second adjacency pass per round
+        (the sync pairwise diff), which roughly doubles gather-path
+        round time — throughput benchmarks at scale pass False."""
         n = nbrs.shape[0]
         self.n_nodes = n
         self.n_values = n_values
@@ -356,6 +361,9 @@ class BroadcastSim:
         if sharded_exchange is not None and exchange is None:
             raise ValueError("sharded_exchange requires exchange")
         self.words_major = exchange is not None
+        # server-ledger exists only on the gather path (the words-major
+        # structured exchange materializes no per-edge terms to diff)
+        self._srv_on = srv_ledger and not self.words_major
         if self.words_major and self.parts.starts.shape[0] > 0:
             raise ValueError(
                 "structured exchange cannot apply per-edge partition "
@@ -429,8 +437,8 @@ class BroadcastSim:
         return BroadcastState(received=received, frontier=received,
                               t=jnp.int32(0), msgs=jnp.uint32(0),
                               history=history,
-                              srv_msgs=(None if self.words_major
-                                        else jnp.uint32(0)))
+                              srv_msgs=(jnp.uint32(0) if self._srv_on
+                                        else None))
 
     def target_bits(self, inject: np.ndarray) -> jnp.ndarray:
         """(W,) uint32 — union of all injected values: the convergence
@@ -499,7 +507,7 @@ class BroadcastSim:
         state_spec = self._state_spec
         hist_spec = (None if self.delays is None
                      else P(None, None, None))   # replicated ring
-        srv_spec = None if self.words_major else P()
+        srv_spec = P() if self._srv_on else None
         return (BroadcastState(state_spec, state_spec, P(), P(),
                                hist_spec, srv_spec),
                 P("nodes", None), Partitions(P(), P(), P(None, None)))
