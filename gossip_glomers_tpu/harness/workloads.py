@@ -355,3 +355,95 @@ def run_kafka(n_nodes: int = 2, n_keys: int = 4, n_ops: int = 120,
     committed = committed_reads[-1] if committed_reads else {}
     ok, details = checkers.check_kafka(send_acks, polls, committed)
     return WorkloadResult(ok, details, _stats(net, n_ops))
+
+
+def run_kafka_faults(n_nodes: int = 4, n_keys: int = 2,
+                     n_bursts: int = 16, latency: float = 0.05,
+                     partitions: PartitionSchedule | None = None,
+                     seed: int = 0) -> WorkloadResult:
+    """Faulted kafka campaign: injected latency, optional partition
+    windows, and BURSTS of simultaneous sends to the same hot key from
+    every node — so the lin-kv allocation loop actually loses CAS races
+    and retries (logmap.go:255-285), commit_offsets races drive the
+    read/write/CAS dance including the code-21 create-race retry
+    (logmap.go:46-52, :143-149), and replicate_msg loss under
+    partitions exercises the acks=0 stance (README.md:22-24).
+
+    The returned stats include the lin-kv op mix (``kv_by_type``) so
+    callers can assert contention actually happened (cas count strictly
+    above one per acked send) — the traffic regime the flat-latency
+    run_kafka never enters."""
+    net = _make_net(n_nodes, KafkaProgram, net_cfg=NetConfig(
+        latency=latency, seed=seed), services=("lin-kv",),
+        partitions=partitions)
+    client = net.client("c1")
+    rng = net.rng
+    send_acks: list[tuple[str, int, int]] = []
+    send_errors = [0]
+    polls: list[dict[str, list[list[int]]]] = []
+    committed_reads: list[dict[str, int]] = []
+    next_msg = [0]
+
+    def burst_sends(key: str) -> None:
+        # one send per node, same key, same virtual instant: every node
+        # reads the same current offset, exactly one CAS wins, the rest
+        # re-enter the loop — the contention regime of logmap.go:255-285
+        for i in range(n_nodes):
+            value = next_msg[0]
+            next_msg[0] += 1
+
+            def on_ack(rep: Message, key=key, value=value) -> None:
+                if rep.type == "send_ok":
+                    send_acks.append((key, rep.body["offset"], value))
+                else:
+                    send_errors[0] += 1
+
+            client.rpc(f"n{i}", {"type": "send", "key": key,
+                                 "msg": value}, on_ack)
+
+    # an early commit race on a key nobody has sent to: both nodes see
+    # KeyDoesNotExist, both try the create-write, the loser gets code 21
+    # and re-runs the dance (logmap.go:143-149)
+    for i in range(min(2, n_nodes)):
+        client.rpc(f"n{i}", {"type": "commit_offsets",
+                             "offsets": {"kfresh": 7}}, lambda rep: None)
+    net.run_for(latency * 8)
+
+    cursor: dict[str, int] = {}
+    for b in range(n_bursts):
+        key = f"k{b % n_keys}"
+        burst_sends(key)
+        net.run_for(latency * 20)        # let retries drain
+        if b % 3 == 2:
+            # racing commits from two different nodes on the hot keys
+            for i in range(min(2, n_nodes)):
+                client.rpc(f"n{rng.randrange(n_nodes)}",
+                           {"type": "commit_offsets",
+                            "offsets": dict(cursor) or {key: 1}},
+                           lambda rep: None)
+        for key2, off, _v in send_acks:
+            cursor[key2] = max(cursor.get(key2, 0), off)
+    net.run_for(5.0)
+
+    # final polls from offset 0 at every node + a committed-offset read
+    for i in range(n_nodes):
+        client.rpc(f"n{i}", {"type": "poll",
+                             "offsets": {f"k{k}": 0
+                                         for k in range(n_keys)}},
+                   lambda rep: polls.append(rep.body.get("msgs", {})))
+    client.rpc("n0", {"type": "list_committed_offsets",
+                      "keys": [f"k{k}" for k in range(n_keys)]},
+               lambda rep: committed_reads.append(
+                   rep.body.get("offsets", {})))
+    net.run_for(2.0)
+
+    committed = committed_reads[-1] if committed_reads else {}
+    ok, details = checkers.check_kafka(send_acks, polls, committed)
+    details["n_acked"] = len(send_acks)
+    details["n_send_errors"] = send_errors[0]
+    stats = _stats(net, n_bursts * n_nodes)
+    stats["kv_by_type"] = {
+        t: c for t, c in net.ledger.server_msgs_by_type.items()
+        if t in ("read", "read_ok", "cas", "cas_ok", "write", "write_ok",
+                 "error")}
+    return WorkloadResult(ok, details, stats)

@@ -82,12 +82,16 @@ class KafkaSim:
     """
 
     def __init__(self, n_nodes: int, n_keys: int, capacity: int, *,
-                 max_sends: int = 4, mesh: Mesh | None = None) -> None:
+                 max_sends: int = 4, mesh: Mesh | None = None,
+                 kv_retries: int = 10) -> None:
         self.n_nodes = n_nodes
         self.n_keys = n_keys
         self.capacity = capacity
         self.max_sends = max_sends
         self.mesh = mesh
+        # allocation-attempt cap for the contention-aware ledger
+        # (defaultKVRetries, logmap.go:19)
+        self.kv_retries = kv_retries
         self._run_rounds = None
         self._step = self._build_step()
 
@@ -165,13 +169,26 @@ class KafkaSim:
             state.committed, reduce_max(jnp.max(commit_req, axis=0)))
         local_committed = jnp.maximum(state.local_committed, commit_req)
 
-        # -- ledger: 4 msgs per send's KV exchange (read + CAS pair),
-        #    N-1 replicate_msg per send, 4 per commit key exchange ------
+        # -- ledger: CAS-contention-aware KV accounting.  A send that is
+        #    rank r among this round's senders of its key loses the CAS
+        #    race to the r earlier ones, so the reference's allocation
+        #    loop (logmap.go:255-285) serializes into r+1 attempts of
+        #    read + read_ok + cas + cas-reply = 4 messages each, capped
+        #    at defaultKVRetries (logmap.go:19).  `rank` is global and
+        #    identical on every shard, so its sum is NOT psum-reduced.
+        #    Commits stay 4 flat: the commit dance does not retry a lost
+        #    CAS (only code 21/timeout — the quirk at logmap.go:46-52).
+        #    Replication: N-1 fire-and-forget replicate_msg per send.
+        attempts = jnp.minimum(rank + 1, self.kv_retries)
+        kv_send_msgs = jnp.sum(
+            jnp.where(valid, 4 * attempts, 0).astype(jnp.uint32),
+            dtype=jnp.uint32)
         n_sends = reduce_sum(jnp.sum(
             (send_key >= 0).astype(jnp.uint32)))
         n_commits = reduce_sum(jnp.sum(
             (commit_req >= 0).astype(jnp.uint32)))
-        msgs = (state.msgs + n_sends * jnp.uint32(4 + (n - 1))
+        msgs = (state.msgs + kv_send_msgs
+                + n_sends * jnp.uint32(n - 1)
                 + n_commits * jnp.uint32(4))
         return KafkaState(log_vals, present, next_slot, committed,
                           local_committed, state.t + 1, msgs)

@@ -233,3 +233,27 @@ def test_kafka_run_rounds_matches_stepwise():
         assert (np.asarray(getattr(s1, f))
                 == np.asarray(getattr(s2, f))).all(), f
     assert int(s1.msgs) == int(s2.msgs)
+
+
+def test_kafka_ledger_is_cas_contention_aware():
+    # all 8 nodes send the same key in one round: ranks 0..7 serialize
+    # into 1..8 allocation attempts of 4 KV msgs each (logmap.go:255-285)
+    # plus 7 replicate_msg per send
+    n = 8
+    sim = KafkaSim(n, 3, capacity=64, max_sends=1)
+    sk = np.zeros((n, 1), np.int32)
+    sv = np.arange(n, dtype=np.int32).reshape(n, 1)
+    cr = np.full((n, 3), -1, np.int32)
+    st = sim.step(sim.init_state(), sk, sv, cr)
+    want_kv = 4 * sum(r + 1 for r in range(n))       # 144
+    assert int(st.msgs) == want_kv + n * (n - 1)
+    # uncontended round: one key per node, one attempt each
+    sim2 = KafkaSim(n, n, capacity=64, max_sends=1)
+    sk2 = np.arange(n, dtype=np.int32).reshape(n, 1)
+    st2 = sim2.step(sim2.init_state(), sk2, sv, cr.copy()[:, :1].repeat(n, 1))
+    assert int(st2.msgs) == 4 * n + n * (n - 1)
+    # the attempt ladder is capped at the reference's retry limit
+    sim3 = KafkaSim(n, 3, capacity=64, max_sends=1, kv_retries=3)
+    st3 = sim3.step(sim3.init_state(), sk, sv, cr)
+    want_capped = 4 * sum(min(r + 1, 3) for r in range(n))
+    assert int(st3.msgs) == want_capped + n * (n - 1)
