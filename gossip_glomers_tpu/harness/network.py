@@ -27,6 +27,16 @@ from ..utils.config import NetConfig
 DropFn = Callable[[str, str, float], bool]
 
 
+def is_server_msg(src: str, dest: str, nodes, services) -> bool:
+    """THE server-to-server classification, shared by every ledger
+    (VirtualNetwork, ProcessNetwork, tracing.summarize): src and dest
+    both a node or a service.  Service replies count — Maelstrom's
+    msgs-per-op counts every message (reference README.md:17), so one
+    KV round-trip costs two server messages."""
+    return (src in nodes or src in services) and (dest in nodes
+                                                  or dest in services)
+
+
 class Ledger:
     """Message accountant (the source of the msgs-per-op stat, reference
     README.md:17)."""
@@ -37,7 +47,12 @@ class Ledger:
         self.server_to_server = 0
         # server-to-server counts split by body type — same accounting
         # as ProcessNetwork.server_msgs_by_type, for cross-harness
-        # message-count parity assertions
+        # message-count parity assertions.  "Server" includes the KV
+        # service endpoints in BOTH directions: Maelstrom's msgs-per-op
+        # counts every network message (reference README.md:17), so a
+        # node's KV round-trip (read + read_ok, cas + cas_ok/error,
+        # counter/add.go:67-95, kafka/logmap.go:255-285) costs TWO
+        # server messages here, not one.
         self.server_msgs_by_type: Counter = Counter()
         self.dropped = 0
         self.client_ops = 0
@@ -176,9 +191,7 @@ class VirtualNetwork:
         deliver."""
         self.ledger.total += 1
         self.ledger.by_type[msg.type] += 1
-        src_is_server = msg.src in self.nodes
-        dest_is_server = msg.dest in self.nodes or msg.dest in self.services
-        if src_is_server and dest_is_server:
+        if is_server_msg(msg.src, msg.dest, self.nodes, self.services):
             self.ledger.server_to_server += 1
             self.ledger.server_msgs_by_type[msg.type] += 1
         if self.drop_fn is not None and self.drop_fn(msg.src, msg.dest,

@@ -40,6 +40,7 @@ from typing import Any, Callable
 
 from ..protocol import (KEY_DOES_NOT_EXIST, PRECONDITION_FAILED, Message,
                         RPCError)
+from .network import is_server_msg
 
 DropFn = Callable[[str, str, float], bool]
 
@@ -191,15 +192,14 @@ class ProcessNetwork:
     def _transmit(self, src: str, dest: str, body: dict) -> None:
         """Single transmit path for EVERY message — node, service and
         client traffic all get the same accounting, drop and latency
-        treatment.  server_to_server counts src-is-node AND dest in
-        nodes-or-services, matching harness/network.py:175-178 so
-        cross-harness ledger comparisons compare the same quantity."""
+        treatment.  Server classification is the shared
+        ``is_server_msg`` so cross-harness ledger comparisons compare
+        the same quantity."""
         with self._lock:
             self.total += 1
             self.by_type[body.get("type", "?")] += 1
             self._last_traffic = time.monotonic()
-            if src in self.nodes and (dest in self.nodes
-                                      or dest in self.services):
+            if is_server_msg(src, dest, self.nodes, self.services):
                 self.server_to_server += 1
                 self.server_msgs_by_type[body.get("type", "?")] += 1
         now = time.monotonic() - self._t0

@@ -17,7 +17,7 @@ from collections import Counter
 from typing import IO
 
 from ..protocol import Message
-from .network import VirtualNetwork
+from .network import VirtualNetwork, is_server_msg
 
 
 def enable_trace(net: VirtualNetwork) -> list[tuple[float, Message]]:
@@ -57,9 +57,10 @@ def summarize(trace: list[tuple[float, Message]],
     reference README.md:17).
 
     Pass the harness's ``nodes``/``services`` id sets to classify
-    server-to-server traffic the way the network ledgers do (src is a
-    node AND dest is a node or service — network.py ``submit`` /
-    process_net.py ``_transmit``).  Without them the prefix heuristic is
+    server-to-server traffic the way the network ledgers do (src AND
+    dest each a node or service, service replies included — network.py
+    ``submit`` / process_net.py ``_transmit``).  Without them the
+    prefix heuristic is
     used, which matches the ledger classification only for service-free
     workloads (no seq-kv/lin-kv traffic).  Note the ledger counts a
     message *before* the drop check while the trace records only
@@ -75,8 +76,7 @@ def summarize(trace: list[tuple[float, Message]],
         by_type[msg.type] += 1
         by_edge[(msg.src, msg.dest)] += 1
         if nodes is not None:
-            s2s = msg.src in nodes and (msg.dest in nodes
-                                        or msg.dest in services)
+            s2s = is_server_msg(msg.src, msg.dest, nodes, services)
         else:
             s2s = (msg.src.startswith(server_prefix)
                    and msg.dest.startswith(server_prefix))

@@ -369,10 +369,13 @@ def run_kafka_faults(n_nodes: int = 4, n_keys: int = 2,
     (logmap.go:46-52, :143-149), and replicate_msg loss under
     partitions exercises the acks=0 stance (README.md:22-24).
 
-    The returned stats include the lin-kv op mix (``kv_by_type``) so
-    callers can assert contention actually happened (cas count strictly
-    above one per acked send) — the traffic regime the flat-latency
-    run_kafka never enters."""
+    The returned stats include the lin-kv op mix (``kv_by_type``),
+    requests AND service replies (read_ok/cas_ok/error — the ledger
+    counts service→node traffic symmetrically, like Maelstrom), so
+    callers can assert contention actually happened: cas count strictly
+    above one per acked send, and lost CAS races visible as code-22
+    ``error`` replies (logmap.go:274-277) — the traffic regime the
+    flat-latency run_kafka never enters."""
     net = _make_net(n_nodes, KafkaProgram, net_cfg=NetConfig(
         latency=latency, seed=seed), services=("lin-kv",),
         partitions=partitions)

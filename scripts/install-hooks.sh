@@ -1,0 +1,8 @@
+#!/bin/sh
+# Install the repo's git hooks (currently: pre-commit = scripts/smoke.sh).
+# Symlinked, so later edits to scripts/smoke.sh take effect immediately.
+set -e
+cd "$(git rev-parse --show-toplevel)"
+chmod +x scripts/smoke.sh
+ln -sf ../../scripts/smoke.sh .git/hooks/pre-commit
+echo "installed pre-commit smoke hook (symlink)"

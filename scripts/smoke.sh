@@ -1,0 +1,19 @@
+#!/bin/sh
+# Full-suite smoke gate: the whole test suite on the virtual 8-device
+# CPU mesh, stop at first failure.  Runs against the STAGED snapshot
+# (a temp checkout of the index), not the working tree, so a partially
+# staged commit cannot land red (VERDICT r2 item 1).  Installed as a
+# symlink at .git/hooks/pre-commit by scripts/install-hooks.sh.
+# Bypass for WIP commits: GG_SKIP_SMOKE=1 or git commit --no-verify.
+set -e
+if [ "${GG_SKIP_SMOKE:-0}" = "1" ]; then
+    echo "smoke: skipped (GG_SKIP_SMOKE=1)"
+    exit 0
+fi
+cd "$(git rev-parse --show-toplevel)"
+tmp=$(mktemp -d)
+trap 'rm -rf "$tmp"' EXIT
+git checkout-index -a --prefix="$tmp/"
+cd "$tmp"
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -x -q

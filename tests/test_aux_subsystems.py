@@ -65,6 +65,34 @@ def test_trace_matches_ledger():
     assert summary["by_type"] == dict(net.ledger.by_type)
 
 
+def test_trace_summary_matches_ledger_on_kv_workload():
+    """The nodes/services-aware classification in summarize() must agree
+    with the network ledger on a workload with seq-kv traffic — service
+    replies (read_ok/cas_ok/error) count on both sides."""
+    from gossip_glomers_tpu.harness.services import KVService
+    from gossip_glomers_tpu.models import CounterProgram
+
+    net = VirtualNetwork()
+    for i in range(3):
+        net.spawn(f"n{i}", CounterProgram())
+    net.add_service(KVService(net, "seq-kv"))
+    trace = tracing.enable_trace(net)
+    net.init_cluster()
+    client = net.client("c1")
+    for d in (2, 3, 4, 5):
+        client.rpc(f"n{d % 3}", {"type": "add", "delta": d})
+        net.run_for(0.3)
+    net.run_for(3.0)
+
+    summary = tracing.summarize(trace, nodes=set(net.nodes),
+                                services=set(net.services))
+    assert summary["server_to_server"] == net.ledger.server_to_server
+    # KV replies are part of the count: ledger-by-type shows them
+    assert net.ledger.server_msgs_by_type["read_ok"] > 0
+    assert (net.ledger.server_msgs_by_type["cas_ok"]
+            + net.ledger.server_msgs_by_type["error"]) > 0
+
+
 # -- checkpoint / resume ------------------------------------------------
 
 
