@@ -29,17 +29,13 @@ BASELINE_TARGET_S = 10.0     # BASELINE.json: "<10 s on a v5e-8"
 
 def main() -> None:
     import jax
-    from jax.sharding import Mesh
 
+    from gossip_glomers_tpu.parallel.mesh import pick_mesh
     from gossip_glomers_tpu.parallel.topology import tree, to_padded_neighbors
     from gossip_glomers_tpu.tpu_sim.broadcast import BroadcastSim, make_inject
 
     devices = jax.devices()
-    mesh = None
-    if len(devices) > 1:
-        # largest power-of-two device count divides N_NODES
-        n_dev = 1 << (len(devices).bit_length() - 1)
-        mesh = Mesh(np.array(devices[:n_dev]), ("nodes",))
+    mesh = pick_mesh()
 
     from gossip_glomers_tpu.tpu_sim.structured import (make_exchange,
                                                        make_sharded_exchange)
@@ -50,9 +46,8 @@ def main() -> None:
     if mesh is not None:
         # halo path: parent/child slice ppermutes, O(block) ICI traffic
         # per round — no all_gather, no redundant full-axis compute
-        sharded = make_sharded_exchange(
-            "tree", N_NODES, int(np.prod(mesh.devices.shape)),
-            branching=BRANCHING)
+        sharded = make_sharded_exchange("tree", N_NODES, mesh.size,
+                                        branching=BRANCHING)
     sim = BroadcastSim(nbrs, n_values=N_VALUES, sync_every=64, mesh=mesh,
                        exchange=make_exchange("tree", N_NODES,
                                               branching=BRANCHING),

@@ -13,12 +13,21 @@ Implementation note: we apply ops linearizably in delivery order.  That is
 the exact lin-kv contract, and a legal (strongest) implementation of
 seq-kv — sequential consistency permits but does not require stale reads.
 An optional ``stale_read_prob`` knob makes seq-kv exercise clients'
-stale-read handling the way Maelstrom's real seq-kv can.
+stale-read handling the way Maelstrom's real seq-kv can: a read may
+serve the previous value of a key for up to ``stale_window`` seconds
+after it was overwritten — but never to a client that has already
+observed the newer value (sequential consistency's per-process order:
+no client ever travels backwards, and read-your-writes holds).  The
+window bounds the weakness the way real sequentially-consistent stores
+converge in practice — once writes quiesce, reads are fresh, so a
+g-counter's read-after-quiescence sum check still passes while its CAS
+path eats genuine stale-read retries (reference add.go:80-88).
 """
 
 from __future__ import annotations
 
 import random
+from collections import Counter
 from typing import Any
 
 from ..protocol import (KEY_DOES_NOT_EXIST, PRECONDITION_FAILED, Message,
@@ -27,17 +36,27 @@ from ..protocol import (KEY_DOES_NOT_EXIST, PRECONDITION_FAILED, Message,
 
 class KVService:
     def __init__(self, network, service_id: str = "seq-kv",
-                 stale_read_prob: float = 0.0) -> None:
+                 stale_read_prob: float = 0.0,
+                 stale_window: float = 1.0) -> None:
         self.network = network
         self.id = service_id
         self.store: dict[str, Any] = {}
         self.history: list[tuple[float, str, str, Any]] = []  # (t, op, key, arg)
         self.stale_read_prob = stale_read_prob
-        self._stale: dict[str, Any] = {}
+        self.stale_window = stale_window
+        self._stale: dict[str, tuple[Any, float]] = {}  # key -> (old, t_overwrite)
+        self._ver: dict[str, int] = {}                  # key -> version counter
+        self._seen: dict[tuple[str, str], int] = {}     # (client, key) -> version
         self._rng = random.Random(network.cfg.seed ^ 0x5EC4)
+        # error replies by RPC code (20 missing-key, 22 CAS mismatch) —
+        # lets workloads assert e.g. that stale reads drove
+        # precondition-failed retries (reference add.go:80-88)
+        self.errors_by_code: Counter = Counter()
 
     def _reply(self, req: Message, body: dict) -> None:
         out = dict(body)
+        if out.get("type") == "error":
+            self.errors_by_code[out.get("code")] += 1
         if req.msg_id is not None:
             out["in_reply_to"] = req.msg_id
         self.network.submit(Message(self.id, req.src, out))
@@ -52,12 +71,21 @@ class KVService:
                     KEY_DOES_NOT_EXIST, f"key {key} not found").to_body())
                 return
             value = self.store[key]
-            if (self.stale_read_prob and key in self._stale
-                    and self._rng.random() < self.stale_read_prob):
-                value = self._stale[key]
+            if self.stale_read_prob and key in self._stale:
+                old, t_over = self._stale[key]
+                # only clients that have NOT yet observed the current
+                # version may be served the previous one (per-process
+                # monotonicity + read-your-writes)
+                behind = (self._seen.get((msg.src, key), 0)
+                          < self._ver.get(key, 0))
+                if (behind and self.network.now - t_over < self.stale_window
+                        and self._rng.random() < self.stale_read_prob):
+                    self._reply(msg, {"type": "read_ok", "value": old})
+                    return
+            self._observe(msg.src, key)
             self._reply(msg, {"type": "read_ok", "value": value})
         elif op == "write":
-            self._record_stale(key)
+            self._record_stale(key, msg.src)
             self.store[key] = body.get("value")
             self.history.append((self.network.now, "write", key,
                                  body.get("value")))
@@ -68,6 +96,7 @@ class KVService:
             if key not in self.store:
                 if create:
                     self.store[key] = to
+                    self._observe(msg.src, key)
                     self.history.append((self.network.now, "cas-create",
                                          key, to))
                     self._reply(msg, {"type": "cas_ok"})
@@ -76,17 +105,29 @@ class KVService:
                         KEY_DOES_NOT_EXIST,
                         f"key {key} not found").to_body())
             elif self.store[key] == frm:
-                self._record_stale(key)
+                self._record_stale(key, msg.src)
                 self.store[key] = to
                 self.history.append((self.network.now, "cas", key, to))
                 self._reply(msg, {"type": "cas_ok"})
             else:
+                # a failed CAS reveals the current value in its error
+                # text, so it counts as observing the current version
+                self._observe(msg.src, key)
                 self._reply(msg, RPCError(
                     PRECONDITION_FAILED,
                     f"expected {frm!r}, had {self.store[key]!r}").to_body())
         else:
             pass  # unknown service op: drop
 
-    def _record_stale(self, key: str) -> None:
+    def _observe(self, client: str, key: str) -> None:
+        if self.stale_read_prob:
+            self._seen[(client, key)] = self._ver.get(key, 0)
+
+    def _record_stale(self, key: str, writer: str) -> None:
+        """Before overwriting ``key``: remember the outgoing value as the
+        servable stale version, bump the key's version, and mark the
+        writer as having observed its own write (read-your-writes)."""
         if self.stale_read_prob and key in self.store:
-            self._stale[key] = self.store[key]
+            self._stale[key] = (self.store[key], self.network.now)
+            self._ver[key] = self._ver.get(key, 0) + 1
+            self._seen[(writer, key)] = self._ver[key]

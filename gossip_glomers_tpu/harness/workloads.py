@@ -59,12 +59,13 @@ def _stats(net: VirtualNetwork, n_ops: int) -> dict:
 def _make_net(n_nodes: int, program_cls, *, net_cfg: NetConfig | None = None,
               services: tuple[str, ...] = (),
               partitions: PartitionSchedule | None = None,
-              program_kwargs: dict | None = None) -> VirtualNetwork:
+              program_kwargs: dict | None = None,
+              service_kwargs: dict | None = None) -> VirtualNetwork:
     net = VirtualNetwork(net_cfg or NetConfig())
     for i in range(n_nodes):
         net.spawn(f"n{i}", program_cls(**(program_kwargs or {})))
     for svc in services:
-        net.add_service(KVService(net, svc))
+        net.add_service(KVService(net, svc, **(service_kwargs or {})))
     if partitions is not None:
         net.drop_fn = partitions.drop_fn()
     net.init_cluster()
@@ -241,11 +242,20 @@ def run_broadcast_mix(n_nodes: int = 25, topology: str = "tree",
 def run_counter(n_nodes: int = 3, n_ops: int = 60, rate: float = 10.0,
                 quiescence: float = 8.0,
                 partitions: PartitionSchedule | None = None,
+                stale_read_prob: float = 0.0,
                 seed: int = 0) -> WorkloadResult:
     """g-counter (BASELINE.json config 3): adds at random nodes, then a
-    read-after-quiescence sum check on every node."""
+    read-after-quiescence sum check on every node.
+
+    ``stale_read_prob`` makes seq-kv return stale reads with that
+    probability (sequential consistency permits them — the consistency
+    level the reference explicitly codes against, add.go:97-118): a
+    stale ``readKV`` makes the next CAS fail precondition (code 22) and
+    re-enter the jittered retry loop (add.go:80-88), without ever
+    corrupting the sum."""
     net = _make_net(n_nodes, CounterProgram, net_cfg=NetConfig(seed=seed),
-                    services=("seq-kv",), partitions=partitions)
+                    services=("seq-kv",), partitions=partitions,
+                    service_kwargs={"stale_read_prob": stale_read_prob})
     client = net.client("c1")
     acked_deltas: list[int] = []
     attempted = 0
@@ -276,7 +286,10 @@ def run_counter(n_nodes: int = 3, n_ops: int = 60, rate: float = 10.0,
                                          attempted_sum=attempted)
     ok = ok and len(acked_deltas) == n_ops
     details["n_acked"] = len(acked_deltas)
-    return WorkloadResult(ok, details, _stats(net, n_ops))
+    stats = _stats(net, n_ops)
+    stats["kv_errors_by_code"] = dict(
+        net.services["seq-kv"].errors_by_code)
+    return WorkloadResult(ok, details, stats)
 
 
 # -- kafka --------------------------------------------------------------

@@ -1,0 +1,30 @@
+"""Device-mesh selection shared by the benchmarks and entry points.
+
+The node axis must divide evenly across the mesh, so the benchmarks use
+the largest power-of-two prefix of the visible devices (ICI-contiguous
+on real TPU slices), optionally capped by the simulated node count.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import Mesh
+
+
+def pick_mesh(max_axis: int | None = None,
+              axis_name: str = "nodes") -> Mesh | None:
+    """1-D mesh over the largest power-of-two device prefix, or None on
+    a single device.  ``max_axis`` caps the mesh size (e.g. at the node
+    count so every shard holds at least one row)."""
+    import jax
+
+    devices = jax.devices()
+    if len(devices) <= 1:
+        return None
+    n_dev = 1 << (len(devices).bit_length() - 1)
+    if max_axis is not None:
+        while n_dev > max_axis:
+            n_dev >>= 1
+    if n_dev <= 1:
+        return None
+    return Mesh(np.array(devices[:n_dev]), (axis_name,))

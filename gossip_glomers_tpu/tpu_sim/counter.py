@@ -170,7 +170,13 @@ class CounterSim:
         # (updateKV -> readKV, add.go:67-71), so all contenders see the
         # new value for their next attempt; idle nodes poll every
         # poll_every rounds (reference 700 ms poll, main.go:50-62).
-        polled = reach & ((state.t % jnp.int32(self.poll_every)) == 0)
+        # poll_every=0 disables the poll loop entirely (for scenarios
+        # round-aligned against a harness run with the poll timer
+        # pushed out of the measurement window).
+        if self.poll_every > 0:
+            polled = reach & ((state.t % jnp.int32(self.poll_every)) == 0)
+        else:
+            polled = jnp.zeros_like(reach)
         cached = jnp.where(want | winner_mask | polled, kv, state.cached)
         attempts = attempts + allsum(
             (polled & ~winner_mask).astype(jnp.uint32)) * jnp.uint32(2)

@@ -7,19 +7,39 @@ Semantics mirrored from the reference node (kafka/log.go, logmap.go):
   fire-and-forget replicate to every peer (sendReplicateMsg,
   log.go:159-175 — "acks=0", loss is acceptable), reply the offset.
 - ``poll``: serve from the LOCAL log only (log.go:79-110).
-- ``commit_offsets``: monotonic max into the KV (logmap.go:134-198).
+- ``commit_offsets``: the read/write/CAS dance (trySetKVOffset,
+  logmap.go:134-165), skipping keys whose local HWM already covers the
+  request (CommitOffset, logmap.go:247-251).
 - ``list_committed_offsets``: local cache only, deliberately not synced
   (log.go:131-156).
+
+**The allocator and the commit dance share one lin-kv key.**  The
+reference addresses the SAME key ``k`` from both paths
+(logmap.go:260,272 vs :138,142,159), so after any send the commit
+dance's read sees the allocator's next-offset value — which is >= any
+honestly-committed offset, so the dance usually ends at the read
+(``readOffset >= offset`` → return readOffset, logmap.go:156-158): TWO
+messages, no CAS, and the node "learns" a commit HWM one past the last
+send (the overshoot quirk).  The CAS/write legs fire only for commits
+beyond the allocator value or on never-touched keys.
 
 Vectorized model: offsets are slots of padded per-key arrays.  The CAS
 contention loop becomes a **rank-within-round allocation**: all sends in
 one round are linearized in (node, slot) order, each getting
-``next_slot[key] + rank`` — the sort/scan equivalent of the reference's
-one-winner-per-CAS-retry loop, and the "offset gen as a collective"
-called for by BASELINE.json config 5.  Replication is one masked
-einsum per round: delivery[dest] = OR over origins of (link alive AND
-origin's new appends) — the full-mesh fire-and-forget as a batched
-matmul, with link loss as a (N, N) boolean mask.
+``current + rank`` where ``current`` is the shared cell's value — the
+sort/scan equivalent of the reference's one-winner-per-CAS-retry loop,
+and the "offset gen as a collective" called for by BASELINE.json
+config 5.  Replication is one masked einsum per round:
+delivery[dest] = OR over origins of (link alive AND origin's new
+appends) — the full-mesh fire-and-forget as a batched matmul, with link
+loss as a (N, N) boolean mask.
+
+Within a round, sends complete before commits (the round-aligned
+equivalent of a harness scenario that issues sends and commits in
+separate instants); commits of one round all read the shared cell
+before any of them writes it, so the first committer in node order wins
+a contended CAS and the rest abort (code 22 is NOT retried — the
+reference's retry predicate tests code 21, logmap.go:46-52,171-181).
 
 State (node axis shardable over the mesh):
 
@@ -27,9 +47,14 @@ State (node axis shardable over the mesh):
   (defaultOffset=1, logmap.go:16).  Replicated: offsets are unique, so
   all replicas agree on content — only *presence* differs per node.
 - ``present (N, K, C) bool`` — does node n hold (key, slot)?
-- ``next_slot (K,) int32``   — the lin-kv allocation high-water mark.
-- ``committed (K,) int32``   — lin-kv committed offsets.
-- ``local_committed (N, K) int32`` — per-node committed cache.
+- ``kv_val (K,) int32``      — THE shared lin-kv cell per key
+  (0 = missing; live values are always >= 1).
+- ``local_committed (N, K) int32`` — ``kd.commitOffset``: set
+  unconditionally by own appends (logmap.go:298), max-bumped by
+  replicate deliveries (logmap.go:309-311), updated with the dance's
+  result by commits (logmap.go:186-197).  In the round-synchronous
+  regime the unconditional own-append set equals a max, because
+  allocated offsets grow monotonically.
 """
 
 from __future__ import annotations
@@ -47,9 +72,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 class KafkaState(NamedTuple):
     log_vals: jnp.ndarray         # (K, C) int32
     present: jnp.ndarray          # (N, K, C) bool
-    next_slot: jnp.ndarray        # (K,) int32
-    committed: jnp.ndarray        # (K,) int32
-    local_committed: jnp.ndarray  # (N, K) int32
+    kv_val: jnp.ndarray           # (K,) int32 — shared lin-kv cell
+    local_committed: jnp.ndarray  # (N, K) int32 — kd.commitOffset
     t: jnp.ndarray                # () int32
     msgs: jnp.ndarray             # () uint32
 
@@ -76,9 +100,9 @@ class KafkaSim:
     """Round-synchronous replicated-log simulator.
 
     Per round, each node submits up to S ``send`` ops and at most one
-    ``commit_offsets`` op (batched as arrays); replication loss is an
-    (N, N) link mask.  ``poll`` / ``list_committed`` are host-side reads
-    with the reference's local-only semantics.
+    ``commit_offsets`` op per key (batched as arrays); replication loss
+    is an (N, N) link mask.  ``poll`` / ``list_committed`` are host-side
+    reads with the reference's local-only semantics.
     """
 
     def __init__(self, n_nodes: int, n_keys: int, capacity: int, *,
@@ -100,8 +124,7 @@ class KafkaSim:
         state = KafkaState(
             log_vals=jnp.full((k, c), -1, jnp.int32),
             present=jnp.zeros((n, k, c), bool),
-            next_slot=jnp.zeros((k,), jnp.int32),
-            committed=jnp.zeros((k,), jnp.int32),
+            kv_val=jnp.zeros((k,), jnp.int32),
             local_committed=jnp.zeros((n, k), jnp.int32),
             t=jnp.int32(0), msgs=jnp.uint32(0))
         if self.mesh is not None:
@@ -117,27 +140,32 @@ class KafkaSim:
     # -- round -------------------------------------------------------------
 
     def _round(self, state: KafkaState, send_key, send_val, commit_req,
-               repl_ok, *, row_ids, widen, reduce_sum,
-               reduce_max) -> KafkaState:
-        """One round: allocate + append + replicate + commit.
+               repl_ok, *, row_ids, widen, reduce_sum, reduce_max,
+               reduce_min) -> KafkaState:
+        """One round: allocate + append + replicate, then commit.
 
         send_key/send_val: (rows, S) int32, key = -1 for no-op.
         commit_req: (rows, K) int32, -1 for no commit of that key.
         repl_ok: (N, N) bool — repl_ok[o, d]: o's replicate_msg reaches d.
-        widen/reduce_sum: identity single-device; all_gather along
-        'nodes' / psum under shard_map.
+        widen/reduce_*: identity single-device; all_gather along
+        'nodes' / psum / pmax / pmin under shard_map.
         """
         n, k_dim, cap = self.n_nodes, self.n_keys, self.capacity
         s_dim = send_key.shape[1]
+        big = jnp.int32(n + 1)
 
         # -- offset allocation (global, linearized in (node, slot) order:
-        #    the reference's lin-kv CAS loop, logmap.go:255-285) --------
+        #    the reference's lin-kv CAS loop, logmap.go:255-285).  The
+        #    shared cell holds the NEXT offset; missing key reads as
+        #    defaultOffset = 1 (logmap.go:262-266).
+        current = jnp.where(state.kv_val > 0, state.kv_val, 1)  # (K,)
         all_key = widen(send_key).reshape(-1)            # (N*S,)
         all_val = widen(send_val).reshape(-1)
         valid = all_key >= 0
         keys_c = jnp.clip(all_key, 0, k_dim - 1)
         rank = _rank_within_key(keys_c, valid)
-        slot = state.next_slot[keys_c] + rank            # (N*S,)
+        offset = current[keys_c] + rank                  # (N*S,)
+        slot = offset - 1
         ok = valid & (slot < cap)
 
         # -- append: content is global (offsets unique ⇒ no conflicts).
@@ -149,7 +177,7 @@ class KafkaSim:
             all_val, mode="drop")
         counts = jnp.zeros((k_dim,), jnp.int32).at[keys_c].add(
             ok.astype(jnp.int32))
-        next_slot = state.next_slot + counts
+        kv_sent = jnp.where(counts > 0, current + counts, state.kv_val)
 
         # new appends per origin node: (N, K, C) one-hot
         origin = jnp.repeat(jnp.arange(n, dtype=jnp.int32), s_dim)
@@ -163,11 +191,72 @@ class KafkaSim:
             new_mask.astype(jnp.int8)) > 0                # (N, K, C)
         present = state.present | deliver[row_ids] | new_mask[row_ids]
 
-        # -- commits: monotonic max (logmap.go:134-198); the local cache
-        #    tracks only this node's own commits (log.go:131-156) -------
-        committed = jnp.maximum(
-            state.committed, reduce_max(jnp.max(commit_req, axis=0)))
-        local_committed = jnp.maximum(state.local_committed, commit_req)
+        # -- local HWM after sends: own append sets kd.commitOffset
+        #    unconditionally (logmap.go:298; == max here, offsets grow),
+        #    replicate delivery max-bumps it (logmap.go:309-311).
+        own_off = jnp.zeros((n, k_dim), jnp.int32).at[
+            origin, scat_k].max(jnp.where(ok, offset, 0), mode="drop")
+        # max delivered offset = max over reachable origins of their max
+        # new offset (a tiny (N,N)x(N,K) max-matmul — avoids re-reducing
+        # the (N,K,C) delivery tensor)
+        deliv_off = jnp.max(
+            jnp.where(repl_ok[:, :, None], own_off[:, None, :], 0),
+            axis=0)                                       # (N, K)
+        hwm = jnp.maximum(state.local_committed,
+                          jnp.maximum(own_off[row_ids],
+                                      deliv_off[row_ids]))
+
+        # -- commits (after this round's sends).  Local skip when the
+        #    HWM covers the request (logmap.go:247-251); otherwise the
+        #    dance reads the SHARED cell:
+        #      read >= req  → done, learn the read value (2 msgs — the
+        #                     common case once the key has sends;
+        #                     logmap.go:156-158, the overshoot quirk)
+        #      read <  req  → CAS read→req; first committer in node
+        #                     order wins, losers get code 22 and ABORT
+        #                     (the retry predicate tests code 21,
+        #                     logmap.go:46-52,171-181) — 4 msgs each
+        #      missing key  → blind create-write; every writer succeeds
+        #                     and the LAST one's value lands (a lin-kv
+        #                     write cannot fail, so the reference's
+        #                     code-21 re-run at logmap.go:143-149 is
+        #                     unreachable against the actual service
+        #                     contract) — 4 msgs each.
+        #    Timeout re-runs (logmap.go:177-181) belong to the fault
+        #    regime the wall-clock harness ledger covers; they have no
+        #    round-synchronous analogue here.
+        req = commit_req                                  # (rows, K)
+        rows_col = row_ids[:, None]
+        # offsets are >= 1 everywhere (defaultOffset, logmap.go:16); a
+        # commit of 0 would write the cell's "missing" sentinel, so it
+        # is treated as a no-op rather than allowed to desync the cell
+        want = req >= 1
+        skip = want & (hwm > 0) & (hwm >= req)
+        active = want & ~skip
+        exists = (kv_sent > 0)[None, :]
+        readv = kv_sent[None, :]
+        read_only = active & exists & (req <= readv)
+        need_cas = active & exists & (req > readv)
+        writers = active & ~exists
+
+        cas_win = reduce_min(jnp.min(
+            jnp.where(need_cas, rows_col, big), axis=0))          # (K,)
+        wrt_last = reduce_max(jnp.max(
+            jnp.where(writers, rows_col, -1), axis=0))            # (K,)
+        cas_req = reduce_sum(jnp.sum(
+            jnp.where(need_cas & (rows_col == cas_win[None, :]), req, 0),
+            axis=0))
+        wrt_req = reduce_sum(jnp.sum(
+            jnp.where(writers & (rows_col == wrt_last[None, :]), req, 0),
+            axis=0))
+        kv_val = jnp.where(cas_win < big, cas_req,
+                           jnp.where(wrt_last >= 0, wrt_req, kv_sent))
+
+        learn = jnp.where(
+            need_cas & (rows_col == cas_win[None, :]), req,
+            jnp.where(read_only, readv,
+                      jnp.where(writers, req, 0)))
+        local_committed = jnp.maximum(hwm, learn)
 
         # -- ledger: CAS-contention-aware KV accounting.  A send that is
         #    rank r among this round's senders of its key loses the CAS
@@ -176,8 +265,9 @@ class KafkaSim:
         #    read + read_ok + cas + cas-reply = 4 messages each, capped
         #    at defaultKVRetries (logmap.go:19).  `rank` is global and
         #    identical on every shard, so its sum is NOT psum-reduced.
-        #    Commits stay 4 flat: the commit dance does not retry a lost
-        #    CAS (only code 21/timeout — the quirk at logmap.go:46-52).
+        #    Commits: 2 per active dance (read + reply) + 2 more when it
+        #    writes (CAS or create-write leg, winners and losers alike);
+        #    locally-skipped commits cost nothing.
         #    Replication: N-1 fire-and-forget replicate_msg per send.
         attempts = jnp.minimum(rank + 1, self.kv_retries)
         kv_send_msgs = jnp.sum(
@@ -185,12 +275,13 @@ class KafkaSim:
             dtype=jnp.uint32)
         n_sends = reduce_sum(jnp.sum(
             (send_key >= 0).astype(jnp.uint32)))
-        n_commits = reduce_sum(jnp.sum(
-            (commit_req >= 0).astype(jnp.uint32)))
+        n_active = reduce_sum(jnp.sum(active.astype(jnp.uint32)))
+        n_write_leg = reduce_sum(jnp.sum(
+            (need_cas | writers).astype(jnp.uint32)))
         msgs = (state.msgs + kv_send_msgs
                 + n_sends * jnp.uint32(n - 1)
-                + n_commits * jnp.uint32(4))
-        return KafkaState(log_vals, present, next_slot, committed,
+                + n_active * jnp.uint32(2) + n_write_leg * jnp.uint32(2))
+        return KafkaState(log_vals, present, kv_val,
                           local_committed, state.t + 1, msgs)
 
     def _round_1dev(self, state, send_key, send_val, commit_req,
@@ -198,11 +289,26 @@ class KafkaSim:
         """Single-device round wiring (identity collectives) — shared by
         the stepwise and the scanned (run_rounds) drivers."""
         row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+        ident = lambda x: x
         return self._round(state, send_key, send_val, commit_req,
-                           repl_ok, row_ids=row_ids,
-                           widen=lambda x: x,
-                           reduce_sum=lambda x: x,
-                           reduce_max=lambda x: x)
+                           repl_ok, row_ids=row_ids, widen=ident,
+                           reduce_sum=ident, reduce_max=ident,
+                           reduce_min=ident)
+
+    def _state_spec(self):
+        return KafkaState(P(None, None), P("nodes", None, None),
+                          P(), P("nodes", None), P(), P())
+
+    def _shard_collectives(self, block: int):
+        row_ids = (lax.axis_index("nodes") * block
+                   + jnp.arange(block, dtype=jnp.int32))
+        return dict(
+            row_ids=row_ids,
+            widen=lambda x: lax.all_gather(x, "nodes", axis=0,
+                                           tiled=True),
+            reduce_sum=lambda x: lax.psum(x, "nodes"),
+            reduce_max=lambda x: lax.pmax(x, "nodes"),
+            reduce_min=lambda x: lax.pmin(x, "nodes"))
 
     def _build_step(self):
         if self.mesh is None:
@@ -210,10 +316,9 @@ class KafkaSim:
 
         mesh = self.mesh
         node2 = P("nodes", None)
-        state_spec = KafkaState(P(None, None), P("nodes", None, None),
-                                P(), P(), node2, P(), P())
+        state_spec = self._state_spec()
 
-        # check_vma=False: log_vals/next_slot are computed identically on
+        # check_vma=False: log_vals/kv_val are computed identically on
         # every shard from all_gather-ed send batches — genuinely
         # replicated, but derived from gathered (varying-marked) values,
         # which the static replication checker cannot prove.
@@ -223,16 +328,9 @@ class KafkaSim:
             in_specs=(state_spec, node2, node2, node2, P(None, None)),
             out_specs=state_spec, check_vma=False)
         def step(state, send_key, send_val, commit_req, repl_ok):
-            block = send_key.shape[0]
-            row_ids = (lax.axis_index("nodes") * block
-                       + jnp.arange(block, dtype=jnp.int32))
             return self._round(
                 state, send_key, send_val, commit_req, repl_ok,
-                row_ids=row_ids,
-                widen=lambda x: lax.all_gather(x, "nodes", axis=0,
-                                               tiled=True),
-                reduce_sum=lambda x: lax.psum(x, "nodes"),
-                reduce_max=lambda x: lax.pmax(x, "nodes"))
+                **self._shard_collectives(send_key.shape[0]))
 
         return step
 
@@ -243,11 +341,9 @@ class KafkaSim:
         """R pre-staged rounds as ONE device program (``lax.scan``):
         send_key/send_val are (R, N, S), commit_req (R, N, K).  One
         dispatch instead of R — per-round dispatch latency dominates the
-        stepwise driver on small rounds.  Single-device only (the
-        stepwise path covers meshes)."""
-        if self.mesh is not None:
-            raise NotImplementedError("run_rounds is single-device; "
-                                      "use step() on meshes")
+        stepwise driver on small rounds.  On a mesh the scan body is the
+        same sharded round as step() (scan under shard_map), so
+        benchmark config 5 runs multi-device with identical results."""
         r = send_key.shape[0]
         if commit_req is None:
             commit_req = np.full((r, self.n_nodes, self.n_keys), -1,
@@ -255,18 +351,41 @@ class KafkaSim:
         if repl_ok is None:
             repl_ok = np.ones((self.n_nodes, self.n_nodes), bool)
         if self._run_rounds is None:
-            @jax.jit
-            def run(state, sks, svs, crs, repl):
-                def body(s, xs):
-                    sk, sv, cr = xs
-                    return self._round_1dev(s, sk, sv, cr, repl), None
-                out, _ = lax.scan(body, state, (sks, svs, crs))
-                return out
+            if self.mesh is None:
+                @jax.jit
+                def run(state, sks, svs, crs, repl):
+                    def body(s, xs):
+                        sk, sv, cr = xs
+                        return self._round_1dev(s, sk, sv, cr, repl), None
+                    out, _ = lax.scan(body, state, (sks, svs, crs))
+                    return out
+            else:
+                node3 = P(None, "nodes", None)
+                state_spec = self._state_spec()
+
+                @jax.jit
+                @functools.partial(
+                    jax.shard_map, mesh=self.mesh,
+                    in_specs=(state_spec, node3, node3, node3,
+                              P(None, None)),
+                    out_specs=state_spec, check_vma=False)
+                def run(state, sks, svs, crs, repl):
+                    coll = self._shard_collectives(sks.shape[1])
+
+                    def body(s, xs):
+                        sk, sv, cr = xs
+                        return self._round(s, sk, sv, cr, repl,
+                                           **coll), None
+                    out, _ = lax.scan(body, state, (sks, svs, crs))
+                    return out
             self._run_rounds = run
-        return self._run_rounds(
-            state, jnp.asarray(send_key, jnp.int32),
-            jnp.asarray(send_val, jnp.int32),
-            jnp.asarray(commit_req, jnp.int32), jnp.asarray(repl_ok))
+        args = [jnp.asarray(send_key, jnp.int32),
+                jnp.asarray(send_val, jnp.int32),
+                jnp.asarray(commit_req, jnp.int32)]
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P(None, "nodes", None))
+            args = [jax.device_put(a, sh) for a in args]
+        return self._run_rounds(state, *args, jnp.asarray(repl_ok))
 
     def step(self, state: KafkaState,
              send_key: np.ndarray | None = None,
@@ -297,8 +416,8 @@ class KafkaSim:
         """(N, S) int32 — the offsets the sends of this round were acked
         with (``send_ok`` replies), or -1.  Computed host-side with the
         same (node, slot)-order linearization as the device round."""
-        ns = state_before  # allocation depends only on pre-round next_slot
-        base = np.asarray(ns.next_slot)
+        kv = np.asarray(state_before.kv_val)
+        base = np.where(kv > 0, kv, 1)
         flat = np.asarray(send_key, np.int32).reshape(-1)
         seen: dict[int, int] = {}
         out = np.full(flat.shape, -1, np.int32)
@@ -307,9 +426,9 @@ class KafkaSim:
                 continue
             r = seen.get(int(k), 0)
             seen[int(k)] = r + 1
-            slot = int(base[k]) + r
-            if slot < self.capacity:
-                out[i] = slot + 1       # offset = slot + defaultOffset(1)
+            off = int(base[k]) + r
+            if off - 1 < self.capacity:
+                out[i] = off
         return out.reshape(send_key.shape)
 
     def poll(self, state: KafkaState, node: int, key: int,
@@ -331,6 +450,9 @@ class KafkaSim:
         lc = np.asarray(state.local_committed[node])
         return {k: int(lc[k]) for k in range(self.n_keys) if lc[k] > 0}
 
-    def committed_kv(self, state: KafkaState) -> dict[int, int]:
-        c = np.asarray(state.committed)
+    def lin_kv(self, state: KafkaState) -> dict[int, int]:
+        """The shared lin-kv cells (key -> value).  After sends this is
+        the allocator's next offset, NOT a committed offset — the two
+        paths share the key (see module docstring)."""
+        c = np.asarray(state.kv_val)
         return {k: int(c[k]) for k in range(self.n_keys) if c[k] > 0}

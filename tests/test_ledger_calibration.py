@@ -1,0 +1,225 @@
+"""tpu_sim ledger calibration against the virtual harness (VERDICT r2
+item 3): round-aligned scenarios where the SAME ops driven through the
+real challenge programs (models/*.py + harness KV services) and through
+the vectorized simulators must produce identical KV-traffic counts —
+with service replies included, the way Maelstrom counts
+(reference README.md:17) — and identical observable state.
+
+The broadcast sim got this treatment in round 2
+(test_srv_ledger_sync_waves_match_virtual_harness); these tests do the
+same for the counter's CAS-contention ladder (add.go:67-95) and the
+kafka allocator/commit dances (logmap.go:134-198, :255-285).
+"""
+
+import numpy as np
+
+from gossip_glomers_tpu.harness.network import VirtualNetwork
+from gossip_glomers_tpu.harness.services import KVService
+from gossip_glomers_tpu.models import CounterProgram, KafkaProgram
+from gossip_glomers_tpu.tpu_sim import CounterSim, KafkaSim
+from gossip_glomers_tpu.utils.config import CounterConfig, NetConfig
+
+
+# -- counter ------------------------------------------------------------
+
+
+def _counter_net(n, cfg):
+    net = VirtualNetwork(NetConfig(seed=0))
+    for i in range(n):
+        net.spawn(f"n{i}", CounterProgram(cfg))
+    net.add_service(KVService(net, "seq-kv"))
+    net.init_cluster()
+    # Pre-seed the key from a client (zero server-message cost): the
+    # very first readKV otherwise takes the KeyDoesNotExist init path
+    # (read + error + CAS-create + cas_ok, add.go:97-118), which costs
+    # 6 per first attempt instead of the steady-state 4.  The sim
+    # models the steady state; the seed pins both sides to it.
+    net.client("c9").rpc("seq-kv", {"type": "write", "key": cfg.kv_key,
+                                    "value": 0})
+    net.run_for(0.0)
+    return net
+
+
+def test_counter_ledger_matches_harness_contention():
+    """N simultaneous adds, one CAS winner per retry wave: the harness's
+    jitter-free retry ladder (add.go:56-58 with retry_min == retry_max)
+    serializes exactly like CounterSim's one-winner-per-round cas mode —
+    4 messages (read + read_ok + cas + reply) per contender per wave."""
+    n = 6
+    cfg = CounterConfig(flush_interval=1.0, retry_min=0.1, retry_max=0.1,
+                        poll_interval=1e6)
+    net = _counter_net(n, cfg)
+    client = net.client("c1")
+    for i in range(n):
+        client.rpc(f"n{i}", {"type": "add", "delta": i + 1})
+    net.run_for(0.0)
+    base = net.ledger.server_to_server
+    assert base == 0
+    # flush tick at t=1.0, retry waves every 0.1 s; stop before the
+    # winners' next (idle) flush tick at t=2.0
+    net.run_for(1.0 + 0.1 * n)
+    harness_msgs = net.ledger.server_to_server - base
+    harness_kv = net.services["seq-kv"].store[cfg.kv_key]
+
+    sim = CounterSim(n, mode="cas", poll_every=0)
+    st = sim.add(sim.init_state(), np.arange(1, n + 1, dtype=np.int32))
+    st = sim.run(st, n)
+
+    want = 4 * n * (n + 1) // 2                   # 4 * (n + n-1 + ... + 1)
+    assert harness_msgs == want
+    assert int(st.msgs) == harness_msgs
+    assert int(sim.kv_value(st)) == harness_kv == n * (n + 1) // 2
+
+
+def test_counter_ledger_matches_harness_polls():
+    """Idle poll traffic: Q poll waves of read + read_ok per node
+    (counter/main.go:50-62) == Q sim rounds at poll_every=1."""
+    n, q = 4, 5
+    cfg = CounterConfig(flush_interval=1e6, poll_interval=0.5)
+    net = _counter_net(n, cfg)
+    base = net.ledger.server_to_server
+    net.run_for(0.5 * q + 0.2)                   # waves at 0.5, 1.0, ...
+    harness_msgs = net.ledger.server_to_server - base
+
+    sim = CounterSim(n, mode="cas", poll_every=1)
+    st = sim.run(sim.init_state(), q)
+
+    assert harness_msgs == 2 * n * q
+    assert int(st.msgs) == harness_msgs
+
+
+# -- kafka --------------------------------------------------------------
+
+
+def _kafka_net(n):
+    net = VirtualNetwork(NetConfig(seed=0))
+    for i in range(n):
+        net.spawn(f"n{i}", KafkaProgram())
+    net.add_service(KVService(net, "lin-kv"))
+    net.init_cluster()
+    return net
+
+
+def test_kafka_ledger_matches_harness():
+    """One scenario, five phases, per-phase message parity between the
+    harness ledger (replies included) and KafkaSim's analytic ledger,
+    plus end-state parity (logs, lin-kv cells, local committed HWMs).
+
+    Phases: (A) 4-way burst sends on one hot key with replication to
+    n4 cut — the allocator CAS ladder (logmap.go:255-285);
+    (B1) a commit whose dance ends at the read with the overshoot learn
+    (logmap.go:156-158); (B2) a locally-skipped commit
+    (logmap.go:247-251); (B3) a create-write race on a fresh key
+    (logmap.go:140-151); (B4) a contended commit CAS where the loser
+    aborts on code 22 (the retry predicate tests 21 —
+    logmap.go:46-52,171-181)."""
+    n = 5
+    net = _kafka_net(n)
+    client = net.client("c1")
+    blocked = {"on": False}
+    net.drop_fn = (lambda src, dest, now:
+                   blocked["on"] and src.startswith("n") and dest == "n4")
+
+    sim = KafkaSim(n, 2, capacity=64, max_sends=1)
+    st = sim.init_state()
+    repl = np.ones((n, n), bool)
+    repl[:, 4] = False
+    repl[4, 4] = True
+
+    def phase_delta():
+        before = net.ledger.server_to_server
+        return lambda: net.ledger.server_to_server - before
+
+    # -- A: burst sends, nodes 0..3, key k0, replication to n4 cut ------
+    blocked["on"] = True
+    delta = phase_delta()
+    acks = {}
+    for i in range(4):
+        client.rpc(f"n{i}", {"type": "send", "key": "k0", "msg": 10 + i},
+                   lambda rep, i=i: acks.__setitem__(i, rep.body["offset"]))
+    net.run_for(0.0)
+    blocked["on"] = False
+    harness_a = delta()
+
+    sk = np.array([[0], [0], [0], [0], [-1]], np.int32)
+    sv = np.array([[10], [11], [12], [13], [0]], np.int32)
+    offs = sim.alloc_offsets(st, sk)
+    before = int(st.msgs)
+    st = sim.step(st, sk, sv, repl_ok=repl)
+    sim_a = int(st.msgs) - before
+
+    # allocator ladder: rank r pays 4*(r+1); 4 sends replicate to 4
+    # peers each (drops are charged — the ledger counts before the cut)
+    assert harness_a == 4 * (1 + 2 + 3 + 4) + 4 * (n - 1) == 56
+    assert sim_a == harness_a
+    assert acks == {0: 1, 1: 2, 2: 3, 3: 4}
+    assert [int(offs[i, 0]) for i in range(4)] == [1, 2, 3, 4]
+    assert net.services["lin-kv"].store["k0"] == sim.lin_kv(st)[0] == 5
+
+    # -- B1: n4 (empty HWM) commits k0@3 — read 5 >= 3, learns 5 --------
+    delta = phase_delta()
+    client.rpc("n4", {"type": "commit_offsets", "offsets": {"k0": 3}})
+    net.run_for(0.0)
+    cr = np.full((n, 2), -1, np.int32)
+    cr[4, 0] = 3
+    before = int(st.msgs)
+    st = sim.step(st, commit_req=cr, repl_ok=repl)
+    assert delta() == int(st.msgs) - before == 2
+    assert sim.list_committed(st, 4) == {0: 5}    # the overshoot quirk
+
+    # -- B2: n0 (HWM 4 via replication) commits k0@4 — local skip -------
+    delta = phase_delta()
+    client.rpc("n0", {"type": "commit_offsets", "offsets": {"k0": 4}})
+    net.run_for(0.0)
+    cr = np.full((n, 2), -1, np.int32)
+    cr[0, 0] = 4
+    before = int(st.msgs)
+    st = sim.step(st, commit_req=cr, repl_ok=repl)
+    assert delta() == int(st.msgs) - before == 0
+
+    # -- B3: n1 and n2 race create-writes on fresh key k1 ---------------
+    delta = phase_delta()
+    client.rpc("n1", {"type": "commit_offsets", "offsets": {"k1": 7}})
+    client.rpc("n2", {"type": "commit_offsets", "offsets": {"k1": 9}})
+    net.run_for(0.0)
+    cr = np.full((n, 2), -1, np.int32)
+    cr[1, 1] = 7
+    cr[2, 1] = 9
+    before = int(st.msgs)
+    st = sim.step(st, commit_req=cr, repl_ok=repl)
+    assert delta() == int(st.msgs) - before == 8   # 2 dances of 4
+    # both writes succeed; the LAST one lands in the cell
+    assert net.services["lin-kv"].store["k1"] == sim.lin_kv(st)[1] == 9
+    assert sim.list_committed(st, 1)[1] == 7
+    assert sim.list_committed(st, 2)[1] == 9
+
+    # -- B4: n3 and n4 contend a commit CAS on k1@12 — first wins,
+    #    loser gets code 22 and aborts --------------------------------
+    delta = phase_delta()
+    client.rpc("n3", {"type": "commit_offsets", "offsets": {"k1": 12}})
+    client.rpc("n4", {"type": "commit_offsets", "offsets": {"k1": 12}})
+    net.run_for(0.0)
+    cr = np.full((n, 2), -1, np.int32)
+    cr[3, 1] = 12
+    cr[4, 1] = 12
+    before = int(st.msgs)
+    st = sim.step(st, commit_req=cr, repl_ok=repl)
+    assert delta() == int(st.msgs) - before == 8   # 2 dances of 4
+    assert net.services["lin-kv"].store["k1"] == sim.lin_kv(st)[1] == 12
+    assert sim.list_committed(st, 3)[1] == 12
+    assert sim.list_committed(st, 4).get(1) is None  # loser learns nothing
+
+    # -- end-state parity: logs and local HWMs node by node -------------
+    for i in range(n):
+        reply = {}
+        client.rpc(f"n{i}", {"type": "poll", "offsets": {"k0": 0}},
+                   lambda rep: reply.update(rep.body["msgs"]))
+        net.run_for(0.0)
+        assert reply["k0"] == sim.poll(st, i, 0, 0), f"n{i}"
+        listed = {}
+        client.rpc(f"n{i}", {"type": "list_committed_offsets",
+                             "keys": ["k0", "k1"]},
+                   lambda rep: listed.update(rep.body["offsets"]))
+        net.run_for(0.0)
+        want = {f"k{k}": v for k, v in sim.list_committed(st, i).items()}
+        assert listed == want, f"n{i}: {listed} != {want}"
