@@ -37,21 +37,29 @@ def main() -> None:
     devices = jax.devices()
     mesh = pick_mesh()
 
-    from gossip_glomers_tpu.tpu_sim.structured import (make_exchange,
-                                                       make_sharded_exchange)
+    from gossip_glomers_tpu.tpu_sim.structured import (
+        make_exchange, make_sharded_exchange, make_sharded_sync_diff,
+        make_sync_diff)
 
     nbrs = to_padded_neighbors(tree(N_NODES, branching=BRANCHING))
     inject = make_inject(N_NODES, N_VALUES)
-    sharded = None
+    sharded = sharded_diff = None
     if mesh is not None:
         # halo path: parent/child slice ppermutes, O(block) ICI traffic
         # per round — no all_gather, no redundant full-axis compute
         sharded = make_sharded_exchange("tree", N_NODES, mesh.size,
                                         branching=BRANCHING)
+        sharded_diff = make_sharded_sync_diff("tree", N_NODES, mesh.size,
+                                              branching=BRANCHING)
+    # the sync-diff closures keep the reference-accounted server ledger
+    # (Maelstrom-comparable msgs/op) live on the structured path
     sim = BroadcastSim(nbrs, n_values=N_VALUES, sync_every=64, mesh=mesh,
                        exchange=make_exchange("tree", N_NODES,
                                               branching=BRANCHING),
-                       sharded_exchange=sharded)
+                       sharded_exchange=sharded,
+                       sync_diff=make_sync_diff("tree", N_NODES,
+                                                branching=BRANCHING),
+                       sharded_sync_diff=sharded_diff)
 
     # Warmup: compile the fused runner and run one full convergence.
     state, rounds = sim.run_fused(inject)
@@ -78,6 +86,10 @@ def main() -> None:
         "vs_baseline": round(BASELINE_TARGET_S / elapsed, 2),
         "rounds": rounds,
         "msgs": int(state.msgs),
+        # Maelstrom-comparable accounting: server messages (broadcast +
+        # ack + anti-entropy reads/pushes) per broadcast op
+        "srv_msgs": sim.server_msgs(state),
+        "srv_msgs_per_op": round(sim.server_msgs(state) / N_VALUES, 1),
         "n_devices": len(devices),
     }))
 

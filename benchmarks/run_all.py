@@ -99,22 +99,28 @@ def config4_epidemic_1m():
     from gossip_glomers_tpu.tpu_sim.broadcast import (BroadcastSim,
                                                       make_inject)
     from gossip_glomers_tpu.tpu_sim.structured import (
-        make_exchange, make_sharded_exchange)
+        make_exchange, make_sharded_exchange, make_sharded_sync_diff,
+        make_sync_diff)
 
     n = 1 << 20
     strides = expander_strides(n, degree=8, seed=0)
     nbrs = circulant(n, strides)
     mesh = pick_mesh()
-    sharded_ex = None
+    sharded_ex = sharded_diff = None
     if mesh is not None:
         # halo path: O(block) ppermutes per stride instead of an
         # O(N) all_gather per round
         sharded_ex = make_sharded_exchange("circulant", n, mesh.size,
                                            strides=strides)
+        sharded_diff = make_sharded_sync_diff("circulant", n, mesh.size,
+                                              strides=strides)
     sim = BroadcastSim(nbrs, n_values=32, sync_every=64, mesh=mesh,
                        exchange=make_exchange("circulant", n,
                                               strides=strides),
-                       sharded_exchange=sharded_ex)
+                       sharded_exchange=sharded_ex,
+                       sync_diff=make_sync_diff("circulant", n,
+                                                strides=strides),
+                       sharded_sync_diff=sharded_diff)
     inject = make_inject(n, 32)
     state, rounds = sim.run_fused(inject)  # compile + warm
     jax.block_until_ready(state.received)
@@ -130,6 +136,7 @@ def config4_epidemic_1m():
         "rounds": int(state.t),
         "wall_s": round(dt, 4),
         "msgs": int(state.msgs),
+        "srv_msgs": sim.server_msgs(state),
     }
 
 

@@ -293,21 +293,46 @@ def _round_wm(state: BroadcastState, *, deg: jnp.ndarray, sync_every: int,
               widen: Callable[[jnp.ndarray], jnp.ndarray] = lambda p: p,
               reduce_sum: Callable[[jnp.ndarray], jnp.ndarray] = lambda s: s,
               local_slice: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
+              sync_diff: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
+              sync_base_once: Callable[[jnp.ndarray], jnp.ndarray]
+              = lambda x: x,
               ) -> BroadcastState:
     """Words-major round for structured topologies: state is (W, N) so
     the node axis packs TPU lanes densely (the node-major layout wastes
     127/128 of each tile at W=1 — see structured.py).  No partition
     masks (structured delivery has no per-edge addressing); ``deg`` is
-    the per-node live degree for the message ledger."""
+    the per-node live degree for the message ledger.
+
+    With ``sync_diff`` (structured.make_sync_diff /
+    make_sharded_sync_diff), the round also keeps the
+    reference-accounted server ledger: same formulas as the gather
+    path's accounting in :func:`_round` with live degree == topology
+    degree (the structured path runs fault-free), and the anti-entropy
+    pairwise diff from per-direction structured deliveries instead of
+    per-edge gathers — bit-identical totals, no all_gather."""
     is_sync = (state.t % jnp.int32(sync_every) == 0) & (state.t > 0)
     payload = jnp.where(is_sync, state.received, state.frontier)
     payload_full = widen(payload)
     pc = _popcount(payload).sum(axis=0).astype(jnp.uint32)    # (n_local,)
     sent = reduce_sum(jnp.sum(pc * deg, dtype=jnp.uint32))
+    if state.srv_msgs is None:
+        srv = None
+    else:
+        d = deg.astype(jnp.int32)
+        pcf = _popcount(state.frontier).sum(axis=0).astype(jnp.uint32)
+        coef = jnp.where(state.t == 0, 2 * d,
+                         jnp.maximum(2 * d - 2, 0)).astype(jnp.uint32)
+        flood = jnp.sum(pcf * coef, dtype=jnp.uint32)
+        base = sync_base_once(
+            jnp.sum(2 * d, dtype=jnp.int32).astype(jnp.uint32))
+        diff = sync_diff(state.received)
+        srv = state.srv_msgs + reduce_sum(
+            flood + jnp.where(is_sync, base + 2 * diff, jnp.uint32(0)))
     inbox = local_slice(exchange(payload_full))
     new = inbox & ~state.received
     return BroadcastState(received=state.received | new, frontier=new,
-                          t=state.t + 1, msgs=state.msgs + sent)
+                          t=state.t + 1, msgs=state.msgs + sent,
+                          srv_msgs=srv)
 
 
 class BroadcastSim:
@@ -339,13 +364,23 @@ class BroadcastSim:
                  exchange: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
                  sharded_exchange: Callable[[jnp.ndarray], jnp.ndarray]
                  | None = None,
+                 sync_diff: Callable[[jnp.ndarray], jnp.ndarray]
+                 | None = None,
+                 sharded_sync_diff: Callable[[jnp.ndarray], jnp.ndarray]
+                 | None = None,
                  delays: np.ndarray | None = None,
                  srv_ledger: bool = True,
                  ) -> None:
         """``srv_ledger``: keep the reference-accounted server-message
         ledger (default).  It costs a second adjacency pass per round
         (the sync pairwise diff), which roughly doubles gather-path
-        round time — throughput benchmarks at scale pass False."""
+        round time — throughput benchmarks at scale pass False.
+
+        On the words-major structured path the ledger needs the
+        matching diff closure: ``sync_diff``
+        (structured.make_sync_diff) single-device, plus
+        ``sharded_sync_diff`` (structured.make_sharded_sync_diff) for
+        the halo path on a mesh."""
         n = nbrs.shape[0]
         self.n_nodes = n
         self.n_values = n_values
@@ -361,9 +396,17 @@ class BroadcastSim:
         if sharded_exchange is not None and exchange is None:
             raise ValueError("sharded_exchange requires exchange")
         self.words_major = exchange is not None
-        # server-ledger exists only on the gather path (the words-major
-        # structured exchange materializes no per-edge terms to diff)
-        self._srv_on = srv_ledger and not self.words_major
+        self.sync_diff = sync_diff
+        self.sharded_sync_diff = sharded_sync_diff
+        # the words-major ledger needs a structured per-edge diff: the
+        # single-device closure off-mesh, the halo closure on-mesh
+        if self.words_major:
+            self._srv_on = srv_ledger and (
+                sync_diff is not None if mesh is None
+                else (sharded_exchange is not None
+                      and sharded_sync_diff is not None))
+        else:
+            self._srv_on = srv_ledger
         if self.words_major and self.parts.starts.shape[0] > 0:
             raise ValueError(
                 "structured exchange cannot apply per-edge partition "
@@ -486,13 +529,22 @@ class BroadcastSim:
         local block back out (n_shards-fold redundant compute and
         O(N) ICI traffic per round)."""
         mesh_axes = tuple(self.mesh.axis_names)
+        if "words" in mesh_axes:
+            # per-word-shard popcounts psum linearly; the per-node sync
+            # base (reads/read_oks) must count once across word shards
+            sync_base_once = lambda b: jnp.where(  # noqa: E731
+                lax.axis_index("words") == 0, b, jnp.uint32(0))
+        else:
+            sync_base_once = lambda b: b  # noqa: E731
         if self.sharded_exchange is not None:
             # halo path: the exchange maps local block -> local block
             # with O(block) ppermutes; no all_gather, no slice.
             return _round_wm(
                 state, deg=deg, sync_every=self.sync_every,
                 exchange=self.sharded_exchange,
-                reduce_sum=lambda s: lax.psum(s, mesh_axes))
+                reduce_sum=lambda s: lax.psum(s, mesh_axes),
+                sync_diff=self.sharded_sync_diff,
+                sync_base_once=sync_base_once)
         block = state.received.shape[1]
         start = lax.axis_index("nodes") * block
         return _round_wm(
@@ -521,7 +573,8 @@ class BroadcastSim:
                 def step_wm(state: BroadcastState, deg) -> BroadcastState:
                     return _round_wm(state, deg=deg,
                                      sync_every=sync_every,
-                                     exchange=self.exchange)
+                                     exchange=self.exchange,
+                                     sync_diff=self.sync_diff)
                 return lambda state, nbrs, nbr_mask: step_wm(state,
                                                              self.deg)
 
@@ -606,7 +659,8 @@ class BroadcastSim:
                     if wm:
                         return _round_wm(s, deg=self.deg,
                                          sync_every=sync_every,
-                                         exchange=self.exchange)
+                                         exchange=self.exchange,
+                                         sync_diff=self.sync_diff)
                     return flood_step(s, nbrs=nbrs, nbr_mask=nbr_mask,
                                       parts=parts, sync_every=sync_every,
                                       delays=self.delays)
@@ -750,11 +804,15 @@ class BroadcastSim:
 
     def server_msgs(self, state: BroadcastState) -> int:
         """Reference-accounted server-to-server message total (what the
-        Maelstrom/harness ledger reads for the same run); gather path
-        only."""
+        Maelstrom/harness ledger reads for the same run).  Available on
+        the gather path and, given the matching ``sync_diff`` /
+        ``sharded_sync_diff`` closures, on the words-major structured
+        path too."""
         if state.srv_msgs is None:
-            raise ValueError("server-message ledger exists only on the "
-                             "adjacency-gather path")
+            raise ValueError(
+                "server-message ledger is off: srv_ledger=False, or a "
+                "words-major run without its sync_diff closure "
+                "(structured.make_sync_diff / make_sharded_sync_diff)")
         return int(state.srv_msgs)
 
     def inject_mid(self, state: BroadcastState, node: int,

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..parallel.topology import grid_cols
 
@@ -171,6 +172,42 @@ def sharded_shift(x_local: jnp.ndarray, s: int, n_shards: int,
     return jnp.concatenate([halo, x_local[:, : block - a]], axis=1)
 
 
+def tree_parent_payload(p_local: jnp.ndarray, n: int, n_shards: int,
+                        branching: int = 4,
+                        axis_name: str = "nodes") -> jnp.ndarray:
+    """Per-node PARENT payload for the heap-ordered k-ary tree, local
+    block -> local block: out[:, c] = payload[:, (g-1)//k] for local col
+    c at global node g (zeros at the root g = 0).  The from_parent half
+    of :func:`tree_sharded_exchange`, also the delivery the per-edge
+    sync diff rides (one delivery serves both edge directions)."""
+    w, block = p_local.shape
+    k = branching
+    sub = block // k
+    zcol = jnp.zeros((w, 1), p_local.dtype)
+    # ext covers global columns [sB-1, sB+B): shard 0's missing left
+    # halo arrives as ppermute zeros == "parent of node 0" == none.
+    left = jax.lax.ppermute(
+        p_local[:, -1:], axis_name,
+        [(p, p + 1) for p in range(n_shards - 1)]) \
+        if n_shards > 1 else zcol
+    ext = jnp.concatenate([left, p_local], axis=1)
+    # k multicast rounds: in round m, source shard q sends the parent
+    # slice for destination shard d = qk + m.  Dests absent from a
+    # round receive zeros, so OR-ing the rounds selects each dest's
+    # single buffer.
+    buf = None
+    for m in range(k):
+        sl = ext[:, m * sub: m * sub + sub + 1]
+        pairs = [(q, q * k + m) for q in range(n_shards)
+                 if q * k + m < n_shards]
+        rv = jax.lax.ppermute(sl, axis_name, pairs)
+        buf = rv if buf is None else buf | rv
+    # local col c's parent sits at buf[ceil(c/k)] (buf[0] is the
+    # left-halo column: zero on the shard owning node 0).
+    return jnp.concatenate(
+        [buf[:, :1], jnp.repeat(buf[:, 1:], k, axis=1)], axis=1)[:, :block]
+
+
 def tree_sharded_exchange(p_local: jnp.ndarray, n: int, n_shards: int,
                           branching: int = 4,
                           axis_name: str = "nodes") -> jnp.ndarray:
@@ -196,31 +233,7 @@ def tree_sharded_exchange(p_local: jnp.ndarray, n: int, n_shards: int,
     assert block * n_shards == n, "node axis must shard evenly"
     assert block % k == 0 and block >= k, "tree halo needs k | block"
     sub = block // k
-    zcol = jnp.zeros((w, 1), p_local.dtype)
-
-    # ---- from_parent: inbox[i] |= payload[(i-1)//k] ------------------
-    # ext covers global columns [sB-1, sB+B): shard 0's missing left
-    # halo arrives as ppermute zeros == "parent of node 0" == none.
-    left = jax.lax.ppermute(
-        p_local[:, -1:], axis_name,
-        [(p, p + 1) for p in range(n_shards - 1)]) \
-        if n_shards > 1 else zcol
-    ext = jnp.concatenate([left, p_local], axis=1)
-    # k multicast rounds: in round m, source shard q sends the parent
-    # slice for destination shard d = qk + m.  Dests absent from a
-    # round receive zeros, so OR-ing the rounds selects each dest's
-    # single buffer.
-    buf = None
-    for m in range(k):
-        sl = ext[:, m * sub: m * sub + sub + 1]
-        pairs = [(q, q * k + m) for q in range(n_shards)
-                 if q * k + m < n_shards]
-        rv = jax.lax.ppermute(sl, axis_name, pairs)
-        buf = rv if buf is None else buf | rv
-    # local col c's parent sits at buf[ceil(c/k)] (buf[0] is the
-    # left-halo column: zero on the shard owning node 0).
-    from_parent = jnp.concatenate(
-        [buf[:, :1], jnp.repeat(buf[:, 1:], k, axis=1)], axis=1)[:, :block]
+    from_parent = tree_parent_payload(p_local, n, n_shards, k, axis_name)
 
     # ---- from_kids: inbox[j] |= OR payload[kj+1 .. kj+k] -------------
     # Pre-reduce on the child shard: group local cols by parent.
@@ -329,6 +342,151 @@ def make_sharded_exchange(topology: str, n: int, n_shards: int,
         if block < 2:
             return None
         return lambda p: line_sharded_exchange(p, n, n_shards, axis_name)
+    return None
+
+
+# -- reference-accounted sync diffs (no gather, no all_gather) ----------
+#
+# The anti-entropy server-message accounting needs the PER-EDGE set
+# differences sum over directed edges (j -> i) of |recv_j \ recv_i| (the
+# targeted pushes of SyncBroadcast, reference broadcast.go:97-108) —
+# which the OR-union exchange destroys.  But every structured topology
+# delivers per-DIRECTION terms where each node hears exactly one
+# neighbor, and edges come in symmetric pairs: ONE delivery of recv_j to
+# node i yields both |recv_j \ recv_i| and |recv_i \ recv_j|, so one
+# half-exchange (parent->child, +s rolls, up/left shifts) prices the
+# whole wave.  Cost: O(1) extra structured exchanges per sync round,
+# identical bit-for-bit to the adjacency-gather accounting
+# (tpu_sim/broadcast.py::_sync_diff_pc).
+
+
+def _dir_diff(term: jnp.ndarray, recv: jnp.ndarray,
+              mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """() uint32 — both directed diffs of each edge, computed at the
+    receiving end: term holds the neighbor's received-set (or zeros
+    where the neighbor does not exist — those columns MUST be masked
+    off, or the reverse diff would count the whole local set)."""
+    per = (lax.population_count(term & ~recv)
+           + lax.population_count(recv & ~term)).sum(axis=0)
+    if mask is not None:
+        per = jnp.where(mask, per, 0)
+    return jnp.sum(per, dtype=jnp.uint32)
+
+
+def tree_sync_diff(recv: jnp.ndarray, branching: int = 4) -> jnp.ndarray:
+    w, n = recv.shape
+    k = branching
+    if n == 1:
+        return jnp.uint32(0)
+    n_parents = (n - 1 + k - 1) // k
+    parent = jnp.repeat(recv[:, :n_parents], k, axis=1)[:, :n - 1]
+    return _dir_diff(parent, recv[:, 1:])
+
+
+def grid_sync_diff(recv: jnp.ndarray, cols: int) -> jnp.ndarray:
+    w, n = recv.shape
+    c = min(cols, n)
+    # vertical edges i <-> i+cols (i + cols < n)
+    vert = (_dir_diff(recv[:, c:], recv[:, :n - c]) if n > c
+            else jnp.uint32(0))
+    # horizontal edges i <-> i+1 within a row
+    mask = (jnp.arange(n - 1, dtype=jnp.int32) % cols) < cols - 1
+    horiz = _dir_diff(recv[:, 1:], recv[:, :-1], mask)
+    return vert + horiz
+
+
+def circulant_sync_diff(recv: jnp.ndarray,
+                        strides: list[int]) -> jnp.ndarray:
+    out = jnp.uint32(0)
+    for s in strides:
+        out = out + _dir_diff(jnp.roll(recv, s, axis=1), recv)
+    return out
+
+
+def line_sync_diff(recv: jnp.ndarray) -> jnp.ndarray:
+    return _dir_diff(recv[:, 1:], recv[:, :-1])
+
+
+def make_sync_diff(topology: str, n: int, **kw):
+    """Full-axis (single-device) per-edge sync-diff closure
+    ``diff(recv) -> uint32``, or None for unstructured topologies."""
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        return lambda r: tree_sync_diff(r, k)
+    if topology == "grid":
+        cols = kw.get("cols") or grid_cols(n)
+        return lambda r: grid_sync_diff(r, cols)
+    if topology == "ring":
+        return lambda r: circulant_sync_diff(r, [1])
+    if topology == "circulant":
+        strides = list(kw["strides"])
+        return lambda r: circulant_sync_diff(r, strides)
+    if topology == "line":
+        return line_sync_diff
+    return None
+
+
+def make_sharded_sync_diff(topology: str, n: int, n_shards: int,
+                           axis_name: str = "nodes", **kw):
+    """Halo-path sync diff: local received block -> LOCAL partial diff
+    (caller psums).  Same feasibility conditions and O(block) ppermute
+    cost as :func:`make_sharded_exchange`; None when no halo
+    decomposition exists."""
+    if n % n_shards != 0:
+        return None
+    block = n // n_shards
+
+    def global_cols(width: int):
+        start = jax.lax.axis_index(axis_name) * block
+        return start + jnp.arange(width, dtype=jnp.int32)
+
+    if topology in ("ring", "circulant"):
+        strides = [1] if topology == "ring" else list(kw["strides"])
+
+        def diff_circ(recv: jnp.ndarray) -> jnp.ndarray:
+            out = jnp.uint32(0)
+            for s in strides:
+                term = sharded_roll(recv, s, n, n_shards, axis_name)
+                out = out + _dir_diff(term, recv)
+            return out
+
+        return diff_circ
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        if block % k != 0 or block < k:
+            return None
+
+        def diff_tree(recv: jnp.ndarray) -> jnp.ndarray:
+            parent = tree_parent_payload(recv, n, n_shards, k, axis_name)
+            return _dir_diff(parent, recv, global_cols(block) != 0)
+
+        return diff_tree
+    if topology == "grid":
+        cols = kw.get("cols") or grid_cols(n)
+        if cols >= block:
+            return None
+
+        def diff_grid(recv: jnp.ndarray) -> jnp.ndarray:
+            g = global_cols(block)
+            vert = _dir_diff(
+                sharded_shift(recv, cols, n_shards, axis_name), recv,
+                g < n - cols)
+            horiz = _dir_diff(
+                sharded_shift(recv, 1, n_shards, axis_name), recv,
+                (g < n - 1) & (g % cols < cols - 1))
+            return vert + horiz
+
+        return diff_grid
+    if topology == "line":
+        if block < 2:
+            return None
+
+        def diff_line(recv: jnp.ndarray) -> jnp.ndarray:
+            return _dir_diff(
+                sharded_shift(recv, 1, n_shards, axis_name), recv,
+                global_cols(block) < n - 1)
+
+        return diff_line
     return None
 
 
