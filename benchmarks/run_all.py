@@ -114,13 +114,15 @@ def config4_epidemic_1m():
                                            strides=strides)
         sharded_diff = make_sharded_sync_diff("circulant", n, mesh.size,
                                               strides=strides)
+    # timed sim: ledger OFF — the sync diff is evaluated every round
+    # under jit (where-masked, not cond-skipped), so keeping it inside
+    # the perf_counter window would inflate the number this benchmark
+    # exists to measure
     sim = BroadcastSim(nbrs, n_values=32, sync_every=64, mesh=mesh,
                        exchange=make_exchange("circulant", n,
                                               strides=strides),
                        sharded_exchange=sharded_ex,
-                       sync_diff=make_sync_diff("circulant", n,
-                                                strides=strides),
-                       sharded_sync_diff=sharded_diff)
+                       srv_ledger=False)
     inject = make_inject(n, 32)
     state, rounds = sim.run_fused(inject)  # compile + warm
     jax.block_until_ready(state.received)
@@ -130,13 +132,24 @@ def config4_epidemic_1m():
     state = sim.run_staged(state0, target)
     jax.block_until_ready(state.received)
     dt = time.perf_counter() - t0
+    # separate untimed accounted run: Maelstrom-comparable srv_msgs for
+    # the identical deterministic schedule
+    sim_acct = BroadcastSim(nbrs, n_values=32, sync_every=64, mesh=mesh,
+                            exchange=make_exchange("circulant", n,
+                                                   strides=strides),
+                            sharded_exchange=sharded_ex,
+                            sync_diff=make_sync_diff("circulant", n,
+                                                     strides=strides),
+                            sharded_sync_diff=sharded_diff)
+    state_a, rounds_a = sim_acct.run_fused(inject)
+    assert rounds_a == int(state.t)
     return {
         "config": "broadcast-1M-expander-epidemic",
         "ok": bool(sim.converged(state, target)),
         "rounds": int(state.t),
         "wall_s": round(dt, 4),
         "msgs": int(state.msgs),
-        "srv_msgs": sim.server_msgs(state),
+        "srv_msgs": sim_acct.server_msgs(state_a),
     }
 
 

@@ -97,13 +97,20 @@ def expander_strides(n: int, degree: int = 8, seed: int = 0) -> list[int]:
     rng = np.random.default_rng(seed)
     # Distinct useful strides live in [1, n//2] (larger ones alias via
     # i-s ≡ i+(n-s)); clamp so small n can't make the sampling loop
-    # unsatisfiable (e.g. n=8, degree=8 has only 4 strides) and never
-    # emit a stride that would be a self-loop or duplicate edge.
-    max_stride = max(1, n // 2)
-    want = min(max(1, degree // 2), max_stride)
+    # unsatisfiable (e.g. n=8, degree=8 has only 4 strides).  For even
+    # n the stride exactly n/2 maps i+s and i-s to the SAME node — one
+    # edge, not two — which would both lose effective degree and make
+    # the per-edge message ledger double-count that edge, so it is
+    # sampled only as a last resort when no other distinct stride
+    # remains.
+    half = max(1, n // 2)
+    pair_max = half - 1 if (n % 2 == 0 and half > 1) else half
+    want = min(max(1, degree // 2), half)
     strides: set[int] = {1}
-    while len(strides) < want:
-        strides.add(int(rng.integers(2, max_stride + 1)))
+    while len(strides) < want and len(strides) < pair_max:
+        strides.add(int(rng.integers(2, pair_max + 1)))
+    if len(strides) < want:
+        strides.add(half)  # sole remaining distinct stride (even n)
     return sorted(strides)
 
 

@@ -89,15 +89,18 @@ class BroadcastState(NamedTuple):
     # delay-d edge delivers the payload flooded d rounds ago (Maelstrom's
     # variable per-edge latency as data).  None when all edges are 1 hop.
     history: jnp.ndarray | None = None
-    # gather path only: reference-accounted server-to-server message
-    # total — what Maelstrom's ledger would read for the same run.
-    # Floods: one `broadcast` per (value, topology neighbor) minus the
-    # sender exclusion (rebroadcastAllExcept, broadcast.go:50-57) plus
-    # one `broadcast_ok` per delivery; sync rounds: `read` per topology
+    # reference-accounted server-to-server message total — what
+    # Maelstrom's ledger would read for the same run.  Floods: one
+    # `broadcast` per (value, topology neighbor) minus the sender
+    # exclusion (rebroadcastAllExcept, broadcast.go:50-57) plus one
+    # `broadcast_ok` per delivery; sync rounds: `read` per topology
     # neighbor + `read_ok` per live neighbor + the targeted diff pushes
-    # and their acks (SyncBroadcast, broadcast.go:81-122).  None on the
-    # words-major structured path, whose `msgs` stays the throughput
-    # (value-message) ledger.
+    # and their acks (SyncBroadcast, broadcast.go:81-122).  Live on the
+    # gather path by default and on the words-major structured path
+    # when its sync_diff closure is supplied (structured.make_sync_diff
+    # / make_sharded_sync_diff); None when srv_ledger=False or the
+    # structured run has no sync_diff — `msgs` is then the only ledger
+    # (throughput / value-messages).
     srv_msgs: jnp.ndarray | None = None
 
 
@@ -821,7 +824,9 @@ class BroadcastSim:
         next round floods it.  Charges the origin correction to the
         server ledger (an origin sends to ALL topology neighbors and is
         acked by every live one — one send + one ack more than the
-        (deg-1)-charged learner the next flood round accounts it as)."""
+        (deg-1)-charged learner the next flood round accounts it as).
+        With ``srv_ledger=False`` there is no ledger to charge and the
+        correction is skipped."""
         if self.words_major:
             raise ValueError("inject_mid targets the gather path")
         w, b = value // WORD, jnp.uint32(1 << (value % WORD))
@@ -829,8 +834,10 @@ class BroadcastSim:
             state.received[node, w] | b)
         frontier = state.frontier.at[node, w].set(
             state.frontier[node, w] | b)
+        srv = (None if state.srv_msgs is None
+               else state.srv_msgs + jnp.uint32(2))
         return state._replace(received=received, frontier=frontier,
-                              srv_msgs=state.srv_msgs + jnp.uint32(2))
+                              srv_msgs=srv)
 
     def run_stats(self, inject: np.ndarray, *, max_rounds: int = 1 << 16,
                   ) -> tuple[BroadcastState, int, list[dict]]:

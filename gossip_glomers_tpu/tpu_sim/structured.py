@@ -355,9 +355,12 @@ def make_sharded_exchange(topology: str, n: int, n_shards: int,
 # neighbor, and edges come in symmetric pairs: ONE delivery of recv_j to
 # node i yields both |recv_j \ recv_i| and |recv_i \ recv_j|, so one
 # half-exchange (parent->child, +s rolls, up/left shifts) prices the
-# whole wave.  Cost: O(1) extra structured exchanges per sync round,
-# identical bit-for-bit to the adjacency-gather accounting
-# (tpu_sim/broadcast.py::_sync_diff_pc).
+# whole wave.  Cost: O(1) extra structured exchanges EVERY round (like
+# the gather path, the diff is where-masked rather than cond-skipped —
+# lax.cond branches would need equal sharding types under shard_map —
+# so throughput benchmarks time with srv_ledger=False and account in a
+# separate run); identical bit-for-bit to the adjacency-gather
+# accounting (tpu_sim/broadcast.py::_sync_diff_pc).
 
 
 def _dir_diff(term: jnp.ndarray, recv: jnp.ndarray,

@@ -45,16 +45,20 @@ def run_broadcast_flood(argv_of, n=5, n_values=8, extra_env=None):
             list(pool.map(lambda i: net.spawn(f"n{i}", argv_of(i),
                                               extra_env=extra_env),
                           range(n)))
-        net.init_cluster()
+        net.init_cluster(timeout=60.0)
         net.set_topology(to_name_map(tree(n)))
+        # generous per-op timeouts: under a loaded full-suite run the
+        # first ops race 25 interpreter startups; slow is fine, counts
+        # are what's asserted
         for v in range(n_values):
-            rep = net.rpc(f"n{v % n}", {"type": "broadcast", "message": v})
+            rep = net.rpc(f"n{v % n}", {"type": "broadcast", "message": v},
+                          timeout=30.0)
             assert rep["type"] == "broadcast_ok", rep
-        net.quiesce(idle=0.3, timeout=5.0)
+        net.quiesce(idle=0.3, timeout=15.0)
         msgs = dict(net.server_msgs_by_type)
         reads = {}
         for i in range(n):
-            rep = net.rpc(f"n{i}", {"type": "read"})
+            rep = net.rpc(f"n{i}", {"type": "read"}, timeout=30.0)
             reads[f"n{i}"] = sorted(rep.get("messages") or [])
         return msgs, reads
     finally:
@@ -157,8 +161,12 @@ def test_virtual_harness_matches_go_flood_counts():
 # schedule:
 #
 #   - 25-node 4-ary tree, sync_jitter=0 -> node i's waves fire at
-#     init_i + 2k.  n24 (a leaf) is initialized 0.35 s after the rest,
-#     so its parent n5 always syncs first.
+#     init_i + k*SYNC_T.  n24 (a leaf) is initialized 0.35 s after the
+#     rest, so its parent n5 always syncs first.  SYNC_T=4 (not the
+#     reference's 2 s) buys wall-clock margin on loaded machines; the
+#     expected counts are interval-independent (they cover exactly two
+#     waves), and explicit precondition asserts below turn a too-slow
+#     spawn/flood into a clear failure instead of a count mismatch.
 #   - values 0..9 flood healthy; value 10 floods while n24 is
 #     partitioned off (its copy drops in-network); heal before the
 #     first wave.
@@ -175,13 +183,14 @@ def test_virtual_harness_matches_go_flood_counts():
 
 SYNC_WAVE_EXPECT = {"broadcast": 265, "broadcast_ok": 264,
                     "read": 96, "read_ok": 96}
+SYNC_T = 4.0   # pinned sync interval for both scenario backends
 
 
 def _sync_wave_scenario_process():
     import time
     from concurrent.futures import ThreadPoolExecutor
 
-    env = {"GG_SYNC_INTERVAL": "2", "GG_SYNC_JITTER": "0"}
+    env = {"GG_SYNC_INTERVAL": str(int(SYNC_T)), "GG_SYNC_JITTER": "0"}
     blocked = {"on": False}
     net = ProcessNetwork(
         drop_fn=lambda src, dest, now: (blocked["on"]
@@ -194,6 +203,7 @@ def _sync_wave_scenario_process():
                     f"n{i}", PY + ["gossip_glomers_tpu.nodes.broadcast"],
                     extra_env=env), range(25)))
         # anchors: n0..n23 now, n24 later -> n5's waves precede n24's
+        t_first = time.monotonic()   # lower bound on every init_i
         for i in range(24):
             rep = net.rpc(f"n{i}", {"type": "init", "node_id": f"n{i}",
                                     "node_ids": ids})
@@ -203,6 +213,12 @@ def _sync_wave_scenario_process():
                               "node_ids": ids})
         assert rep["type"] == "init_ok"
         t24 = time.monotonic()
+        # clearance before earliest wave 3 (>= t_first+3T) is
+        # T - 0.7 - (t24 - t_first); this bound guarantees > 1 s
+        assert t24 - t_first < SYNC_T - 1.7, (
+            "scenario precondition: node inits took "
+            f"{t24 - t_first:.2f}s; the wave-window cut at t24+2T+0.7 "
+            "would overlap wave 3 — machine too loaded for this test")
         net.set_topology(to_name_map(tree(25)))
         for v in range(10):
             rep = net.rpc(f"n{v % 25}", {"type": "broadcast",
@@ -214,10 +230,15 @@ def _sync_wave_scenario_process():
         assert rep["type"] == "broadcast_ok"
         time.sleep(0.2)                       # flood done, n24's copy lost
         blocked["on"] = False                 # heal before the first wave
+        assert time.monotonic() < t_first + SYNC_T - 0.3, (
+            "scenario precondition: flood + partition window did not "
+            "finish before the first sync wave — machine too loaded")
         assert not net.rpc("n24", {"type": "read"}).get("messages",
                                                         []).count(10)
-        # wait past n24's wave 2 (t24+4) but before anyone's wave 3 (>= +6)
-        time.sleep(max(0.0, t24 + 4.7 - time.monotonic()))
+        # wait past n24's wave 2 (t24+2T) but before anyone's wave 3
+        # (earliest is n0's at ~t_first+3T; the init precondition above
+        # guarantees >1s of clearance)
+        time.sleep(max(0.0, t24 + 2 * SYNC_T + 0.7 - time.monotonic()))
         snap = dict(net.server_msgs_by_type)
         r24 = sorted(net.rpc("n24", {"type": "read"})["messages"])
         return snap, r24
@@ -234,7 +255,8 @@ def _sync_wave_scenario_virtual():
     net = VirtualNetwork(NetConfig(latency=0.0, seed=0))
     for i in range(25):
         net.spawn(f"n{i}",
-                  BroadcastProgram(BroadcastConfig(sync_jitter=0.0)))
+                  BroadcastProgram(BroadcastConfig(sync_interval=SYNC_T,
+                                                   sync_jitter=0.0)))
     blocked = {"on": False}
     net.drop_fn = (lambda src, dest, now: blocked["on"]
                    and "n24" in (src, dest))
@@ -255,8 +277,8 @@ def _sync_wave_scenario_virtual():
     client.rpc("n0", {"type": "broadcast", "message": 10})
     net.run_for(0.05)
     blocked["on"] = False
-    # waves: n0..n23 at t=2, 4; n24 at 2.35, 4.35; cut before t=6
-    net.run_for(4.8 - net.now)
+    # waves: n0..n23 at t=T, 2T; n24 at T+.35, 2T+.35; cut before 3T
+    net.run_for(2 * SYNC_T + 0.8 - net.now)
     snap = dict(net.ledger.server_msgs_by_type)
     got: dict[str, list] = {}
     client.rpc("n24", {"type": "read"},

@@ -432,6 +432,25 @@ def test_expander_strides_small_n_terminates():
     assert expander_strides(1024, degree=8)[0] == 1
 
 
+def test_expander_strides_even_n_avoids_half_stride():
+    # For even n, stride n/2 collapses i+s and i-s into ONE edge: it
+    # must be sampled only when no other distinct stride remains.
+    from gossip_glomers_tpu.parallel.topology import (circulant,
+                                                      expander_strides)
+    for n in (16, 64, 1024):
+        for seed in range(8):
+            s = expander_strides(n, degree=8, seed=seed)
+            assert n // 2 not in s, (n, seed, s)
+            # hence circulant emits no duplicate neighbor columns
+            nbrs = circulant(n, s)
+            for i in (0, 1, n // 2):
+                row = nbrs[i].tolist()
+                assert len(row) == len(set(row)), (n, seed, row)
+    # n=4 has only strides {1, 2}: 2 is the sole remaining distinct
+    # stride and is kept so degree doesn't collapse to 2
+    assert expander_strides(4, degree=8) == [1, 2]
+
+
 # -- reference-accounted server-message ledger --------------------------
 
 
@@ -477,6 +496,25 @@ def test_srv_ledger_sync_waves_match_virtual_harness():
     assert r24 == list(range(11))
     assert sim.server_msgs(state) == sum(snap.values())
     assert sum(SYNC_WAVE_EXPECT.values()) == sum(snap.values())
+
+
+def test_inject_mid_with_ledger_off_skips_charge():
+    # srv_ledger=False: inject_mid must still set the bits (no opaque
+    # None + uint32 TypeError) and simply skip the 2-message correction
+    n, nv = 9, 16
+    sim = BroadcastSim(to_padded_neighbors(tree(n)), n_values=nv,
+                       sync_every=1 << 20, srv_ledger=False)
+    inject = make_inject(n, 4)
+    state = sim.init_state(inject)
+    state = sim.step(state)
+    state = sim.inject_mid(state, 3, 10)
+    assert state.srv_msgs is None
+    inj2 = inject.copy()
+    inj2[3, 0] |= np.uint32(1 << 10)
+    target = sim.target_bits(inj2)
+    while not sim.converged(state, target):
+        state = sim.step(state)
+    assert 10 in sim.read(state)[0]
 
 
 def test_srv_ledger_sharded_matches_single_device():
