@@ -7,88 +7,50 @@ chips are visible).  The Go reference tops out at 25 OS processes under
 Maelstrom; here every node is a row of a device-sharded bitset array and
 one jitted round == one network hop.
 
+Timing methodology lives in gossip_glomers_tpu/tpu_sim/timing.py
+(fused whole-convergence device program, staged inputs, median of 3).
+
 Prints exactly one JSON line:
   {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": ratio}
 vs_baseline = baseline_target_seconds / measured  (>1 means faster than
-the 10 s target).
+the 10 s target).  Extra keys: Maelstrom-comparable server-message
+accounting for the same run, and the W=128 words-axis regime (4,096
+values -> 128 uint32 bitset words per node) on tree and circulant
+topologies — the many-values case the words-major layout exists for.
 """
 
 from __future__ import annotations
 
 import json
-import sys
-import time
-
-import numpy as np
 
 N_NODES = 1 << 20            # 1,048,576
 N_VALUES = 32                # one bitset word; injected round-robin
 BRANCHING = 4
 BASELINE_TARGET_S = 10.0     # BASELINE.json: "<10 s on a v5e-8"
+W128_VALUES = 4096           # words-axis regime: 128 uint32 words
 
 
 def main() -> None:
     import jax
 
-    from gossip_glomers_tpu.parallel.mesh import pick_mesh
-    from gossip_glomers_tpu.parallel.topology import tree, to_padded_neighbors
-    from gossip_glomers_tpu.tpu_sim.broadcast import BroadcastSim, make_inject
+    from gossip_glomers_tpu.tpu_sim.broadcast import make_inject
+    from gossip_glomers_tpu.tpu_sim.timing import (structured_sim,
+                                                   timed_convergence,
+                                                   words_axis_regime)
 
     devices = jax.devices()
-    mesh = pick_mesh()
-
-    from gossip_glomers_tpu.tpu_sim.structured import (
-        make_exchange, make_sharded_exchange, make_sharded_sync_diff,
-        make_sync_diff)
-
-    nbrs = to_padded_neighbors(tree(N_NODES, branching=BRANCHING))
     inject = make_inject(N_NODES, N_VALUES)
-    sharded = sharded_diff = None
-    if mesh is not None:
-        # halo path: parent/child slice ppermutes, O(block) ICI traffic
-        # per round — no all_gather, no redundant full-axis compute
-        sharded = make_sharded_exchange("tree", N_NODES, mesh.size,
-                                        branching=BRANCHING)
-        sharded_diff = make_sharded_sync_diff("tree", N_NODES, mesh.size,
-                                              branching=BRANCHING)
-    # timed sim: server ledger OFF — its sync diff runs every round
-    # under jit (where-masked, not cond-skipped) and would inflate the
-    # headline number; a separate untimed accounted run below reports
-    # the Maelstrom-comparable srv_msgs for the same deterministic
-    # schedule
-    sim = BroadcastSim(nbrs, n_values=N_VALUES, sync_every=64, mesh=mesh,
-                       exchange=make_exchange("tree", N_NODES,
-                                              branching=BRANCHING),
-                       sharded_exchange=sharded,
-                       srv_ledger=False)
-    sim_acct = BroadcastSim(nbrs, n_values=N_VALUES, sync_every=64,
-                            mesh=mesh,
-                            exchange=make_exchange("tree", N_NODES,
-                                                   branching=BRANCHING),
-                            sharded_exchange=sharded,
-                            sync_diff=make_sync_diff("tree", N_NODES,
-                                                     branching=BRANCHING),
-                            sharded_sync_diff=sharded_diff)
 
-    # Warmup: compile the fused runner and run one full convergence.
-    state, rounds = sim.run_fused(inject)
-    jax.block_until_ready(state.received)
+    # Headline: timed sim has the server ledger OFF — its sync diff
+    # runs every round under jit (where-masked, not cond-skipped) and
+    # would inflate the number; a separate untimed accounted run
+    # reports the Maelstrom-comparable srv_msgs for the same
+    # deterministic schedule.
+    sim = structured_sim("tree", N_NODES, N_VALUES, branching=BRANCHING)
+    elapsed, rounds, state = timed_convergence(sim, inject)
 
-    # Timed region: the whole-convergence device program, start to
-    # observed completion.  Workload staging (host->device upload of the
-    # injected values) happens before the clock, mirroring how the
-    # reference's Maelstrom timings exclude process startup.
-    state0, target = sim.stage(inject)
-    jax.block_until_ready(state0.received)
-    t0 = time.perf_counter()
-    state = sim.run_staged(state0, target)
-    jax.block_until_ready(state.received)
-    elapsed = time.perf_counter() - t0
-    rounds = int(state.t)
-
-    assert sim.converged(state, target), "benchmark run did not converge"
-
-    # untimed accounted run: same schedule, server ledger on
+    sim_acct = structured_sim("tree", N_NODES, N_VALUES,
+                              branching=BRANCHING, srv_ledger=True)
     state_a, rounds_a = sim_acct.run_fused(inject)
     assert rounds_a == rounds, (rounds_a, rounds)
     srv_msgs = sim_acct.server_msgs(state_a)
@@ -104,6 +66,9 @@ def main() -> None:
         # ack + anti-entropy reads/pushes) per broadcast op
         "srv_msgs": srv_msgs,
         "srv_msgs_per_op": round(srv_msgs / N_VALUES, 1),
+        "w1_ms_per_round": round(elapsed / rounds * 1e3, 3),
+        "w128": words_axis_regime(N_NODES, W128_VALUES,
+                                  branching=BRANCHING),
         "n_devices": len(devices),
     }))
 

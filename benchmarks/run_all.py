@@ -6,6 +6,8 @@
   3. counter:   1k-node g-counter, partitioned (tpu_sim, all-reduce)
   4. broadcast: 1M-node expander epidemic      (tpu_sim, structured)
   5. kafka:     10k-key log, collective offsets(tpu_sim, rank-per-round)
+  6. broadcast: 1M nodes x 4,096 values (W=128 words axis), tree +
+     circulant — the many-values regime (tpu_sim, structured)
 
 Usage: python benchmarks/run_all.py [--out BENCH_ALL.json]
 The headline driver metric stays in bench.py (config 4's tree variant).
@@ -15,9 +17,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import pathlib
+import sys
 import time
 
 import numpy as np
+
+# runnable both as `python -m benchmarks.run_all` and as a plain script
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
 def config1_tree25():
@@ -190,6 +197,19 @@ def config4b_random_regular_1m():
     }
 
 
+def config6_words_axis_w128():
+    """The words-axis (many-values) regime: 1M nodes x 4,096 values =
+    128 uint32 bitset words per node, tree + circulant structured
+    exchanges, words axis sharded on the 2D mesh where available.
+    Shares gossip_glomers_tpu.tpu_sim.timing.words_axis_regime with
+    bench.py's ``w128`` key (one traffic model, no drift); see its
+    docstring for the gbytes_per_s_lb bandwidth lower bound."""
+    from gossip_glomers_tpu.tpu_sim.timing import words_axis_regime
+
+    return {"config": "broadcast-1M-words-axis-w128", "ok": True,
+            **words_axis_regime(1 << 20, 4096)}
+
+
 def config5_kafka_10k():
     import jax
 
@@ -234,6 +254,7 @@ def main() -> None:
         "1": config1_tree25, "2": config2_grid25_faults,
         "3": config3_counter_1k, "4": config4_epidemic_1m,
         "4b": config4b_random_regular_1m, "5": config5_kafka_10k,
+        "6": config6_words_axis_w128,
     }
     pick = (args.only.split(",") if args.only else list(configs))
     results = []

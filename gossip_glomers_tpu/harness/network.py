@@ -25,6 +25,10 @@ from ..utils.config import NetConfig
 
 # drop_fn(src, dest, now) -> True when the link is currently cut
 DropFn = Callable[[str, str, float], bool]
+# latency_fn(src, dest, now) -> per-edge delivery latency in seconds;
+# overrides the uniform NetConfig.latency/jitter when set (the virtual
+# analogue of Maelstrom's per-link latency knobs)
+LatencyFn = Callable[[str, str, float], float]
 
 
 def is_server_msg(src: str, dest: str, nodes, services) -> bool:
@@ -141,6 +145,7 @@ class VirtualNetwork:
         self.clients: dict[str, Client] = {}
         self.ledger = Ledger()
         self.drop_fn: DropFn | None = None
+        self.latency_fn: LatencyFn | None = None
         self.trace: list[tuple[float, Message]] | None = None
 
     # -- construction -----------------------------------------------------
@@ -198,9 +203,12 @@ class VirtualNetwork:
                                                      self.now):
             self.ledger.dropped += 1
             return
-        delay = self.cfg.latency
-        if self.cfg.latency_jitter:
-            delay += self.rng.uniform(0, self.cfg.latency_jitter)
+        if self.latency_fn is not None:
+            delay = self.latency_fn(msg.src, msg.dest, self.now)
+        else:
+            delay = self.cfg.latency
+            if self.cfg.latency_jitter:
+                delay += self.rng.uniform(0, self.cfg.latency_jitter)
         if self.trace is not None:
             self.trace.append((self.now, msg))
         self.schedule(delay, lambda: self._deliver(msg))

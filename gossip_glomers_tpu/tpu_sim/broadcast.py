@@ -239,7 +239,14 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
     # charge read-per-topo-neighbor + read_ok-per-live-neighbor + the
     # targeted diff pushes and their acks.  Under `delays`, sends are
     # still charged at send time and the sync diff is computed against
-    # current (not RTT-stale) peer state — exact at zero delay.
+    # current (not RTT-stale) peer state; the reference dance instead
+    # diffs the peer's one-hop-old reply against own state a full RTT
+    # later (broadcast.go:86-108).  The two disagree only for values
+    # still in flight across a wave's RTT window — at most one
+    # spurious/missed push + ack (2 msgs) per such (value, directed
+    # pair), and exact whenever waves hit quiescent state.  Measured
+    # against the per-edge-latency virtual harness in
+    # test_delay_mode_sync_diff_gap_is_one_push / _exact_when_quiescent.
     if state.srv_msgs is None:
         srv = None
     else:

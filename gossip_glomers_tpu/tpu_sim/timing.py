@@ -1,0 +1,106 @@
+"""Shared benchmark timing for broadcast convergence runs.
+
+One pattern, used by bench.py and benchmarks/run_all.py: compile + warm
+the fused whole-convergence device program, re-stage the workload on
+device, then time exactly the staged program start-to-observed-end —
+host->device upload stays off the clock the way Maelstrom timings
+exclude process startup (reference README.md:16 methodology).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def timed_convergence(sim, inject: np.ndarray, repeats: int = 3):
+    """(elapsed_s, rounds, final_state) for a fused convergence run of
+    ``sim`` (a BroadcastSim) on the ``inject`` workload.  The timed
+    region runs ``repeats`` times and the MEDIAN is reported — one
+    anomalous sample (async-dispatch hiccup, tunnel jitter) must not
+    become the recorded number in either direction."""
+    import jax
+
+    state, _ = sim.run_fused(inject)            # compile + warm
+    jax.block_until_ready(state.received)
+    samples = []
+    for _ in range(max(1, repeats)):
+        state0, target = sim.stage(inject)
+        jax.block_until_ready(state0.received)
+        t0 = time.perf_counter()
+        state = sim.run_staged(state0, target)
+        jax.block_until_ready(state.received)
+        samples.append(time.perf_counter() - t0)
+    assert sim.converged(state, target), "benchmark run did not converge"
+    return sorted(samples)[len(samples) // 2], int(state.t), state
+
+
+def structured_sim(topology: str, n: int, n_values: int, *,
+                   sync_every: int = 64, srv_ledger: bool = False,
+                   **kw):
+    """A words-major structured BroadcastSim on the picked mesh (halo
+    exchanges on >1 device), ledger off by default — the sync-diff
+    accounting runs every round under jit, so timed runs keep it out
+    (see structured.py's sync-diff cost note)."""
+    from ..parallel.mesh import pick_mesh
+    from .broadcast import BroadcastSim
+    from .structured import (make_exchange, make_sharded_exchange,
+                             make_sharded_sync_diff, make_sync_diff)
+
+    mesh = pick_mesh()
+    sharded = sharded_diff = None
+    if mesh is not None:
+        sharded = make_sharded_exchange(topology, n, mesh.size, **kw)
+        sharded_diff = make_sharded_sync_diff(topology, n, mesh.size,
+                                              **kw)
+    return BroadcastSim(
+        _nbrs_for(topology, n, **kw), n_values=n_values,
+        sync_every=sync_every, mesh=mesh,
+        exchange=make_exchange(topology, n, **kw),
+        sharded_exchange=sharded,
+        srv_ledger=srv_ledger,
+        sync_diff=make_sync_diff(topology, n, **kw) if srv_ledger
+        else None,
+        sharded_sync_diff=sharded_diff if srv_ledger else None)
+
+
+def words_axis_regime(n: int = 1 << 20, n_values: int = 4096, *,
+                      branching: int = 4, strides_seed: int = 0) -> dict:
+    """The many-values regime (W = n_values/32 bitset words per node):
+    timed convergence on tree and circulant structured exchanges.
+    ``gbytes_per_s_lb`` is a logical-traffic lower bound on achieved
+    HBM bandwidth in GIGABYTES/s: what a perfectly fused round must
+    stream — read received+frontier, write received+frontier, plus one
+    full-bitset payload read per exchange direction.  Shared by
+    bench.py's ``w128`` key and benchmarks/run_all.py config 6 so the
+    traffic model cannot drift between them."""
+    from ..parallel.topology import expander_strides
+    from .broadcast import make_inject
+
+    inject = make_inject(n, n_values)
+    bitset_gb = n * (n_values // 32) * 4 / 1e9     # one (W, N) array
+    strides = expander_strides(n, degree=8, seed=strides_seed)
+    out: dict = {"n_values": n_values}
+    for topo, kw, n_dirs in (
+            ("tree", {"branching": branching}, branching + 1),
+            ("circulant", {"strides": strides}, 2 * len(strides))):
+        sim = structured_sim(topo, n, n_values, **kw)
+        dt, rounds, _ = timed_convergence(sim, inject)
+        out[topo] = {
+            "wall_s": round(dt, 4), "rounds": rounds,
+            "ms_per_round": round(dt / rounds * 1e3, 3),
+            "gbytes_per_s_lb": round(
+                (4 + n_dirs) * bitset_gb * rounds / dt, 1)}
+    return out
+
+
+def _nbrs_for(topology: str, n: int, **kw) -> np.ndarray:
+    from ..parallel.topology import circulant, to_padded_neighbors, tree
+
+    if topology == "tree":
+        return to_padded_neighbors(
+            tree(n, branching=kw.get("branching", 4)))
+    if topology == "circulant":
+        return circulant(n, list(kw["strides"]))
+    raise ValueError(topology)
