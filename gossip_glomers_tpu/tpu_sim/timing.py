@@ -1,10 +1,33 @@
 """Shared benchmark timing for broadcast convergence runs.
 
-One pattern, used by bench.py and benchmarks/run_all.py: compile + warm
-the fused whole-convergence device program, re-stage the workload on
-device, then time exactly the staged program start-to-observed-end —
-host->device upload stays off the clock the way Maelstrom timings
-exclude process startup (reference README.md:16 methodology).
+One pattern, used by bench.py and benchmarks/run_all.py: run exactly
+the convergence round count (host-computed, :func:`discover_rounds`) as
+a counter-only ``fori_loop`` program with a pure exchange+merge body
+(``BroadcastSim._build_fixed``'s flood specialization; ledgers are
+recovered exactly post-loop in closed form), and measure it with
+CHAINED AMORTIZED timing — host->device upload stays off the clock the
+way Maelstrom timings exclude process startup (reference README.md:16
+methodology).
+
+Why chained (measured on the remote-TPU tunnel, see ARCHITECTURE.md
+"Timing methodology"): every BLOCKING POINT — a D2H transfer such as
+``np.asarray``/`int()` on a device value, or the per-iteration
+condition fetch of a data-dependent ``while_loop`` — costs ~100 ms of
+tunnel round-trip, swamping millisecond device programs; worse, in the
+session's initial async mode ``block_until_ready`` can return BEFORE
+the compute has run, so naive per-call timing lies fast (sub-artifact
+"0.1 ms" readings for half-gigabyte workloads), while after any D2H
+the session turns synchronous and per-call timing lies slow (~100 ms
+floor; the state decays after minutes of idle).  Chaining K
+data-dependent calls behind a single completion fence and differencing
+two chain lengths cancels the per-blocking-point term and is correct
+in both modes.  Data dependency between calls forces real execution;
+after the first convergence the state is saturated, but the dense
+bitwise round work is identical, so the amortized per-call time is the
+steady-state convergence time.  Round counts are computed on the host
+so no data-dependent while program ever needs to run, and
+finish/validation readbacks happen only after all samples
+(:class:`TimedRun` + :func:`bench_structured` enforce the schedule).
 """
 
 from __future__ import annotations
@@ -12,28 +35,6 @@ from __future__ import annotations
 import time
 
 import numpy as np
-
-
-def timed_convergence(sim, inject: np.ndarray, repeats: int = 3):
-    """(elapsed_s, rounds, final_state) for a fused convergence run of
-    ``sim`` (a BroadcastSim) on the ``inject`` workload.  The timed
-    region runs ``repeats`` times and the MEDIAN is reported — one
-    anomalous sample (async-dispatch hiccup, tunnel jitter) must not
-    become the recorded number in either direction."""
-    import jax
-
-    state, _ = sim.run_fused(inject)            # compile + warm
-    jax.block_until_ready(state.received)
-    samples = []
-    for _ in range(max(1, repeats)):
-        state0, target = sim.stage(inject)
-        jax.block_until_ready(state0.received)
-        t0 = time.perf_counter()
-        state = sim.run_staged(state0, target)
-        jax.block_until_ready(state.received)
-        samples.append(time.perf_counter() - t0)
-    assert sim.converged(state, target), "benchmark run did not converge"
-    return sorted(samples)[len(samples) // 2], int(state.t), state
 
 
 def structured_sim(topology: str, n: int, n_values: int, *,
@@ -65,34 +66,288 @@ def structured_sim(topology: str, n: int, n_values: int, *,
         sharded_sync_diff=sharded_diff if srv_ledger else None)
 
 
-def words_axis_regime(n: int = 1 << 20, n_values: int = 4096, *,
-                      branching: int = 4, strides_seed: int = 0) -> dict:
-    """The many-values regime (W = n_values/32 bitset words per node):
-    timed convergence on tree and circulant structured exchanges.
-    ``gbytes_per_s_lb`` is a logical-traffic lower bound on achieved
-    HBM bandwidth in GIGABYTES/s: what a perfectly fused round must
-    stream — read received+frontier, write received+frontier, plus one
-    full-bitset payload read per exchange direction.  Shared by
-    bench.py's ``w128`` key and benchmarks/run_all.py config 6 so the
-    traffic model cannot drift between them."""
-    from ..parallel.topology import expander_strides
+def discover_rounds(topology: str, n: int, n_values: int, **kw) -> int:
+    """Host-only convergence round count for a structured flood — no
+    device program runs, keeping the benchmark process session-clean.
+
+    Rounds-to-convergence = max over injected values of the
+    eccentricity of the value's origin (origins are round-robin
+    ``v % n``):
+    - tree: exact ecc(o) — for each ancestor a of o, the farthest node
+      whose path to o turns at a is the deepest descendant of a
+      outside the branch containing o (heap indexing makes subtree
+      depth ranges closed-form; cross-checked against BFS in
+      test_discover_rounds_tree_matches_bfs);
+    - circulant: vertex-transitive, so ecc is the same for every
+      origin — one numpy BFS over the stride graph gives it.
+    Validated post-run: :meth:`TimedRun.finish` asserts the result
+    actually converged and falls back to device discovery if not (that
+    self-heals an under-estimate; the formulas here are exact, which
+    the tests pin, so an over-estimate cannot occur)."""
+    if topology == "tree":
+        k = kw.get("branching", 4)
+
+        def depth(i: int) -> int:
+            d = 0
+            while i > 0:
+                i = (i - 1) // k
+                d += 1
+            return d
+
+        def submax(a: int) -> int:
+            # depth of the deepest descendant of node a
+            lo = hi = a
+            d = depth(a)
+            while True:
+                lo, hi = k * lo + 1, k * hi + k
+                if lo > n - 1:
+                    return d
+                hi = min(hi, n - 1)
+                d += 1
+
+        def ecc(o: int) -> int:
+            best = submax(o) - depth(o)          # down o's own subtree
+            child, a = o, (o - 1) // k
+            while o > 0:
+                da = depth(a)
+                m = max((submax(c)
+                         for c in range(k * a + 1,
+                                        min(k * a + k, n - 1) + 1)
+                         if c != child), default=da)
+                best = max(best, (depth(o) - da) + (m - da))
+                if a == 0:
+                    break
+                child, a = a, (a - 1) // k
+            return best
+
+        return max(ecc(v % n) for v in range(min(n_values, n)))
+    if topology == "circulant":
+        strides = list(kw["strides"])
+        reach = np.zeros(n, bool)
+        reach[0] = True
+        frontier = reach.copy()
+        rounds = 0
+        while not reach.all():
+            new = np.zeros(n, bool)
+            for s in strides:
+                new |= np.roll(frontier, s) | np.roll(frontier, -s)
+            frontier = new & ~reach
+            if not frontier.any():
+                raise ValueError("circulant strides do not connect")
+            reach |= frontier
+            rounds += 1
+        return rounds
+    raise ValueError(topology)
+
+
+class TimedRun:
+    """One convergence benchmark, phase-split: :meth:`prepare` stages
+    inputs and compiles+warms the loop program, :meth:`sample` times it
+    (loop program ONLY — no ledgers, no reductions), :meth:`finish`
+    assembles the final state, verifies convergence, and computes the
+    closed-form message ledger.  Callers run every sample before any
+    finish (see module docstring)."""
+
+    def __init__(self, sim, inject: np.ndarray, rounds: int) -> None:
+        self.sim, self.inject, self.rounds = sim, inject, rounds
+        self.samples: list[float] = []
+
+    def prepare(self) -> None:
+        import jax
+
+        self.state0, self.target = self.sim.stage(self.inject)
+        jax.block_until_ready(self.state0.received)
+        self.parts = self.sim.build_fixed(self.rounds)
+        if self.parts is None:           # generic body, no split
+            out = self.sim.run_staged_fixed(self.state0, self.rounds)
+            jax.block_until_ready(out.received)
+        else:
+            loop_fn, _ = self.parts
+            out = loop_fn(self.state0.received, self.state0.frontier)
+            jax.block_until_ready(out[0])
+
+    def sample(self, repeats: int = 3) -> None:
+        import jax
+
+        if self.parts is None:
+            # generic body (CPU test mesh / fallback): plain per-call
+            for _ in range(max(1, repeats)):
+                s0, _ = self.sim.stage(self.inject)
+                jax.block_until_ready(s0.received)
+                t0 = time.perf_counter()
+                out = self.sim.run_staged_fixed(s0, self.rounds)
+                jax.block_until_ready(out.received)
+                self.samples.append(time.perf_counter() - t0)
+            self._last, self._last_s0 = out, s0
+            return
+
+        # Chained amortized timing: per-call wall time on the tunnel is
+        # dominated by a ~100 ms per-BLOCKING-POINT overhead (and in
+        # the session's async mode block_until_ready can return before
+        # the compute has actually run, making per-call numbers lie
+        # FAST).  Chaining K data-dependent calls with a single D2H
+        # completion fence at the end and differencing two chain
+        # lengths measures the true per-convergence device time,
+        # correct in both session modes.
+        loop_fn = self.parts[0]
+        s0 = self.state0
+
+        def chain(k: int) -> float:
+            out = (s0.received, s0.frontier)
+            t0 = time.perf_counter()
+            for _ in range(k):
+                out = loop_fn(*out)
+            np.asarray(out[0][:1, :1])       # completion fence (D2H)
+            return time.perf_counter() - t0
+
+        est = max(chain(2) / 2, 1e-5)        # incl. fence overhead
+        k1 = min(max(2, int(round(0.6 / est))), 16)
+        k2 = 4 * k1
+        for _ in range(max(1, repeats)):
+            self.samples.append(_chain_diff(chain, k1, k2))
+        # one fresh single call for finish()/validation (not timed)
+        s1, _ = self.sim.stage(self.inject)
+        jax.block_until_ready(s1.received)
+        self._last = loop_fn(s1.received, s1.frontier)
+        self._last_s0 = s1
+
+    def finish(self):
+        """(median_s, rounds, final_state); re-discovers and re-times
+        on device if the host-computed round count was wrong."""
+        if self.parts is None:
+            state = self._last
+        else:
+            state = self.parts[1](self._last_s0, self._last)
+        if not self.sim.converged(state, self.target):
+            _, true_rounds = self.sim.run(self.inject)
+            assert true_rounds != self.rounds, \
+                "fixed runner diverged from run()"
+            retry = TimedRun(self.sim, self.inject, true_rounds)
+            retry.prepare()
+            retry.sample(max(1, len(self.samples)))
+            return retry.finish()
+        assert int(state.t) == self.rounds
+        return (sorted(self.samples)[len(self.samples) // 2],
+                self.rounds, state)
+
+
+def bench_structured(n: int, entries, repeats: int = 3) -> dict:
+    """Run several structured convergence benchmarks with the session-
+    clean two-phase schedule.  ``entries``: (name, topology, n_values,
+    kw, n_dirs) tuples.  Returns {name: {wall_s, rounds, ms_per_round,
+    gbytes_per_s_lb}} — gbytes_per_s_lb is a logical-traffic lower
+    bound on achieved HBM bandwidth in GIGABYTES/s: what a perfectly
+    fused round must stream (read received+frontier, write
+    received+frontier, plus one full-bitset payload read per exchange
+    direction)."""
     from .broadcast import make_inject
 
-    inject = make_inject(n, n_values)
-    bitset_gb = n * (n_values // 32) * 4 / 1e9     # one (W, N) array
-    strides = expander_strides(n, degree=8, seed=strides_seed)
-    out: dict = {"n_values": n_values}
-    for topo, kw, n_dirs in (
-            ("tree", {"branching": branching}, branching + 1),
-            ("circulant", {"strides": strides}, 2 * len(strides))):
-        sim = structured_sim(topo, n, n_values, **kw)
-        dt, rounds, _ = timed_convergence(sim, inject)
-        out[topo] = {
+    runs = []
+    for name, topo, nv, kw, n_dirs in entries:
+        sim = structured_sim(topo, n, nv, **kw)
+        tr = TimedRun(sim, make_inject(n, nv),
+                      discover_rounds(topo, n, nv, **kw))
+        tr.prepare()
+        tr.sample(repeats)
+        runs.append((name, nv, n_dirs, tr))
+    out: dict = {}
+    for name, nv, n_dirs, tr in runs:    # finishes AFTER all sampling
+        dt, rounds, state = tr.finish()
+        bitset_gb = n * (nv // 32) * 4 / 1e9
+        out[name] = {
             "wall_s": round(dt, 4), "rounds": rounds,
             "ms_per_round": round(dt / rounds * 1e3, 3),
             "gbytes_per_s_lb": round(
-                (4 + n_dirs) * bitset_gb * rounds / dt, 1)}
+                (4 + n_dirs) * bitset_gb * rounds / dt, 1),
+            "_state": state, "_sim": tr.sim}
     return out
+
+
+def _chain_diff(chain, k1: int, k2: int, attempts: int = 3) -> float:
+    """One amortized sample (t(k2) - t(k1)) / (k2 - k1), re-measured
+    when a session hiccup makes the difference non-positive — a
+    garbage sample must be discarded, not clamped into a fake ~0."""
+    for _ in range(attempts):
+        t1, t2 = chain(k1), chain(k2)
+        if t2 > t1:
+            return (t2 - t1) / (k2 - k1)
+    raise RuntimeError(
+        f"chained timing unstable: t({k2}) <= t({k1}) "
+        f"{attempts} times in a row")
+
+
+def chained_time(step, out0, fence, repeats: int = 3,
+                 target_s: float = 0.6) -> float:
+    """Median amortized per-call seconds of ``step`` (out -> out,
+    data-dependent), with ``fence(out)`` forcing completion via a tiny
+    D2H read.  Same per-blocking-point cancellation as
+    :meth:`TimedRun.sample`, for non-broadcast sims (counter, kafka)."""
+    def chain(k: int) -> float:
+        out = out0
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = step(out)
+        fence(out)
+        return time.perf_counter() - t0
+
+    est = max(chain(2) / 2, 1e-5)
+    k1 = min(max(2, int(round(target_s / est))), 16)
+    k2 = 4 * k1
+    samples = [_chain_diff(chain, k1, k2) for _ in range(max(1, repeats))]
+    return sorted(samples)[len(samples) // 2]
+
+
+def timed_convergence(sim, inject: np.ndarray, repeats: int = 3,
+                      rounds: int | None = None):
+    """(elapsed_s, rounds, final_state) for one convergence benchmark
+    of ``sim`` on ``inject`` — single-run convenience over
+    :class:`TimedRun`.  Pass ``rounds`` from :func:`discover_rounds`
+    to keep the process session-clean; with ``rounds=None`` the count
+    is discovered by a host-stepped device run first (fine off-tunnel,
+    e.g. the CPU test mesh).  The MEDIAN of ``repeats`` samples is
+    reported, so one anomalous sample (async-dispatch hiccup, tunnel
+    jitter) cannot become the recorded number in either direction."""
+    if rounds is None:
+        _, rounds = sim.run(inject)
+    tr = TimedRun(sim, inject, rounds)
+    tr.prepare()
+    tr.sample(repeats)
+    return tr.finish()
+
+
+def words_axis_entries(n: int, n_values: int, *, branching: int = 4,
+                       strides_seed: int = 0) -> list:
+    """The (name, topology, n_values, kw, n_dirs) entries of the
+    many-values regime — THE single definition of its traffic model,
+    consumed by :func:`words_axis_regime` (run_all config 6) and
+    prepended to bench.py's entry list, so the two cannot drift."""
+    from ..parallel.topology import expander_strides
+
+    strides = expander_strides(n, degree=8, seed=strides_seed)
+    return [("tree", "tree", n_values, {"branching": branching},
+             branching + 1),
+            ("circulant", "circulant", n_values, {"strides": strides},
+             2 * len(strides))]
+
+
+def format_words_regime(res: dict, n_values: int) -> dict:
+    """Public w128-style dict from a :func:`bench_structured` result
+    holding the :func:`words_axis_entries` names."""
+    out = {"n_values": n_values}
+    for name in ("tree", "circulant"):
+        out[name] = {k: v for k, v in res[name].items()
+                     if not k.startswith("_")}
+    return out
+
+
+def words_axis_regime(n: int = 1 << 20, n_values: int = 4096, *,
+                      branching: int = 4, strides_seed: int = 0) -> dict:
+    """The many-values regime (W = n_values/32 bitset words per node):
+    timed convergence on tree and circulant structured exchanges."""
+    res = bench_structured(
+        n, words_axis_entries(n, n_values, branching=branching,
+                              strides_seed=strides_seed))
+    return format_words_regime(res, n_values)
 
 
 def _nbrs_for(topology: str, n: int, **kw) -> np.ndarray:

@@ -498,6 +498,120 @@ def test_srv_ledger_sync_waves_match_virtual_harness():
     assert sum(SYNC_WAVE_EXPECT.values()) == sum(snap.values())
 
 
+def test_run_staged_fixed_matches_while_runner():
+    # the benchmark timed path (counter-only fori_loop of exactly R
+    # rounds) must be bit-identical to the data-dependent while runner
+    # on every backend variant: gather single-device, gather sharded
+    # (1D + 2D mesh), words-major structured, and delay mode
+    n, nv = 64, 40
+    nbrs = to_padded_neighbors(tree(n))
+    inject = make_inject(n, nv)
+    delays = np.random.default_rng(0).integers(
+        1, 4, nbrs.shape).astype(np.int32)
+    variants = [
+        BroadcastSim(nbrs, n_values=nv, sync_every=6),
+        BroadcastSim(nbrs, n_values=nv, sync_every=6, mesh=mesh_1d()),
+        BroadcastSim(nbrs, n_values=nv, sync_every=6, mesh=mesh_2d()),
+        BroadcastSim(nbrs, n_values=nv, sync_every=6, delays=delays),
+    ]
+    from gossip_glomers_tpu.tpu_sim.timing import structured_sim
+    variants.append(structured_sim("tree", n, nv, sync_every=6,
+                                   branching=4))
+    for sim in variants:
+        ref, rounds = sim.run_fused(inject)
+        state0, target = sim.stage(inject)
+        fixed = sim.run_staged_fixed(state0, rounds)
+        assert int(fixed.t) == rounds
+        assert (np.asarray(fixed.received)
+                == np.asarray(ref.received)).all()
+        assert int(fixed.msgs) == int(ref.msgs)
+        if ref.srv_msgs is not None:
+            assert int(fixed.srv_msgs) == int(ref.srv_msgs)
+
+
+def test_fixed_flood_specialization_matches_while_runner():
+    # the pure-flood fixed runner (closed-form msgs ledger, phase-split
+    # loop_fn/finish) only engages when words_major AND mesh is None —
+    # construct that sim explicitly (conftest's 8-device mesh otherwise
+    # routes every structured_sim through the sharded generic path)
+    from gossip_glomers_tpu.tpu_sim.structured import make_exchange
+    n, nv = 256, 96                        # W = 3 words, 3 distinct degs
+    nbrs = to_padded_neighbors(tree(n))
+    inject = make_inject(n, nv)
+    sim = BroadcastSim(nbrs, n_values=nv, sync_every=64, mesh=None,
+                       exchange=make_exchange("tree", n, branching=4),
+                       srv_ledger=False)
+    ref, rounds = sim.run_fused(inject)
+    assert rounds <= 64                    # no sync wave fires
+    parts = sim.build_fixed(rounds)
+    assert parts is not None, "flood specialization did not engage"
+    state0, target = sim.stage(inject)
+    fixed = sim.run_staged_fixed(state0, rounds)
+    assert int(fixed.t) == rounds
+    assert (np.asarray(fixed.received) == np.asarray(ref.received)).all()
+    assert int(fixed.msgs) == int(ref.msgs)   # closed-form ledger exact
+
+    # the chained TimedRun branch must also take this path and agree
+    from gossip_glomers_tpu.tpu_sim.timing import TimedRun
+    tr = TimedRun(sim, inject, rounds)
+    tr.prepare()
+    assert tr.parts is not None
+    tr.sample(repeats=1)
+    dt, r2, state = tr.finish()
+    assert dt > 0 and r2 == rounds
+    assert int(state.msgs) == int(ref.msgs)
+
+
+def test_discover_rounds_tree_matches_bfs():
+    # exact eccentricity, cross-checked against brute-force BFS —
+    # including ragged trees where all deepest leaves live in ONE
+    # root-child subtree (n=6: node 5 is the only depth-2 node)
+    from collections import deque
+
+    from gossip_glomers_tpu.tpu_sim.timing import discover_rounds
+
+    def bfs_rounds(n, k, n_values):
+        adj = [[] for _ in range(n)]
+        for i in range(1, n):
+            p = (i - 1) // k
+            adj[p].append(i)
+            adj[i].append(p)
+        best = 0
+        for v in range(min(n_values, n)):
+            o = v % n
+            dist = [-1] * n
+            dist[o] = 0
+            q = deque([o])
+            while q:
+                u = q.popleft()
+                for w in adj[u]:
+                    if dist[w] < 0:
+                        dist[w] = dist[u] + 1
+                        q.append(w)
+            best = max(best, max(dist))
+        return best
+
+    for n in (1, 2, 5, 6, 7, 21, 64, 86, 341):
+        for k in (2, 4):
+            for nv in (1, 3, 8):
+                assert discover_rounds("tree", n, nv, branching=k) \
+                    == bfs_rounds(n, k, nv), (n, k, nv)
+
+
+def test_discover_rounds_circulant_matches_sim():
+    from gossip_glomers_tpu.parallel.topology import (circulant,
+                                                      expander_strides)
+    from gossip_glomers_tpu.tpu_sim.timing import discover_rounds
+
+    n = 512
+    strides = expander_strides(n, degree=6, seed=2)
+    R = discover_rounds("circulant", n, 32, strides=strides)
+    sim = BroadcastSim(circulant(n, strides), n_values=32,
+                       sync_every=1 << 20, srv_ledger=False)
+    _, rounds = sim.run(make_inject(n, 32))
+    assert R == rounds
+
+
 def test_timing_helpers_match_plain_run():
     # bench.py / run_all.py build their sims through timing.structured_sim
     # (picked mesh + halo exchanges) and time via timed_convergence; the
