@@ -199,6 +199,52 @@ def _sync_diff_pc(payload_full: jnp.ndarray, recv_local: jnp.ndarray,
                          term(0))
 
 
+def _degree_masks(np_deg: np.ndarray):
+    """(distinct degrees, per-degree full-ones (1, N) uint32 mask
+    arrays) — the static masks the closed-form flood ledger ANDs with
+    per-node popcounts instead of a u32 vector multiply (which, like
+    1-D intermediates, lowers poorly on TPU)."""
+    degs = sorted(set(np_deg.tolist()))
+    return degs, [jnp.asarray(
+        ((np_deg == d).astype(np.uint32)
+         * np.uint32(0xFFFFFFFF))[None, :]) for d in degs]
+
+
+def _flood_loop(exchange, rounds: int):
+    """Pure exchange+merge fori_loop body over (received, frontier) —
+    the timed benchmark program (no bookkeeping: in-loop reduces and
+    selects defeat XLA's loop fusion)."""
+    def loop(rec, fr):
+        def one(i, c):
+            rec, fr = c
+            new = exchange(fr) & ~rec
+            return (rec | new, new)
+
+        return lax.fori_loop(0, rounds, one, (rec, fr))
+
+    return loop
+
+
+def _flood_ledger(state: BroadcastState, rec, fr, degs, masks,
+                  rounds: int,
+                  reduce_sum=lambda s: s) -> BroadcastState:
+    """Recover the value-message ledger of a pure flood in closed form:
+    every (node, value) bit in `received` was in the frontier of
+    exactly one executed round — flooded to deg neighbors then —
+    except the final frontier (arrived last round, never flooded), so
+    msgs += sum_i deg_i * (pc_i(received) - pc_i(frontier))."""
+    dpc = (_popcount(rec).sum(axis=0, keepdims=True)
+           - _popcount(fr).sum(axis=0, keepdims=True)
+           ).astype(jnp.uint32)
+    sent = jnp.uint32(0)
+    for d, m in zip(degs, masks):
+        sent = sent + jnp.uint32(d) * jnp.sum(dpc & m,
+                                              dtype=jnp.uint32)
+    return state._replace(received=rec, frontier=fr,
+                          t=state.t + jnp.int32(rounds),
+                          msgs=state.msgs + reduce_sum(sent))
+
+
 def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
            nbrs: jnp.ndarray, nbr_mask: jnp.ndarray, parts: Partitions,
            sync_every: int,
@@ -782,69 +828,29 @@ class BroadcastSim:
 
         # Pure-flood specialization: when no sync wave fires within the
         # trip count (rounds <= sync_every) and no ledgers/faults need
-        # per-round bookkeeping, the loop body is JUST exchange+merge —
-        # which XLA fuses into a VMEM-resident program (measured ~1000x
-        # faster per round at 1M nodes / W=1 than the bookkeeping body,
-        # whose in-loop scalar reduces and selects defeat loop fusion).
-        # The value-message ledger is recovered EXACTLY post-loop in
-        # closed form: every (node, value) bit that entered `received`
-        # was in the frontier of exactly one executed round — and was
-        # flooded to deg neighbors then — except the final frontier
-        # (arrived in the last round, never flooded).  So
-        #   msgs += sum_i deg_i * (pc_i(received) - pc_i(frontier)).
-        # Computed with static per-degree full-ones masks (bitwise AND
-        # + scalar reduce, all 2-D shapes) because a u32 vector multiply
-        # and 1-D intermediates lower poorly on TPU.  Bit-exactness vs
-        # the while runner is pinned by
-        # test_run_staged_fixed_matches_while_runner.
+        # per-round bookkeeping, the loop body is JUST exchange+merge
+        # (_flood_loop) — which XLA fuses into a VMEM-resident program,
+        # measured ~1000x faster per round at 1M nodes / W=1 than the
+        # bookkeeping body — and the value-message ledger is recovered
+        # exactly post-loop (_flood_ledger).  Bit-exactness vs the
+        # while runner is pinned by
+        # test_run_staged_fixed_matches_while_runner and
+        # test_fixed_flood_specialization_matches_while_runner.
         flood_ok = (wm and not self._srv_on and self.delays is None
                     and rounds <= sync_every and rounds > 0)
 
         if self.mesh is None and flood_ok:
-            exchange = self.exchange
-            np_deg = self._host_deg          # NO device readback here
-            degs = sorted(set(np_deg.tolist()))
-            masks = [jax.device_put(jnp.asarray(
-                ((np_deg == d).astype(np.uint32)
-                 * np.uint32(0xFFFFFFFF))[None, :])) for d in degs]
+            # degrees come from the host copy: a device readback here
+            # would flip the tunnel session (see timing.py)
+            degs, mask_arrays = _degree_masks(self._host_deg)
+            masks = [jax.device_put(m) for m in mask_arrays]
+            loop_fn = jax.jit(_flood_loop(self.exchange, rounds))
 
             @jax.jit
-            def loop_fn(rec, fr):
-                def one(i, c):
-                    rec, fr = c
-                    new = exchange(fr) & ~rec
-                    return (rec | new, new)
+            def ledger_fn(state: BroadcastState, rec, fr, *ms):
+                return _flood_ledger(state, rec, fr, degs, ms, rounds)
 
-                return lax.fori_loop(0, rounds, one, (rec, fr))
-
-            @jax.jit
-            def ledger_fn(state: BroadcastState, rec, fr, *masks):
-                dpc = (_popcount(rec).sum(axis=0, keepdims=True)
-                       - _popcount(fr).sum(axis=0, keepdims=True)
-                       ).astype(jnp.uint32)
-                sent = jnp.uint32(0)
-                for d, m in zip(degs, masks):
-                    sent = sent + jnp.uint32(d) * jnp.sum(
-                        dpc & m, dtype=jnp.uint32)
-                return state._replace(
-                    received=rec, frontier=fr,
-                    t=state.t + jnp.int32(rounds),
-                    msgs=state.msgs + sent)
-
-            def finish(state0, loop_out):
-                return ledger_fn(state0, *loop_out, *masks)
-
-            # phase-split handles for benchmarks: the loop program is
-            # the only thing a timed sample should execute — the ledger
-            # program's reduces disturb the tunnel session (timing.py
-            # runs every sample before any finish)
-            self._fixed_parts = (loop_fn, finish)
-
-            def composed(state, nbrs, nbr_mask):
-                return finish(state, loop_fn(state.received,
-                                             state.frontier))
-
-            return composed
+            return self._wire_flood_parts(loop_fn, ledger_fn, masks)
 
         if self.mesh is None:
             @jax.jit
@@ -865,6 +871,38 @@ class BroadcastSim:
 
         mesh = self.mesh
         state_spec, node_spec, part_spec = self._specs()
+
+        if flood_ok and self.sharded_exchange is not None:
+            # mesh twin of the pure-flood specialization: same loop and
+            # closed-form ledger cores, wrapped in shard_map — per-shard
+            # masked reduces psum-globalized (word shards partition the
+            # popcounts; frontier ⊆ received bitwise, so per-shard
+            # partial sums subtract safely in uint32)
+            st_spec = self._state_spec
+            axes = tuple(mesh.axis_names)
+            degs, mask_arrays = _degree_masks(self._host_deg)
+            mask_spec = P(None, "nodes")
+            masks = [jax.device_put(m, NamedSharding(mesh, mask_spec))
+                     for m in mask_arrays]
+
+            loop_fn = jax.jit(functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(st_spec, st_spec),
+                out_specs=(st_spec, st_spec), check_vma=False,
+            )(_flood_loop(self.sharded_exchange, rounds)))
+
+            @jax.jit
+            @functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(state_spec, st_spec, st_spec)
+                + tuple(mask_spec for _ in masks),
+                out_specs=state_spec, check_vma=False,
+            )
+            def ledger_fn(state: BroadcastState, rec, fr, *ms):
+                return _flood_ledger(state, rec, fr, degs, ms, rounds,
+                                     lambda s: lax.psum(s, axes))
+
+            return self._wire_flood_parts(loop_fn, ledger_fn, masks)
 
         if wm:
             @jax.jit
@@ -965,6 +1003,22 @@ class BroadcastSim:
         state, target = self.stage(inject)
         final = self.run_staged(state, target, max_rounds=max_rounds)
         return final, int(final.t)
+
+    def _wire_flood_parts(self, loop_fn, ledger_fn, masks):
+        """Phase-split handles for benchmarks: the loop program is the
+        only thing a timed sample should execute — the ledger program's
+        reduces disturb the tunnel session (timing.py runs every sample
+        before any finish)."""
+        def finish(state0, loop_out):
+            return ledger_fn(state0, *loop_out, *masks)
+
+        self._fixed_parts = (loop_fn, finish)
+
+        def composed(state, nbrs, nbr_mask):
+            return finish(state, loop_fn(state.received,
+                                         state.frontier))
+
+        return composed
 
     def build_fixed(self, rounds: int):
         """Build (and cache) the fixed-trip runner for ``rounds``.

@@ -169,46 +169,38 @@ class TimedRun:
     def sample(self, repeats: int = 3) -> None:
         import jax
 
-        if self.parts is None:
-            # generic body (CPU test mesh / fallback): plain per-call
+        # Chained amortized timing (see chained_time) needs a real
+        # accelerator: on the CPU test backend the tunnel artifacts it
+        # cancels don't exist, and long chains of shard_map collective
+        # programs can abort XLA's CPU runtime — use plain per-call
+        # timing there (and for the generic un-split body).
+        chained = (self.parts is not None
+                   and jax.devices()[0].platform != "cpu")
+        if chained:
+            loop_fn = self.parts[0]
+            s0 = self.state0
+            self.samples.extend(_chained_samples(
+                lambda out: loop_fn(*out), (s0.received, s0.frontier),
+                lambda out: np.asarray(out[0][:1, :1]), repeats))
+        else:
             for _ in range(max(1, repeats)):
                 s0, _ = self.sim.stage(self.inject)
                 jax.block_until_ready(s0.received)
                 t0 = time.perf_counter()
-                out = self.sim.run_staged_fixed(s0, self.rounds)
-                jax.block_until_ready(out.received)
+                if self.parts is None:
+                    out = self.sim.run_staged_fixed(s0, self.rounds)
+                    jax.block_until_ready(out.received)
+                else:
+                    out = self.parts[0](s0.received, s0.frontier)
+                    jax.block_until_ready(out[0])
                 self.samples.append(time.perf_counter() - t0)
-            self._last, self._last_s0 = out, s0
-            return
-
-        # Chained amortized timing: per-call wall time on the tunnel is
-        # dominated by a ~100 ms per-BLOCKING-POINT overhead (and in
-        # the session's async mode block_until_ready can return before
-        # the compute has actually run, making per-call numbers lie
-        # FAST).  Chaining K data-dependent calls with a single D2H
-        # completion fence at the end and differencing two chain
-        # lengths measures the true per-convergence device time,
-        # correct in both session modes.
-        loop_fn = self.parts[0]
-        s0 = self.state0
-
-        def chain(k: int) -> float:
-            out = (s0.received, s0.frontier)
-            t0 = time.perf_counter()
-            for _ in range(k):
-                out = loop_fn(*out)
-            np.asarray(out[0][:1, :1])       # completion fence (D2H)
-            return time.perf_counter() - t0
-
-        est = max(chain(2) / 2, 1e-5)        # incl. fence overhead
-        k1 = min(max(2, int(round(0.6 / est))), 16)
-        k2 = 4 * k1
-        for _ in range(max(1, repeats)):
-            self.samples.append(_chain_diff(chain, k1, k2))
+            if self.parts is None:
+                self._last, self._last_s0 = out, s0
+                return
         # one fresh single call for finish()/validation (not timed)
         s1, _ = self.sim.stage(self.inject)
         jax.block_until_ready(s1.received)
-        self._last = loop_fn(s1.received, s1.frontier)
+        self._last = self.parts[0](s1.received, s1.frontier)
         self._last_s0 = s1
 
     def finish(self):
@@ -259,7 +251,7 @@ def bench_structured(n: int, entries, repeats: int = 3) -> dict:
             "ms_per_round": round(dt / rounds * 1e3, 3),
             "gbytes_per_s_lb": round(
                 (4 + n_dirs) * bitset_gb * rounds / dt, 1),
-            "_state": state, "_sim": tr.sim}
+            "_state": state}
     return out
 
 
@@ -276,12 +268,15 @@ def _chain_diff(chain, k1: int, k2: int, attempts: int = 3) -> float:
         f"{attempts} times in a row")
 
 
-def chained_time(step, out0, fence, repeats: int = 3,
-                 target_s: float = 0.6) -> float:
-    """Median amortized per-call seconds of ``step`` (out -> out,
+def _chained_samples(step, out0, fence, repeats: int = 3,
+                     target_s: float = 0.6) -> list:
+    """``repeats`` amortized per-call samples of ``step`` (out -> out,
     data-dependent), with ``fence(out)`` forcing completion via a tiny
-    D2H read.  Same per-blocking-point cancellation as
-    :meth:`TimedRun.sample`, for non-broadcast sims (counter, kafka)."""
+    D2H read.  Per-blocking-point overhead cancels in the chain-length
+    difference (module docstring); one untimed warm call first so the
+    k-calibration estimate never includes compile time."""
+    fence(step(out0))                        # warm / compile, untimed
+
     def chain(k: int) -> float:
         out = out0
         t0 = time.perf_counter()
@@ -293,7 +288,15 @@ def chained_time(step, out0, fence, repeats: int = 3,
     est = max(chain(2) / 2, 1e-5)
     k1 = min(max(2, int(round(target_s / est))), 16)
     k2 = 4 * k1
-    samples = [_chain_diff(chain, k1, k2) for _ in range(max(1, repeats))]
+    return [_chain_diff(chain, k1, k2) for _ in range(max(1, repeats))]
+
+
+def chained_time(step, out0, fence, repeats: int = 3,
+                 target_s: float = 0.6) -> float:
+    """Median amortized per-call seconds of ``step`` — the chained
+    methodology (module docstring) for non-broadcast sims (counter,
+    kafka); :meth:`TimedRun.sample` uses the same sampler."""
+    samples = _chained_samples(step, out0, fence, repeats, target_s)
     return sorted(samples)[len(samples) // 2]
 
 

@@ -561,6 +561,25 @@ def test_fixed_flood_specialization_matches_while_runner():
     assert dt > 0 and r2 == rounds
     assert int(state.msgs) == int(ref.msgs)
 
+    # mesh twin on the 2D (nodes x words) mesh: halo loop + per-shard
+    # masked ledger psum-globalized over word shards
+    from gossip_glomers_tpu.tpu_sim.structured import make_sharded_exchange
+    nv2 = 128                              # W = 4, divisible by 2 words
+    inj2 = make_inject(n, nv2)
+    sim2 = BroadcastSim(nbrs, n_values=nv2, sync_every=64,
+                        mesh=mesh_2d(),
+                        exchange=make_exchange("tree", n, branching=4),
+                        sharded_exchange=make_sharded_exchange(
+                            "tree", n, 4, branching=4),
+                        srv_ledger=False)
+    ref2, rounds2 = sim2.run_fused(inj2)
+    assert sim2.build_fixed(rounds2) is not None, \
+        "mesh flood specialization did not engage"
+    st0, _ = sim2.stage(inj2)
+    fx2 = sim2.run_staged_fixed(st0, rounds2)
+    assert (np.asarray(fx2.received) == np.asarray(ref2.received)).all()
+    assert int(fx2.msgs) == int(ref2.msgs)
+
 
 def test_discover_rounds_tree_matches_bfs():
     # exact eccentricity, cross-checked against brute-force BFS —
