@@ -78,8 +78,11 @@ def discover_rounds(topology: str, n: int, n_values: int, **kw) -> int:
       outside the branch containing o (heap indexing makes subtree
       depth ranges closed-form; cross-checked against BFS in
       test_discover_rounds_tree_matches_bfs);
-    - circulant: vertex-transitive, so ecc is the same for every
-      origin — one numpy BFS over the stride graph gives it.
+    - circulant / ring: vertex-transitive, so ecc is the same for
+      every origin — one numpy BFS over the stride graph gives it;
+    - line: ecc(o) = max(o, n-1-o);
+    - grid (ragged, grid_cols columns): Manhattan ecc over the corner
+      candidates of the staircase-convex cell region.
     Validated post-run: :meth:`TimedRun.finish` asserts the result
     actually converged and falls back to device discovery if not (that
     self-heals an under-estimate; the formulas here are exact, which
@@ -121,8 +124,8 @@ def discover_rounds(topology: str, n: int, n_values: int, **kw) -> int:
             return best
 
         return max(ecc(v % n) for v in range(min(n_values, n)))
-    if topology == "circulant":
-        strides = list(kw["strides"])
+    if topology in ("circulant", "ring"):
+        strides = [1] if topology == "ring" else list(kw["strides"])
         reach = np.zeros(n, bool)
         reach[0] = True
         frontier = reach.copy()
@@ -137,6 +140,30 @@ def discover_rounds(topology: str, n: int, n_values: int, **kw) -> int:
             reach |= frontier
             rounds += 1
         return rounds
+    if topology == "line":
+        return max(max(v % n, n - 1 - v % n)
+                   for v in range(min(n_values, n)))
+    if topology == "grid":
+        from ..parallel.topology import grid_cols
+
+        cols = kw.get("cols") or grid_cols(n)
+        rows = (n + cols - 1) // cols
+        last = n - (rows - 1) * cols       # width of the ragged last row
+
+        def ecc(o: int) -> int:
+            r0, c0 = divmod(o, cols)
+            best = 0
+            for r in (0, rows - 1):
+                w = cols if r < rows - 1 else last
+                for c in (0, w - 1):
+                    best = max(best, abs(r - r0) + abs(c - c0))
+            # the ragged corner (cols-1 of the second-to-last row) can
+            # exceed all four outer corners when the last row is short
+            if last < cols and rows >= 2:
+                best = max(best, abs(rows - 2 - r0) + abs(cols - 1 - c0))
+            return best
+
+        return max(ecc(v % n) for v in range(min(n_values, n)))
     raise ValueError(topology)
 
 
@@ -354,11 +381,15 @@ def words_axis_regime(n: int = 1 << 20, n_values: int = 4096, *,
 
 
 def _nbrs_for(topology: str, n: int, **kw) -> np.ndarray:
-    from ..parallel.topology import circulant, to_padded_neighbors, tree
+    from ..parallel.topology import (circulant, grid, line, ring,
+                                     to_padded_neighbors, tree)
 
     if topology == "tree":
         return to_padded_neighbors(
             tree(n, branching=kw.get("branching", 4)))
     if topology == "circulant":
         return circulant(n, list(kw["strides"]))
+    if topology in ("grid", "ring", "line"):
+        builder = {"grid": grid, "ring": ring, "line": line}[topology]
+        return to_padded_neighbors(builder(n))
     raise ValueError(topology)

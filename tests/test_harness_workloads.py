@@ -149,3 +149,38 @@ def test_kafka_fault_campaign_no_partition_still_contends():
     # 5-way bursts on one key: ranks 0..4 per burst, so the serialized
     # CAS ladder fires well above one cas per send
     assert kv["cas"] >= res.details["n_acked"] * 2
+
+
+def test_workloads_replay_bit_identical():
+    """All randomness is seeded (survey §7 'hard parts': deterministic
+    replay of an asynchronous system): running any workload twice with
+    the same seed must reproduce the EXACT ledger — totals, per-type
+    splits, drops, op latencies — not just the same pass/fail."""
+    from gossip_glomers_tpu.harness import random_partitions
+    from gossip_glomers_tpu.harness.workloads import (run_broadcast,
+                                                      run_counter,
+                                                      run_kafka,
+                                                      run_kafka_faults,
+                                                      run_unique_ids)
+
+    def parts9():
+        return random_partitions([f"n{i}" for i in range(9)],
+                                 t_end=6.0, seed=5)
+
+    runs = [
+        lambda: run_unique_ids(n_nodes=3, n_ops=40, seed=3),
+        lambda: run_broadcast(n_nodes=9, topology="grid", n_values=12,
+                              rate=30.0, latency=0.05, quiescence=6.0,
+                              partitions=parts9(), seed=5),
+        lambda: run_counter(n_nodes=3, n_ops=24, rate=20.0,
+                            quiescence=6.0, stale_read_prob=0.3,
+                            seed=7),
+        lambda: run_kafka(n_nodes=2, n_keys=3, n_ops=50, seed=11),
+        lambda: run_kafka_faults(n_nodes=4, n_keys=2, n_bursts=4,
+                                 latency=0.03, seed=13),
+    ]
+    for make in runs:
+        a, b = make(), make()
+        assert a.ok == b.ok
+        assert a.stats == b.stats, (a.stats, b.stats)
+        assert a.details == b.details

@@ -617,6 +617,37 @@ def test_discover_rounds_tree_matches_bfs():
                     == bfs_rounds(n, k, nv), (n, k, nv)
 
 
+def test_discover_rounds_all_topologies_match_sim():
+    # ring / line / grid (incl. ragged grids): the host oracle must
+    # equal the gather sim's actual convergence round count
+    from gossip_glomers_tpu.parallel.topology import grid, ring
+    from gossip_glomers_tpu.tpu_sim.timing import discover_rounds
+
+    cases = [("ring", ring, [5, 8, 17]),
+             ("line", line, [2, 7, 16]),
+             ("grid", grid, [9, 12, 16, 30])]   # 12, 30: ragged rows
+    for topo, builder, sizes in cases:
+        for n in sizes:
+            for nv in (1, 4, 16):
+                sim = BroadcastSim(to_padded_neighbors(builder(n)),
+                                   n_values=nv, sync_every=1 << 20,
+                                   srv_ledger=False)
+                _, rounds = sim.run(make_inject(n, nv))
+                assert discover_rounds(topo, n, nv) == rounds, \
+                    (topo, n, nv, rounds)
+
+    # the oracle is reachable from the benchmark path: structured_sim
+    # + timed_convergence accept these topologies end to end
+    from gossip_glomers_tpu.tpu_sim.timing import (structured_sim,
+                                                   timed_convergence)
+    sim = structured_sim("grid", 64, 8)
+    dt, rounds, state = timed_convergence(sim, make_inject(64, 8),
+                                          repeats=1,
+                                          rounds=discover_rounds(
+                                              "grid", 64, 8))
+    assert dt > 0 and rounds == discover_rounds("grid", 64, 8)
+
+
 def test_discover_rounds_circulant_matches_sim():
     from gossip_glomers_tpu.parallel.topology import (circulant,
                                                       expander_strides)
