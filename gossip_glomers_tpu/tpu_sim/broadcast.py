@@ -401,8 +401,9 @@ class BroadcastSim:
       arbitrary topologies and per-edge partition schedules.
     - **words-major (W, N)** with a structured ``exchange`` from
     structured.py — gather-free contiguous delivery for named
-    topologies, ~1000x faster per round at 1M nodes (lane-dense layout,
-    no tile-granularity random reads).  No partitions.
+    topologies, ~60-190x faster per round at 1M nodes / W=1
+    (lane-dense layout, no tile-granularity random reads).  No
+    partitions.
 
     Single-device: plain ``jax.jit``.  Multi-device: ``shard_map`` over
     ``Mesh(axis 'nodes' [, 'words'])`` — the node axis block-sharded
@@ -829,10 +830,11 @@ class BroadcastSim:
         # Pure-flood specialization: when no sync wave fires within the
         # trip count (rounds <= sync_every) and no ledgers/faults need
         # per-round bookkeeping, the loop body is JUST exchange+merge
-        # (_flood_loop) — which XLA fuses into a VMEM-resident program,
-        # measured ~1000x faster per round at 1M nodes / W=1 than the
-        # bookkeeping body — and the value-message ledger is recovered
-        # exactly post-loop (_flood_ledger).  Bit-exactness vs the
+        # (_flood_loop) — free of the in-loop scalar reduces and
+        # selects that defeat XLA's loop fusion, so the whole multi-
+        # round program stays VMEM-resident at W=1 — and the value-
+        # message ledger is recovered exactly post-loop
+        # (_flood_ledger).  Bit-exactness vs the
         # while runner is pinned by
         # test_run_staged_fixed_matches_while_runner and
         # test_fixed_flood_specialization_matches_while_runner.

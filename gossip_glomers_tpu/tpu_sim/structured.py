@@ -17,8 +17,10 @@ random access:
 Layout: **words-major (W, N)** — the node axis is minor, so it packs
 TPU lanes densely.  The node-major (N, W) layout puts W in the lane
 dimension, which at W=1 wastes 127/128 of every vector register and
-memory tile; words-major measured ~1000x faster for the exchange loop
-at 1M nodes.
+memory tile; the structured words-major round measures ~60-190x faster
+than the node-major adjacency gather at 1M nodes / W=1 (chained
+amortized timing: 61 ms/round gather vs 1.07 ms tree / 0.32 ms
+circulant).
 
 Each exchange maps the full (W, N) payload to the full (W, N) inbox and
 equals the padded-adjacency gather over the corresponding topology from
