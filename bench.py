@@ -22,6 +22,7 @@ topologies — the many-values case the words-major layout exists for.
 from __future__ import annotations
 
 import json
+import sys
 
 N_NODES = 1 << 20            # 1,048,576
 N_VALUES = 32                # one bitset word; injected round-robin
@@ -46,45 +47,65 @@ def main() -> None:
     # (the headline plus the shared words_axis_entries, whose traffic
     # model is defined once in timing.py): every timed sample runs
     # before any finish/validation/accounting program — see timing.py's
-    # module docstring for the tunnel-session rationale.
-    res = bench_structured(N_NODES, [
-        ("w1_tree", "tree", N_VALUES, {"branching": BRANCHING},
-         BRANCHING + 1),
-        *words_axis_entries(N_NODES, W128_VALUES,
-                            branching=BRANCHING),
-    ])
+    # module docstring for the tunnel-session rationale.  The w128
+    # entries are best-effort extras: if the combined run fails for any
+    # reason (theirs or a transient), the headline is re-measured alone
+    # so the driver never loses its line; only a headline-alone failure
+    # is fatal.
+    head_entry = ("w1_tree", "tree", N_VALUES, {"branching": BRANCHING},
+                  BRANCHING + 1)
+    try:
+        entries = [head_entry,
+                   *words_axis_entries(N_NODES, W128_VALUES,
+                                       branching=BRANCHING)]
+        res = bench_structured(N_NODES, entries)
+        w128 = format_words_regime(res, W128_VALUES)
+    except Exception as e:                         # noqa: BLE001
+        print(f"combined benchmark run failed ({e!r}); "
+              "retrying headline alone", file=sys.stderr)
+        res = bench_structured(N_NODES, [head_entry])
+        w128 = {"error": f"not measured: combined run failed: {e!r}"}
     head = res["w1_tree"]
     elapsed, rounds, state = (head["wall_s"], head["rounds"],
                               head["_state"])
-    w128 = format_words_regime(res, W128_VALUES)
 
-    # Untimed accounted run: server ledger ON (its sync diff runs every
-    # round under jit and would inflate timed numbers) — reports the
-    # Maelstrom-comparable srv_msgs for the same deterministic
-    # schedule, and independently re-derives the convergence round
-    # count through the data-dependent while runner as validation.
-    sim_acct = structured_sim("tree", N_NODES, N_VALUES,
-                              branching=BRANCHING, srv_ledger=True)
-    state_a, rounds_a = sim_acct.run_fused(inject)
-    assert rounds_a == rounds, (rounds_a, rounds)
-    assert int(state_a.msgs) == int(state.msgs), "ledger mismatch"
-    srv_msgs = sim_acct.server_msgs(state_a)
-
-    print(json.dumps({
+    out = {
         "metric": "1M-node tree broadcast time-to-convergence",
         "value": round(elapsed, 4),
         "unit": "s",
         "vs_baseline": round(BASELINE_TARGET_S / elapsed, 2),
         "rounds": rounds,
         "msgs": int(state.msgs),
-        # Maelstrom-comparable accounting: server messages (broadcast +
-        # ack + anti-entropy reads/pushes) per broadcast op
-        "srv_msgs": srv_msgs,
-        "srv_msgs_per_op": round(srv_msgs / N_VALUES, 1),
         "w1_ms_per_round": round(elapsed / rounds * 1e3, 3),
         "w128": w128,
         "n_devices": len(devices),
-    }))
+    }
+
+    # Untimed accounted run: server ledger ON (its sync diff runs every
+    # round under jit and would inflate timed numbers) — reports the
+    # Maelstrom-comparable srv_msgs for the same deterministic
+    # schedule, and independently re-derives the convergence round
+    # count through the data-dependent while runner as validation.
+    # Best-effort for the same reason as above.
+    try:
+        sim_acct = structured_sim("tree", N_NODES, N_VALUES,
+                                  branching=BRANCHING, srv_ledger=True)
+        state_a, rounds_a = sim_acct.run_fused(inject)
+        assert rounds_a == rounds, (rounds_a, rounds)
+        assert int(state_a.msgs) == int(state.msgs), "ledger mismatch"
+        srv_msgs = sim_acct.server_msgs(state_a)
+        # Maelstrom-comparable accounting: server messages (broadcast +
+        # ack + anti-entropy reads/pushes) per broadcast op
+        out["srv_msgs"] = srv_msgs
+        out["srv_msgs_per_op"] = round(srv_msgs / N_VALUES, 1)
+    except AssertionError:
+        raise   # a ledger/rounds validation failure is a real bug —
+        #         it must crash the benchmark, not become a JSON field
+    except Exception as e:                         # noqa: BLE001
+        print(f"accounted run failed: {e!r}", file=sys.stderr)
+        out["srv_msgs_error"] = repr(e)
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
