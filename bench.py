@@ -59,12 +59,17 @@ def main() -> None:
                    *words_axis_entries(N_NODES, W128_VALUES,
                                        branching=BRANCHING)]
         res = bench_structured(N_NODES, entries)
-        w128 = format_words_regime(res, W128_VALUES)
     except Exception as e:                         # noqa: BLE001
         print(f"combined benchmark run failed ({e!r}); "
               "retrying headline alone", file=sys.stderr)
         res = bench_structured(N_NODES, [head_entry])
         w128 = {"error": f"not measured: combined run failed: {e!r}"}
+    else:
+        try:   # formatting must never discard the measurement
+            w128 = format_words_regime(res, W128_VALUES)
+        except Exception as e:                     # noqa: BLE001
+            print(f"w128 formatting failed: {e!r}", file=sys.stderr)
+            w128 = {"error": f"formatting failed: {e!r}"}
     head = res["w1_tree"]
     elapsed, rounds, state = (head["wall_s"], head["rounds"],
                               head["_state"])

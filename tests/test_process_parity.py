@@ -15,6 +15,7 @@ criterion of BASELINE.json, made executable without any Maelstrom
 install.
 """
 
+import json
 import os
 import sys
 
@@ -91,6 +92,37 @@ def test_25_node_flood_parity_go_vs_ours():
     assert msgs_go["broadcast"] == msgs_py["broadcast"] == want
     assert msgs_go["broadcast_ok"] == msgs_py["broadcast_ok"] == want
     assert msgs_go == msgs_py
+
+
+@needs_go
+def test_fatal_input_parity_go_vs_ours():
+    """Both implementations die (exit 1) on malformed JSON and on a
+    message type with no handler — the reference lib returns the error
+    from Run and every main() exits via log.Fatal."""
+    import subprocess
+
+    # same scrub ProcessNetwork applies: without it the image's
+    # sitecustomize registers the TPU plugin in every child
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    init = json.dumps({"src": "c1", "dest": "n0",
+                       "body": {"type": "init", "msg_id": 1,
+                                "node_id": "n0", "node_ids": ["n0"]}})
+    bogus = json.dumps({"src": "c1", "dest": "n0",
+                        "body": {"type": "no_such_op", "msg_id": 2}})
+    for argv in ([GO_BROADCAST],
+                 PY + ["gossip_glomers_tpu.nodes.broadcast"]):
+        for payload in ("this is not json\n", init + "\n" + bogus + "\n"):
+            p = subprocess.Popen(argv, stdin=subprocess.PIPE,
+                                 stdout=subprocess.DEVNULL,
+                                 stderr=subprocess.DEVNULL,
+                                 text=True, env=env)
+            try:
+                p.stdin.write(payload)
+                p.stdin.flush()
+                assert p.wait(timeout=15) == 1, (argv, payload)
+            finally:
+                p.kill()
 
 
 @needs_go

@@ -100,6 +100,52 @@ def test_unique_ids_node_over_pipes():
         proc.wait(timeout=5)
 
 
+def test_malformed_json_kills_node():
+    # reference parity: Go's Run returns the unmarshal error and every
+    # main() exits via log.Fatal (runtime/node.py:249-252)
+    proc = _spawn("echo")
+    try:
+        proc.stdin.write("this is not json\n")
+        proc.stdin.flush()
+        assert proc.wait(timeout=10) == 1
+    finally:
+        proc.kill()
+
+
+def test_unknown_type_kills_node():
+    # reference parity: "No handler for %s" -> log.Fatal
+    # (runtime/node.py:231-237)
+    proc = _spawn("echo")
+    try:
+        _send(proc, "c1", "n0", {"type": "init", "msg_id": 1,
+                                 "node_id": "n0", "node_ids": ["n0"]})
+        assert _recv(proc)["body"]["type"] == "init_ok"
+        _send(proc, "c1", "n0", {"type": "no_such_op", "msg_id": 2})
+        assert proc.wait(timeout=10) == 1
+    finally:
+        proc.kill()
+
+
+def test_reply_with_no_callback_is_ignored():
+    # reference parity: "Ignoring reply to %d with no callback" — the
+    # node logs and keeps serving (runtime/node.py:123-127; the format
+    # string is embedded in the reference's checked-in binaries)
+    proc = _spawn("echo")
+    try:
+        _send(proc, "c1", "n0", {"type": "init", "msg_id": 1,
+                                 "node_id": "n0", "node_ids": ["n0"]})
+        assert _recv(proc)["body"]["type"] == "init_ok"
+        _send(proc, "c1", "n0", {"type": "echo_ok", "in_reply_to": 999})
+        _send(proc, "c1", "n0", {"type": "echo", "msg_id": 2,
+                                 "echo": "still alive"})
+        reply = _recv(proc)
+        assert reply["body"]["type"] == "echo_ok"
+        assert reply["body"]["echo"] == "still alive"
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=5)
+
+
 def test_console_script_entry_points_registered():
     """Packaging (pyproject [project.scripts]): one Maelstrom-style
     executable per challenge, like the reference's checked-in binaries.
