@@ -34,6 +34,9 @@ W128_VALUES = 4096           # words-axis regime: 128 uint32 words
 def main() -> None:
     import jax
 
+    from gossip_glomers_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
+
     from gossip_glomers_tpu.tpu_sim.broadcast import make_inject
     from gossip_glomers_tpu.tpu_sim.timing import (bench_structured,
                                                    format_words_regime,

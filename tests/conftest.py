@@ -29,3 +29,8 @@ try:
 except ImportError:  # pragma: no cover
     sys.path.insert(0,
                     os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_glomers_tpu.utils.compile_cache import (  # noqa: E402
+    enable_compile_cache)
+
+enable_compile_cache()
