@@ -1,0 +1,117 @@
+"""Maelstrom-style workload CLI over the virtual-clock harness.
+
+The reference is driven as ``maelstrom test -w broadcast --bin ...
+--node-count 25 --time-limit 20 --rate 10 --latency 100 --nemesis
+partition`` (README.md:7-10, 16-18).  This is the same UX against the
+in-repo deterministic harness:
+
+    python -m gossip_glomers_tpu.harness test -w broadcast \
+        --node-count 25 --topology grid --rate 10 --time-limit 10 \
+        --latency 0.1 --nemesis partition --seed 3
+
+Prints a Maelstrom-style summary line ("Everything looks good!" /
+"Analysis invalid") plus one JSON line of the checker stats, and exits
+nonzero on failure — scriptable like the original.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gossip_glomers_tpu.harness",
+        description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    t = sub.add_parser("test", help="run one workload under the harness")
+    t.add_argument("-w", "--workload", required=True,
+                   choices=["echo", "unique-ids", "broadcast", "counter",
+                            "kafka"])
+    t.add_argument("--node-count", type=int, default=None)
+    t.add_argument("--rate", type=float, default=10.0,
+                   help="client ops per (virtual) second")
+    t.add_argument("--time-limit", type=float, default=10.0,
+                   help="virtual seconds of op generation; total ops = "
+                        "rate * time-limit")
+    t.add_argument("--topology", default=None,
+                   help="broadcast topology (tree/grid/ring/line); "
+                        "broadcast only")
+    t.add_argument("--latency", type=float, default=0.0,
+                   help="per-hop delivery latency in virtual seconds")
+    t.add_argument("--nemesis", choices=["partition"], default=None)
+    t.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from .workloads import (run_broadcast, run_counter, run_echo,
+                            run_kafka, run_unique_ids)
+
+    # a flag the chosen workload cannot honor is an error, not a silent
+    # default — a green run must mean the requested configuration ran
+    if args.topology is not None and args.workload != "broadcast":
+        ap.error(f"--topology applies to broadcast, not {args.workload}")
+    if args.nemesis and args.workload not in ("broadcast", "counter"):
+        ap.error(f"--nemesis is not wired for {args.workload}")
+    if args.workload == "echo":
+        if args.node_count not in (None, 1):
+            ap.error("echo is single-node")
+        if args.latency:
+            ap.error("echo has no network to delay")
+
+    def make_partitions(n: int, include: list | None = None):
+        if args.nemesis != "partition":
+            return None
+        from . import random_partitions
+        parts = random_partitions(
+            [f"n{i}" for i in range(n)], t_end=args.time_limit,
+            seed=args.seed, include=include)
+        if not parts.windows:
+            ap.error("--nemesis partition scheduled no windows: "
+                     "--time-limit too short for the partition period")
+        return parts
+
+    # quiescence: anti-entropy interval (2 s) x a few waves, plus heal
+    # time when partitioning and a latency allowance
+    quiescence = 6.0 + (4.0 if args.nemesis else 0.0) + 20 * args.latency
+    n_ops = max(1, int(args.rate * args.time_limit))
+    res = None
+    if args.workload == "echo":
+        res = run_echo(n_ops=n_ops, seed=args.seed)
+    elif args.workload == "unique-ids":
+        res = run_unique_ids(n_nodes=args.node_count or 3, n_ops=n_ops,
+                             latency=args.latency, seed=args.seed)
+    elif args.workload == "broadcast":
+        n = args.node_count or 25
+        res = run_broadcast(
+            n_nodes=n, topology=args.topology or "tree",
+            n_values=n_ops, rate=args.rate, latency=args.latency,
+            quiescence=quiescence, partitions=make_partitions(n),
+            seed=args.seed)
+    elif args.workload == "counter":
+        n = args.node_count or 3
+        # counter nodes talk only to seq-kv: a partition that never
+        # covers the service would be a silent no-op
+        res = run_counter(n_nodes=n, n_ops=n_ops, rate=args.rate,
+                          quiescence=quiescence, latency=args.latency,
+                          partitions=make_partitions(
+                              n, include=["seq-kv"]),
+                          seed=args.seed)
+    elif args.workload == "kafka":
+        res = run_kafka(n_nodes=args.node_count or 2, n_ops=n_ops,
+                        rate=args.rate, latency=args.latency,
+                        seed=args.seed)
+
+    print(json.dumps({"workload": args.workload, "ok": res.ok,
+                      **{k: v for k, v in res.stats.items()
+                         if isinstance(v, (int, float, str))}}))
+    if res.ok:
+        print("Everything looks good! (checker passed)")
+        return 0
+    print(f"Analysis invalid! details: {res.details}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -94,8 +94,10 @@ def run_echo(n_ops: int = 20, seed: int = 0) -> WorkloadResult:
 
 
 def run_unique_ids(n_nodes: int = 3, n_ops: int = 200,
+                   latency: float = 0.0,
                    seed: int = 0) -> WorkloadResult:
-    net = _make_net(n_nodes, UniqueIdsProgram, net_cfg=NetConfig(seed=seed))
+    net = _make_net(n_nodes, UniqueIdsProgram,
+                    net_cfg=NetConfig(latency=latency, seed=seed))
     client = net.client("c1")
     ids: list[str] = []
     for i in range(n_ops):
@@ -242,7 +244,7 @@ def run_broadcast_mix(n_nodes: int = 25, topology: str = "tree",
 def run_counter(n_nodes: int = 3, n_ops: int = 60, rate: float = 10.0,
                 quiescence: float = 8.0,
                 partitions: PartitionSchedule | None = None,
-                stale_read_prob: float = 0.0,
+                stale_read_prob: float = 0.0, latency: float = 0.0,
                 seed: int = 0) -> WorkloadResult:
     """g-counter (BASELINE.json config 3): adds at random nodes, then a
     read-after-quiescence sum check on every node.
@@ -253,7 +255,8 @@ def run_counter(n_nodes: int = 3, n_ops: int = 60, rate: float = 10.0,
     stale ``readKV`` makes the next CAS fail precondition (code 22) and
     re-enter the jittered retry loop (add.go:80-88), without ever
     corrupting the sum."""
-    net = _make_net(n_nodes, CounterProgram, net_cfg=NetConfig(seed=seed),
+    net = _make_net(n_nodes, CounterProgram,
+                    net_cfg=NetConfig(latency=latency, seed=seed),
                     services=("seq-kv",), partitions=partitions,
                     service_kwargs={"stale_read_prob": stale_read_prob})
     client = net.client("c1")
@@ -296,10 +299,12 @@ def run_counter(n_nodes: int = 3, n_ops: int = 60, rate: float = 10.0,
 
 
 def run_kafka(n_nodes: int = 2, n_keys: int = 4, n_ops: int = 120,
-              rate: float = 20.0, seed: int = 0) -> WorkloadResult:
+              rate: float = 20.0, latency: float = 0.0,
+              seed: int = 0) -> WorkloadResult:
     """Kafka workload (Maelstrom 5a-5c shape): interleaved send / poll /
     commit_offsets / list_committed_offsets against random nodes."""
-    net = _make_net(n_nodes, KafkaProgram, net_cfg=NetConfig(seed=seed),
+    net = _make_net(n_nodes, KafkaProgram,
+                    net_cfg=NetConfig(latency=latency, seed=seed),
                     services=("lin-kv",))
     client = net.client("c1")
     rng = net.rng

@@ -184,3 +184,50 @@ def test_workloads_replay_bit_identical():
         assert a.ok == b.ok
         assert a.stats == b.stats, (a.stats, b.stats)
         assert a.details == b.details
+
+
+def test_workload_cli_maelstrom_ux():
+    """`python -m gossip_glomers_tpu.harness test -w ...` mirrors the
+    Maelstrom CLI the reference is driven by (README.md:7-10): runs the
+    workload, prints a JSON stats line + verdict, exits 0/1."""
+    import json
+    import subprocess
+    import sys
+
+    def run(*args):
+        p = subprocess.run(
+            [sys.executable, "-m", "gossip_glomers_tpu.harness",
+             "test", *args],
+            capture_output=True, text=True, timeout=120)
+        return p
+
+    p = run("-w", "broadcast", "--node-count", "9", "--topology", "grid",
+            "--rate", "10", "--time-limit", "6", "--latency", "0.05",
+            "--nemesis", "partition", "--seed", "3")
+    assert p.returncode == 0, p.stderr
+    stats = json.loads(p.stdout.splitlines()[0])
+    assert stats["ok"] and stats["msgs_per_op"] > 0
+    assert stats["dropped_msgs"] > 0      # the nemesis really fired
+    assert "Everything looks good!" in p.stdout
+
+    p = run("-w", "counter", "--rate", "10", "--time-limit", "6",
+            "--nemesis", "partition", "--seed", "7")
+    assert p.returncode == 0, p.stderr
+    stats = json.loads(p.stdout.splitlines()[0])
+    assert stats["ok"]
+    assert stats["dropped_msgs"] > 0      # seq-kv reachability was cut
+
+    p = run("-w", "unique-ids", "--rate", "20", "--time-limit", "1")
+    assert p.returncode == 0, p.stderr
+    assert json.loads(p.stdout.splitlines()[0])["ok"]
+
+    # a flag the workload cannot honor is a usage error, not a silent
+    # green run
+    p = run("-w", "kafka", "--topology", "ring")
+    assert p.returncode == 2
+    p = run("-w", "echo", "--nemesis", "partition")
+    assert p.returncode == 2
+    # a nemesis window that cannot fire inside --time-limit is an error
+    p = run("-w", "broadcast", "--time-limit", "2",
+            "--nemesis", "partition")
+    assert p.returncode == 2

@@ -160,7 +160,7 @@ def test_console_script_entry_points_registered():
 
     expected = {"maelstrom-echo", "maelstrom-unique-ids",
                 "maelstrom-broadcast", "maelstrom-counter",
-                "maelstrom-kafka"}
+                "maelstrom-kafka", "maelstrom-test"}
     eps = {ep.name: ep.value for ep in entry_points(group="console_scripts")
            if ep.module.startswith("gossip_glomers_tpu")}
     if not eps:   # source checkout: read the declaration itself
