@@ -11,6 +11,7 @@ come from (README.md:16-18).
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -41,7 +42,17 @@ def _stats(net: VirtualNetwork, n_ops: int) -> dict:
     # RPCs — init/topology/final-read control traffic is excluded.  The
     # reference README's "<20 msgs/op" (README.md:17) divides by every
     # client op including reads, so it is not directly comparable.
-    lat = net.ledger.op_latencies
+    lat = sorted(net.ledger.op_latencies)
+
+    def pct(p: float) -> float:
+        # Maelstrom publishes latency distributions per workload; the
+        # nearest-rank percentile (ceil(p*N)-th smallest) over the
+        # virtual-clock op latencies is the comparable figure
+        if not lat:
+            return 0.0
+        return lat[min(len(lat) - 1,
+                       max(0, math.ceil(p * len(lat)) - 1))]
+
     return {
         "total_msgs": net.ledger.total,
         "server_msgs": net.ledger.server_to_server,
@@ -49,8 +60,11 @@ def _stats(net: VirtualNetwork, n_ops: int) -> dict:
         "client_ops": net.ledger.client_ops,
         "msgs_per_op": (net.ledger.server_to_server / n_ops
                         if n_ops else 0.0),
-        "latency_max": max(lat) if lat else 0.0,
+        "latency_max": lat[-1] if lat else 0.0,
         "latency_mean": sum(lat) / len(lat) if lat else 0.0,
+        "latency_p50": pct(0.50),
+        "latency_p95": pct(0.95),
+        "latency_p99": pct(0.99),
         "virtual_time": net.now,
         "by_type": dict(net.ledger.by_type),
     }

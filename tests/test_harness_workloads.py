@@ -231,3 +231,31 @@ def test_workload_cli_maelstrom_ux():
     p = run("-w", "broadcast", "--time-limit", "2",
             "--nemesis", "partition")
     assert p.returncode == 2
+
+
+def test_latency_percentiles_reported():
+    # Maelstrom publishes op-latency distributions; the harness stats
+    # expose the nearest-rank p50/p95/p99 over the virtual clock
+    res = run_broadcast(n_nodes=9, topology="tree", n_values=20,
+                        rate=10.0, quiescence=6.0, latency=0.1)
+    s = res.stats
+    assert 0.0 < s["latency_p50"] <= s["latency_p95"] \
+        <= s["latency_p99"] <= s["latency_max"]
+    # tree ack = one hop out + one back at 0.1 s/hop
+    assert abs(s["latency_p50"] - 0.2) < 1e-6
+
+
+def test_latency_percentile_nearest_rank():
+    # pinned against hand-computed nearest-rank values on DISTINCT
+    # latencies (the CLI-level test above has identical latencies and
+    # cannot catch an indexing error)
+    from gossip_glomers_tpu.harness.network import VirtualNetwork
+    from gossip_glomers_tpu.harness.workloads import _stats
+
+    net = VirtualNetwork()
+    net.ledger.op_latencies = [0.1 * i for i in range(1, 21)]
+    s = _stats(net, 20)
+    assert abs(s["latency_p50"] - 1.0) < 1e-9    # ceil(10)-1 -> 10th
+    assert abs(s["latency_p95"] - 1.9) < 1e-9    # ceil(19)-1 -> 19th
+    assert abs(s["latency_p99"] - 2.0) < 1e-9    # ceil(19.8)-1 -> 20th
+    assert abs(s["latency_max"] - 2.0) < 1e-9
