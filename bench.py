@@ -62,6 +62,10 @@ def main() -> None:
                    *words_axis_entries(N_NODES, W128_VALUES,
                                        branching=BRANCHING)]
         res = bench_structured(N_NODES, entries)
+    except AssertionError:
+        raise   # TimedRun.finish correctness validations (e.g. "fixed
+        #         runner diverged from run()") are real bugs — same
+        #         policy as the accounted-run block below
     except Exception as e:                         # noqa: BLE001
         print(f"combined benchmark run failed ({e!r}); "
               "retrying headline alone", file=sys.stderr)

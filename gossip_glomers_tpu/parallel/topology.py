@@ -35,10 +35,10 @@ def grid_cols(n: int) -> int:
     return max(1, math.isqrt(n - 1) + 1) if n > 1 else 1
 
 
-def grid(n: int) -> list[list[int]]:
+def grid(n: int, cols: int | None = None) -> list[list[int]]:
     """2D grid (Maelstrom's default broadcast topology): ceil(sqrt(n))
-    columns, neighbors up/down/left/right."""
-    cols = grid_cols(n)
+    columns by default, neighbors up/down/left/right."""
+    cols = cols or grid_cols(n)
     adj: list[list[int]] = [[] for _ in range(n)]
     for i in range(n):
         r, c = divmod(i, cols)

@@ -268,18 +268,50 @@ def bench_structured(n: int, entries, repeats: int = 3) -> dict:
                       discover_rounds(topo, n, nv, **kw))
         tr.prepare()
         tr.sample(repeats)
-        runs.append((name, nv, n_dirs, tr))
+        runs.append((name, nv, n_dirs, tr, sim))
     out: dict = {}
-    for name, nv, n_dirs, tr in runs:    # finishes AFTER all sampling
+    for name, nv, n_dirs, tr, sim in runs:  # finishes AFTER sampling
         dt, rounds, state = tr.finish()
         bitset_gb = n * (nv // 32) * 4 / 1e9
-        out[name] = {
+        entry = {
             "wall_s": round(dt, 4), "rounds": rounds,
             "ms_per_round": round(dt / rounds * 1e3, 3),
             "gbytes_per_s_lb": round(
                 (4 + n_dirs) * bitset_gb * rounds / dt, 1),
             "_state": state}
+        # `_state.msgs` is a device uint32 and WRAPS mod 2^32 in the
+        # many-values regime (e.g. W=128 circulant ~7e10 true sends).
+        # For pure-flood runs (the only runs this benchmark times) the
+        # ledger has a closed form over the final state — recompute it
+        # unwrapped: per-node popcount delta reduced ON DEVICE (the
+        # full bitsets would be a ~1 GB D2H at W=128; the (N,) delta is
+        # ~4 MB), final int64 dot on the host.  Max delta per node is
+        # W*32 <= 4096, so int32 cannot overflow on device.
+        if tr.parts is not None:
+            dpc = np.asarray(
+                _dpc_fn(sim.words_major)(state.received,
+                                         state.frontier),
+                dtype=np.int64)
+            entry["msgs64"] = int(
+                (sim._host_deg.astype(np.int64) * dpc).sum())
+        out[name] = entry
     return out
+
+
+def _dpc_fn(words_major: bool):
+    """Jitted (received, frontier) -> per-node popcount delta (N,)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    axis = 0 if words_major else 1
+
+    @jax.jit
+    def dpc(rec, fr):
+        return (lax.population_count(rec).astype(jnp.int32).sum(axis=axis)
+                - lax.population_count(fr).astype(jnp.int32).sum(axis=axis))
+
+    return dpc
 
 
 def _chain_diff(chain, k1: int, k2: int, attempts: int = 3) -> float:
@@ -389,7 +421,11 @@ def _nbrs_for(topology: str, n: int, **kw) -> np.ndarray:
             tree(n, branching=kw.get("branching", 4)))
     if topology == "circulant":
         return circulant(n, list(kw["strides"]))
-    if topology in ("grid", "ring", "line"):
-        builder = {"grid": grid, "ring": ring, "line": line}[topology]
+    if topology == "grid":
+        # cols threads through so adjacency, exchange, and
+        # discover_rounds can never disagree on the grid shape
+        return to_padded_neighbors(grid(n, kw.get("cols")))
+    if topology in ("ring", "line"):
+        builder = {"ring": ring, "line": line}[topology]
         return to_padded_neighbors(builder(n))
     raise ValueError(topology)

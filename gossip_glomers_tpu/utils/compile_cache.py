@@ -14,10 +14,25 @@ import os
 
 def enable_compile_cache() -> None:
     """Point JAX's persistent compilation cache at <repo>/.jax_cache
-    (derived from the package location; call before heavy compiles)."""
+    (derived from the package location; call before heavy compiles).
+
+    An explicit ``JAX_COMPILATION_CACHE_DIR`` always wins.  For an
+    installed distribution (e.g. the ``maelstrom-test`` console script)
+    the derived root lands inside site-packages, where writes may fail
+    or pollute the install tree — fall back to a per-user cache there.
+    """
     import jax
 
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return   # user already chose a cache location
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(root, ".jax_cache"))
+    # a source checkout has the repo's marker files next to the package;
+    # site-packages does not
+    if os.path.exists(os.path.join(root, "pyproject.toml")):
+        cache = os.path.join(root, ".jax_cache")
+    else:
+        cache = os.path.join(
+            os.path.expanduser("~"), ".cache", "gossip_glomers_tpu",
+            "jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)

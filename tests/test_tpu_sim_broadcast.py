@@ -892,3 +892,37 @@ def test_srv_ledger_structured_2d_mesh_tree():
     assert ref.server_msgs(s1) == shd.server_msgs(s2)
     s3, _ = shd.run_fused(inject)
     assert ref.server_msgs(s1) == shd.server_msgs(s3)
+
+
+def test_bench_structured_msgs64_matches_device_ledger():
+    # the host-side int64 closed-form ledger (the unwrapped view of the
+    # uint32 device `msgs`) must equal the device value where no wrap
+    # occurs
+    from gossip_glomers_tpu.tpu_sim.timing import bench_structured
+
+    res = bench_structured(
+        256, [("tree", "tree", 32, {"branching": 4}, 5)], repeats=1)
+    entry = res["tree"]
+    assert "msgs64" in entry
+    assert entry["msgs64"] == int(entry["_state"].msgs)
+
+
+def test_grid_cols_threads_through_timing():
+    # a non-default cols must give a consistent adjacency/exchange/
+    # oracle triple (ADVICE r3: _nbrs_for used to ignore cols)
+    from gossip_glomers_tpu.tpu_sim.timing import (discover_rounds,
+                                                   structured_sim,
+                                                   timed_convergence)
+
+    n, nv, cols = 64, 8, 5   # non-default cols (grid_cols(64) == 8)
+    sim = structured_sim("grid", n, nv, cols=cols)
+    rounds = discover_rounds("grid", n, nv, cols=cols)
+    dt, r, state = timed_convergence(sim, make_inject(n, nv),
+                                     repeats=1, rounds=rounds)
+    assert r == rounds
+    ref = BroadcastSim(to_padded_neighbors(grid(n, cols)), n_values=nv,
+                       sync_every=1 << 20, srv_ledger=False)
+    sref, rref = ref.run(make_inject(n, nv))
+    assert rref == rounds
+    assert (ref.received_node_major(sref)
+            == sim.received_node_major(state)).all()
