@@ -32,10 +32,12 @@ from .broadcast import (BroadcastSim, BroadcastState, Partitions,
 from .counter import CounterSim, CounterState, KVReach
 from .echo import EchoSim, EchoState
 from .kafka import KafkaSim, KafkaState
+from .structured import StructuredFaults, make_faulted
 from .unique_ids import UniqueIdsSim, UniqueIdsState
 
 __all__ = ["BroadcastSim", "BroadcastState", "Partitions", "make_inject",
            "CounterSim", "CounterState", "KVReach",
            "KafkaSim", "KafkaState",
+           "StructuredFaults", "make_faulted",
            "UniqueIdsSim", "UniqueIdsState",
            "EchoSim", "EchoState"]

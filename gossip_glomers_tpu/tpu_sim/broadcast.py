@@ -156,27 +156,32 @@ def _gather_or(payload: jnp.ndarray, nbrs: jnp.ndarray,
 
 def _gather_or_delayed(history: jnp.ndarray, t: jnp.ndarray,
                        delays: jnp.ndarray, nbrs: jnp.ndarray,
-                       live_at_send: jnp.ndarray) -> jnp.ndarray:
-    """Latency-queue delivery: edge (i, d) with delay ``delays[i, d]``
-    delivers the payload flooded at round t - (delay-1) — read from the
-    ring buffer of past full-axis payloads.  ``live_at_send`` must be
-    evaluated at each edge's send round (drops happen at send time, like
-    Maelstrom's)."""
+                       nbr_mask: jnp.ndarray, parts: Partitions,
+                       row_ids: jnp.ndarray, delay_set: tuple,
+                       widen) -> jnp.ndarray:
+    """Latency-queue delivery: edge (i, d) with delay δ = delays[i, d]
+    delivers the payload flooded at round t - (δ-1), with liveness
+    evaluated at that send round (drops happen at send time, like
+    Maelstrom's).
+
+    ``history`` is a ring of past LOCAL payload blocks (L, rows, W) —
+    node-SHARDED under shard_map, so a 1M-node delayed run holds
+    O(L·N/shards) per device instead of a replicated O(L·N) ring.  The
+    distinct delay values are static, so delivery is one masked
+    ``widen`` (all_gather along 'nodes') + gather per value: the full
+    past payload an edge class needs is materialized transiently per
+    round, never stored."""
     ring = history.shape[0]
-
-    def term(d):
-        idx = lax.dynamic_index_in_dim(nbrs, d, axis=1, keepdims=False)
-        dly = lax.dynamic_index_in_dim(delays, d, axis=1, keepdims=False)
-        ok = lax.dynamic_index_in_dim(live_at_send, d, axis=1,
-                                      keepdims=False)
-        src_t = t - (dly - 1)
-        ok = ok & (src_t >= 0)
-        rows = history[src_t % ring,
-                       jnp.clip(idx, 0, history.shape[1] - 1)]
-        return jnp.where(ok[:, None], rows, jnp.uint32(0))
-
-    return lax.fori_loop(1, nbrs.shape[1], lambda d, acc: acc | term(d),
-                         term(0))
+    out = None
+    for d in delay_set:
+        src_t = t - (d - 1)
+        payload = widen(lax.dynamic_index_in_dim(
+            history, src_t % ring, axis=0, keepdims=False))
+        live = (_edge_live(src_t, row_ids, nbrs, nbr_mask, parts)
+                & (delays == d) & (src_t >= 0))
+        term = _gather_or(payload, nbrs, live)
+        out = term if out is None else out | term
+    return out
 
 
 def _sync_diff_pc(payload_full: jnp.ndarray, recv_local: jnp.ndarray,
@@ -251,6 +256,7 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
            widen: Callable[[jnp.ndarray], jnp.ndarray] = lambda p: p,
            reduce_sum: Callable[[jnp.ndarray], jnp.ndarray] = lambda s: s,
            delays: jnp.ndarray | None = None,
+           delay_set: tuple = (),
            sync_base_once: Callable[[jnp.ndarray], jnp.ndarray]
            = lambda x: x,
            ) -> BroadcastState:
@@ -317,13 +323,14 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
         inbox = _gather_or(payload_full, nbrs, live_now)
         history = state.history
     else:
+        # the ring stores the LOCAL payload block (node-sharded under
+        # shard_map); _gather_or_delayed widens the needed slices
         ring = state.history.shape[0]
         history = lax.dynamic_update_index_in_dim(
-            state.history, payload_full, state.t % ring, axis=0)
-        t_send = state.t - (delays - 1)
-        live_send = _edge_live(t_send, row_ids, nbrs, nbr_mask, parts)
+            state.history, payload, state.t % ring, axis=0)
         inbox = _gather_or_delayed(history, state.t, delays, nbrs,
-                                   live_send)
+                                   nbr_mask, parts, row_ids, delay_set,
+                                   widen)
     new = inbox & ~state.received
     return BroadcastState(received=state.received | new,
                           frontier=new,
@@ -336,55 +343,80 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
 def flood_step(state: BroadcastState, *, nbrs: jnp.ndarray,
                nbr_mask: jnp.ndarray, parts: Partitions,
                sync_every: int,
-               delays: jnp.ndarray | None = None) -> BroadcastState:
+               delays: jnp.ndarray | None = None,
+               delay_set: tuple = ()) -> BroadcastState:
     """Single-device node-major round (the ``entry()`` compile-check
     target)."""
     row_ids = jnp.arange(nbrs.shape[0], dtype=jnp.int32)
+    if delays is not None and not delay_set:
+        # convenience for direct callers (entry(), tests): derive the
+        # static value set from the concrete delays array
+        delay_set = tuple(int(x) for x in np.unique(np.asarray(delays)))
     return _round(state, row_ids=row_ids, nbrs=nbrs, nbr_mask=nbr_mask,
-                  parts=parts, sync_every=sync_every, delays=delays)
+                  parts=parts, sync_every=sync_every, delays=delays,
+                  delay_set=delay_set)
 
 
 def _round_wm(state: BroadcastState, *, deg: jnp.ndarray, sync_every: int,
-              exchange: Callable[[jnp.ndarray], jnp.ndarray],
+              exchange: Callable[..., jnp.ndarray],
               widen: Callable[[jnp.ndarray], jnp.ndarray] = lambda p: p,
               reduce_sum: Callable[[jnp.ndarray], jnp.ndarray] = lambda s: s,
               local_slice: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
-              sync_diff: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
+              sync_diff: Callable[..., jnp.ndarray] | None = None,
               sync_base_once: Callable[[jnp.ndarray], jnp.ndarray]
               = lambda x: x,
+              live_rows: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
+              deg_slice: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
               ) -> BroadcastState:
     """Words-major round for structured topologies: state is (W, N) so
     the node axis packs TPU lanes densely (the node-major layout wastes
-    127/128 of each tile at W=1 — see structured.py).  No partition
-    masks (structured delivery has no per-edge addressing); ``deg`` is
-    the per-node live degree for the message ledger.
+    127/128 of each tile at W=1 — see structured.py).  ``deg`` is the
+    per-node TOPOLOGY degree.
+
+    Partition faults: with ``live_rows`` (BroadcastSim._live_rows over
+    a StructuredFaults bundle) the round computes the (D, N)
+    per-direction liveness at round t; the exchange and sync_diff then
+    take ``(payload, live)`` (the masked closures) and the ledgers use
+    the live degree ``live.sum(axis=0)`` — matching the gather path's
+    per-edge accounting bit for bit, still gather-free.  ``deg_slice``
+    maps the full-axis live degree to the local block on the sharded
+    all_gather fallback (identity elsewhere).
 
     With ``sync_diff`` (structured.make_sync_diff /
     make_sharded_sync_diff), the round also keeps the
     reference-accounted server ledger: same formulas as the gather
-    path's accounting in :func:`_round` with live degree == topology
-    degree (the structured path runs fault-free), and the anti-entropy
+    path's accounting in :func:`_round`, with the anti-entropy
     pairwise diff from per-direction structured deliveries instead of
     per-edge gathers — bit-identical totals, no all_gather."""
     is_sync = (state.t % jnp.int32(sync_every) == 0) & (state.t > 0)
     payload = jnp.where(is_sync, state.received, state.frontier)
     payload_full = widen(payload)
+    if live_rows is None:
+        live = None
+        live_deg = deg
+    else:
+        live = live_rows(state.t)
+        live_deg = deg_slice(
+            live.sum(axis=0, dtype=jnp.int32).astype(jnp.uint32))
     pc = _popcount(payload).sum(axis=0).astype(jnp.uint32)    # (n_local,)
-    sent = reduce_sum(jnp.sum(pc * deg, dtype=jnp.uint32))
+    sent = reduce_sum(jnp.sum(pc * live_deg, dtype=jnp.uint32))
     if state.srv_msgs is None:
         srv = None
     else:
         d = deg.astype(jnp.int32)
+        ld = live_deg.astype(jnp.int32)
         pcf = _popcount(state.frontier).sum(axis=0).astype(jnp.uint32)
-        coef = jnp.where(state.t == 0, 2 * d,
-                         jnp.maximum(2 * d - 2, 0)).astype(jnp.uint32)
+        coef = jnp.where(state.t == 0, d + ld,
+                         jnp.maximum(d + ld - 2, 0)).astype(jnp.uint32)
         flood = jnp.sum(pcf * coef, dtype=jnp.uint32)
         base = sync_base_once(
-            jnp.sum(2 * d, dtype=jnp.int32).astype(jnp.uint32))
-        diff = sync_diff(state.received)
+            jnp.sum(d + ld, dtype=jnp.int32).astype(jnp.uint32))
+        diff = (sync_diff(state.received) if live is None
+                else sync_diff(state.received, live))
         srv = state.srv_msgs + reduce_sum(
             flood + jnp.where(is_sync, base + 2 * diff, jnp.uint32(0)))
-    inbox = local_slice(exchange(payload_full))
+    inbox = local_slice(exchange(payload_full) if live is None
+                        else exchange(payload_full, live))
     new = inbox & ~state.received
     return BroadcastState(received=state.received | new, frontier=new,
                           t=state.t + 1, msgs=state.msgs + sent,
@@ -402,8 +434,10 @@ class BroadcastSim:
     - **words-major (W, N)** with a structured ``exchange`` from
     structured.py — gather-free contiguous delivery for named
     topologies, ~60-190x faster per round at 1M nodes / W=1
-    (lane-dense layout, no tile-granularity random reads).  No
-    partitions.
+    (lane-dense layout, no tile-granularity random reads).  Partition
+    schedules run here too via a ``StructuredFaults`` bundle
+    (structured.make_faulted): host-precomputed per-direction liveness
+    masks, applied per round by the masked exchanges.
 
     Single-device: plain ``jax.jit``.  Multi-device: ``shard_map`` over
     ``Mesh(axis 'nodes' [, 'words'])`` — the node axis block-sharded
@@ -427,6 +461,7 @@ class BroadcastSim:
                  | None = None,
                  delays: np.ndarray | None = None,
                  srv_ledger: bool = True,
+                 faulted=None,
                  ) -> None:
         """``srv_ledger``: keep the reference-accounted server-message
         ledger (default).  It costs a second adjacency pass per round
@@ -437,7 +472,14 @@ class BroadcastSim:
         matching diff closure: ``sync_diff``
         (structured.make_sync_diff) single-device, plus
         ``sharded_sync_diff`` (structured.make_sharded_sync_diff) for
-        the halo path on a mesh."""
+        the halo path on a mesh.
+
+        ``faulted`` (structured.StructuredFaults, from
+        structured.make_faulted): required to run a partition schedule
+        on the words-major path — per-direction receiver-side liveness
+        masks precomputed per window on the host, applied by the
+        masked exchange/diff closures each round (Maelstrom's nemesis
+        at any scale without falling back to the gather path)."""
         n = nbrs.shape[0]
         self.n_nodes = n
         self.n_values = n_values
@@ -455,19 +497,36 @@ class BroadcastSim:
         self.words_major = exchange is not None
         self.sync_diff = sync_diff
         self.sharded_sync_diff = sharded_sync_diff
+        n_windows = int(self.parts.starts.shape[0])
+        self._faulted = faulted if (self.words_major
+                                    and n_windows > 0) else None
+        if self.words_major and n_windows > 0 and faulted is None:
+            raise ValueError(
+                "a words-major structured run under a partition "
+                "schedule needs the masked closures: pass "
+                "faulted=structured.make_faulted(topology, n, groups)")
+        if self._faulted is not None:
+            if self._faulted.same.shape[0] != n_windows \
+                    or self._faulted.same.shape[-1] != n:
+                raise ValueError(
+                    "StructuredFaults masks do not match the partition "
+                    f"schedule: same{tuple(self._faulted.same.shape)} "
+                    f"vs {n_windows} windows x {n} nodes")
         # the words-major ledger needs a structured per-edge diff: the
         # single-device closure off-mesh, the halo closure on-mesh
-        if self.words_major:
+        if self._faulted is not None:
+            f = self._faulted
+            self._srv_on = srv_ledger and (
+                f.sync_diff is not None if mesh is None
+                else f.sharded_exchange is not None
+                and f.sharded_sync_diff is not None)
+        elif self.words_major:
             self._srv_on = srv_ledger and (
                 sync_diff is not None if mesh is None
                 else (sharded_exchange is not None
                       and sharded_sync_diff is not None))
         else:
             self._srv_on = srv_ledger
-        if self.words_major and self.parts.starts.shape[0] > 0:
-            raise ValueError(
-                "structured exchange cannot apply per-edge partition "
-                "masks; use the adjacency-gather path for faulted runs")
         if delays is not None:
             if exchange is not None:
                 raise ValueError("per-edge delays need the gather path")
@@ -478,6 +537,12 @@ class BroadcastSim:
         self.delays = (None if delays is None
                        else jnp.asarray(delays, jnp.int32))
         self.ring = 1 if delays is None else int(delays.max())
+        # distinct delay values, static: delivery runs one masked
+        # gather per value, which is what lets the history ring stay
+        # node-sharded (one all_gather per value per round instead of a
+        # replicated (L, N, W) ring — see _gather_or_delayed)
+        self._delay_set = (() if delays is None else tuple(
+            int(x) for x in np.unique(np.asarray(delays))))
         self._fused = None
         self._fused_max_rounds = None
         self._fixed = None
@@ -508,6 +573,23 @@ class BroadcastSim:
             self.deg = (jax.device_put(jnp.asarray(deg),
                                        NamedSharding(mesh, P("nodes")))
                         if mesh is not None else jnp.asarray(deg))
+            if self._faulted is not None:
+                ex = jnp.asarray(self._faulted.exists)
+                sm = jnp.asarray(self._faulted.same)
+                if mesh is not None:
+                    # halo mode: receiver-side rows shard with the node
+                    # axis; all_gather fallback: replicated (the full-
+                    # axis masked exchange needs full-axis masks)
+                    if self._faulted.sharded_exchange is not None:
+                        e_spec = P(None, "nodes")
+                        s_spec = P(None, None, "nodes")
+                    else:
+                        e_spec = P(None, None)
+                        s_spec = P(None, None, None)
+                    ex = jax.device_put(ex, NamedSharding(mesh, e_spec))
+                    sm = jax.device_put(sm, NamedSharding(mesh, s_spec))
+                    self._f_specs = (e_spec, s_spec)
+                self._f_exists, self._f_same = ex, sm
         elif mesh is not None:
             node_sh = NamedSharding(mesh, P("nodes", None))
             self.nbrs = jax.device_put(jnp.asarray(nbrs, jnp.int32), node_sh)
@@ -534,14 +616,18 @@ class BroadcastSim:
                 received, NamedSharding(self.mesh, self._state_spec))
         history = None
         if self.delays is not None:
-            # full-axis ring so any edge can read any past payload;
-            # replicated across shards (latency mode targets the small
-            # fault-fidelity configs, not the million-node path)
+            # ring of past LOCAL payload blocks, node-SHARDED: each
+            # shard stores only its own rows' history (O(L·N/shards)
+            # per device); delivery widens the per-delay-value slices
+            # transiently (_gather_or_delayed), so million-node delayed
+            # runs fit memory
             history = jnp.zeros(
                 (self.ring, self.n_nodes, self.n_words), jnp.uint32)
             if self.mesh is not None:
                 history = jax.device_put(
-                    history, NamedSharding(self.mesh, P(None, None, None)))
+                    history,
+                    NamedSharding(self.mesh,
+                                  P(None, *self._state_spec)))
         return BroadcastState(received=received, frontier=received,
                               t=jnp.int32(0), msgs=jnp.uint32(0),
                               history=history,
@@ -578,10 +664,27 @@ class BroadcastSim:
             parts=parts, sync_every=self.sync_every,
             widen=lambda p: lax.all_gather(p, "nodes", axis=0, tiled=True),
             reduce_sum=lambda s: lax.psum(s, mesh_axes),
-            delays=delays, sync_base_once=sync_base_once)
+            delays=delays, delay_set=self._delay_set,
+            sync_base_once=sync_base_once)
 
-    def _sharded_round_wm(self, state: BroadcastState,
-                          deg) -> BroadcastState:
+    @staticmethod
+    def _live_rows(exists, same, starts, ends):
+        """Device closure t -> (D, n) combined per-direction liveness:
+        exists AND same-group under every active partition window (the
+        per-direction-class form of :func:`_edge_live`)."""
+        n_windows = int(starts.shape[0])
+
+        def live_rows(t):
+            def body(w, lv):
+                active = (starts[w] <= t) & (t < ends[w])
+                return lv & (same[w] | ~active)
+
+            return lax.fori_loop(0, n_windows, body, exists)
+
+        return live_rows
+
+    def _sharded_round_wm(self, state: BroadcastState, deg,
+                          masks=None) -> BroadcastState:
         """The words-major round inside shard_map.
 
         Preferred: the **halo path** (``sharded_exchange`` from
@@ -592,7 +695,14 @@ class BroadcastSim:
         without a halo decomposition: all_gather the payload along the
         node axis, run the full-axis exchange per shard, slice the
         local block back out (n_shards-fold redundant compute and
-        O(N) ICI traffic per round)."""
+        O(N) ICI traffic per round).
+
+        ``masks`` = (exists, same, starts, ends) under a partition
+        schedule (faulted mode): the masked closures from the
+        StructuredFaults bundle replace the plain ones and the
+        per-round live rows drive the ledgers (sharded with the node
+        axis on the halo path, so the masking is local and costs no
+        ICI)."""
         mesh_axes = tuple(self.mesh.axis_names)
         if "words" in mesh_axes:
             # per-word-shard popcounts psum linearly; the per-node sync
@@ -601,33 +711,79 @@ class BroadcastSim:
                 lax.axis_index("words") == 0, b, jnp.uint32(0))
         else:
             sync_base_once = lambda b: b  # noqa: E731
-        if self.sharded_exchange is not None:
+        f = self._faulted
+        if masks is not None:
+            live_rows = self._live_rows(*masks)
+        else:
+            live_rows = None
+        if (f.sharded_exchange if masks is not None
+                else self.sharded_exchange) is not None:
             # halo path: the exchange maps local block -> local block
             # with O(block) ppermutes; no all_gather, no slice.
             return _round_wm(
                 state, deg=deg, sync_every=self.sync_every,
-                exchange=self.sharded_exchange,
+                exchange=(f.sharded_exchange if masks is not None
+                          else self.sharded_exchange),
                 reduce_sum=lambda s: lax.psum(s, mesh_axes),
-                sync_diff=self.sharded_sync_diff,
-                sync_base_once=sync_base_once)
+                sync_diff=(f.sharded_sync_diff if masks is not None
+                           else self.sharded_sync_diff),
+                sync_base_once=sync_base_once, live_rows=live_rows)
         block = state.received.shape[1]
         start = lax.axis_index("nodes") * block
         return _round_wm(
             state, deg=deg, sync_every=self.sync_every,
-            exchange=self.exchange,
+            exchange=(f.exchange if masks is not None
+                      else self.exchange),
             widen=lambda p: lax.all_gather(p, "nodes", axis=1, tiled=True),
             reduce_sum=lambda s: lax.psum(s, mesh_axes),
             local_slice=lambda x: lax.dynamic_slice_in_dim(
-                x, start, block, axis=1))
+                x, start, block, axis=1),
+            live_rows=live_rows,
+            deg_slice=lambda x: lax.dynamic_slice_in_dim(
+                x, start, block))
 
     def _specs(self):
         state_spec = self._state_spec
         hist_spec = (None if self.delays is None
-                     else P(None, None, None))   # replicated ring
+                     else P(None, *state_spec))  # node-sharded ring
         srv_spec = P() if self._srv_on else None
         return (BroadcastState(state_spec, state_spec, P(), P(),
                                hist_spec, srv_spec),
                 P("nodes", None), Partitions(P(), P(), P(None, None)))
+
+    def _wm_round_single(self, state: BroadcastState, deg,
+                         masks=None) -> BroadcastState:
+        """Single-device words-major round, faulted or not.  ``deg``
+        and the fault ``masks`` arrive as traced jit arguments (like
+        the shard_map path's explicit args) so the big per-node arrays
+        are not baked into every traced program as constants."""
+        f = self._faulted
+        if masks is None:
+            return _round_wm(state, deg=deg,
+                             sync_every=self.sync_every,
+                             exchange=self.exchange,
+                             sync_diff=self.sync_diff)
+        return _round_wm(
+            state, deg=deg, sync_every=self.sync_every,
+            exchange=f.exchange, sync_diff=f.sync_diff,
+            live_rows=self._live_rows(*masks))
+
+    def _wm_extra_args(self):
+        """The faulted words-major mode's extra traced arguments: mask
+        arrays + window rounds (empty when unfaulted)."""
+        if self._faulted is None:
+            return ()
+        return (self._f_exists, self._f_same, self.parts.starts,
+                self.parts.ends)
+
+    def _wm_mesh_extra(self):
+        """Extra (in_specs, args) the sharded words-major programs
+        thread through shard_map in faulted mode: the mask arrays and
+        the window rounds (explicit args, not closure captures)."""
+        if self._faulted is None:
+            return (), ()
+        e_spec, s_spec = self._f_specs
+        return ((e_spec, s_spec, P(), P()), self._wm_extra_args())
 
     def _build_step(self):
         parts, sync_every = self.parts, self.sync_every
@@ -635,39 +791,42 @@ class BroadcastSim:
         if self.mesh is None:
             if self.words_major:
                 @jax.jit
-                def step_wm(state: BroadcastState, deg) -> BroadcastState:
-                    return _round_wm(state, deg=deg,
-                                     sync_every=sync_every,
-                                     exchange=self.exchange,
-                                     sync_diff=self.sync_diff)
-                return lambda state, nbrs, nbr_mask: step_wm(state,
-                                                             self.deg)
+                def step_wm(state: BroadcastState, deg,
+                            *masks) -> BroadcastState:
+                    return self._wm_round_single(state, deg,
+                                                 masks or None)
+                extra = self._wm_extra_args()
+                return lambda state, nbrs, nbr_mask: step_wm(
+                    state, self.deg, *extra)
 
             @jax.jit
             def step(state: BroadcastState, nbrs, nbr_mask) -> BroadcastState:
                 return flood_step(state, nbrs=nbrs, nbr_mask=nbr_mask,
                                   parts=parts, sync_every=sync_every,
-                                  delays=self.delays)
+                                  delays=self.delays,
+                                  delay_set=self._delay_set)
             return step
 
         state_spec, node_spec, part_spec = self._specs()
 
         if self.words_major:
+            extra_specs, extra_args = self._wm_mesh_extra()
+
             @jax.jit
             @functools.partial(
                 jax.shard_map, mesh=self.mesh,
-                in_specs=(state_spec, P("nodes")), out_specs=state_spec,
+                in_specs=(state_spec, P("nodes")) + extra_specs,
+                out_specs=state_spec,
                 check_vma=False,
             )
-            def step_wm(state: BroadcastState, deg) -> BroadcastState:
-                return self._sharded_round_wm(state, deg)
+            def step_wm(state: BroadcastState, deg,
+                        *masks) -> BroadcastState:
+                return self._sharded_round_wm(state, deg, masks or None)
 
-            return lambda state, nbrs, nbr_mask: step_wm(state, self.deg)
+            return lambda state, nbrs, nbr_mask: step_wm(
+                state, self.deg, *extra_args)
 
         if self.delays is not None:
-            # the history ring is replicated while payloads are gathered
-            # from varying blocks — provably identical on every shard,
-            # but beyond the static replication checker (see kafka.py)
             @jax.jit
             @functools.partial(
                 jax.shard_map, mesh=self.mesh,
@@ -715,23 +874,27 @@ class BroadcastSim:
             return jnp.all(s.received == t)
 
         if self.mesh is None:
+            extra = self._wm_extra_args()
+
             @jax.jit
-            def run(state: BroadcastState, nbrs, nbr_mask, target):
+            def run(state: BroadcastState, nbrs, nbr_mask, target, deg,
+                    *masks):
                 def cond(s):
                     return (s.t < limit) & ~eq_target(s, target)
 
                 def body(s):
                     if wm:
-                        return _round_wm(s, deg=self.deg,
-                                         sync_every=sync_every,
-                                         exchange=self.exchange,
-                                         sync_diff=self.sync_diff)
+                        return self._wm_round_single(s, deg,
+                                                     masks or None)
                     return flood_step(s, nbrs=nbrs, nbr_mask=nbr_mask,
                                       parts=parts, sync_every=sync_every,
-                                      delays=self.delays)
+                                      delays=self.delays,
+                                  delay_set=self._delay_set)
 
                 return lax.while_loop(cond, body, state)
-            return run
+
+            return lambda state, nbrs, nbr_mask, target: run(
+                state, nbrs, nbr_mask, target, self.deg, *extra)
 
         mesh = self.mesh
         state_spec, node_spec, part_spec = self._specs()
@@ -759,19 +922,24 @@ class BroadcastSim:
             return final
 
         if wm:
+            extra_specs, extra_args = self._wm_mesh_extra()
+
             @jax.jit
             @functools.partial(
                 jax.shard_map, mesh=mesh,
-                in_specs=(state_spec, P("nodes"), target_spec),
+                in_specs=(state_spec, P("nodes"), target_spec)
+                + extra_specs,
                 out_specs=state_spec, check_vma=False,
             )
-            def run_wm(state: BroadcastState, deg, target) -> BroadcastState:
+            def run_wm(state: BroadcastState, deg, target,
+                       *masks) -> BroadcastState:
                 return while_converge(
                     state, target,
-                    lambda s: self._sharded_round_wm(s, deg))
+                    lambda s: self._sharded_round_wm(s, deg,
+                                                     masks or None))
 
             return lambda state, nbrs, nbr_mask, target: run_wm(
-                state, self.deg, target)
+                state, self.deg, target, *extra_args)
 
         if self.delays is not None:
             @jax.jit
@@ -839,6 +1007,7 @@ class BroadcastSim:
         # test_run_staged_fixed_matches_while_runner and
         # test_fixed_flood_specialization_matches_while_runner.
         flood_ok = (wm and not self._srv_on and self.delays is None
+                    and self._faulted is None
                     and rounds <= sync_every and rounds > 0)
 
         if self.mesh is None and flood_ok:
@@ -855,21 +1024,24 @@ class BroadcastSim:
             return self._wire_flood_parts(loop_fn, ledger_fn, masks)
 
         if self.mesh is None:
+            extra = self._wm_extra_args()
+
             @jax.jit
-            def run(state: BroadcastState, nbrs, nbr_mask):
+            def run(state: BroadcastState, nbrs, nbr_mask, deg, *masks):
                 def one(s):
                     if wm:
-                        return _round_wm(s, deg=self.deg,
-                                         sync_every=sync_every,
-                                         exchange=self.exchange,
-                                         sync_diff=self.sync_diff)
+                        return self._wm_round_single(s, deg,
+                                                     masks or None)
                     return flood_step(s, nbrs=nbrs, nbr_mask=nbr_mask,
                                       parts=parts,
                                       sync_every=sync_every,
-                                      delays=self.delays)
+                                      delays=self.delays,
+                                  delay_set=self._delay_set)
 
                 return iterate(state, one)
-            return run
+
+            return lambda state, nbrs, nbr_mask: run(
+                state, nbrs, nbr_mask, self.deg, *extra)
 
         mesh = self.mesh
         state_spec, node_spec, part_spec = self._specs()
@@ -907,17 +1079,22 @@ class BroadcastSim:
             return self._wire_flood_parts(loop_fn, ledger_fn, masks)
 
         if wm:
+            extra_specs, extra_args = self._wm_mesh_extra()
+
             @jax.jit
             @functools.partial(
                 jax.shard_map, mesh=mesh,
-                in_specs=(state_spec, P("nodes")),
+                in_specs=(state_spec, P("nodes")) + extra_specs,
                 out_specs=state_spec, check_vma=False,
             )
-            def run_wm(state: BroadcastState, deg) -> BroadcastState:
+            def run_wm(state: BroadcastState, deg,
+                       *masks) -> BroadcastState:
                 return iterate(
-                    state, lambda s: self._sharded_round_wm(s, deg))
+                    state, lambda s: self._sharded_round_wm(
+                        s, deg, masks or None))
 
-            return lambda state, nbrs, nbr_mask: run_wm(state, self.deg)
+            return lambda state, nbrs, nbr_mask: run_wm(
+                state, self.deg, *extra_args)
 
         if self.delays is not None:
             @jax.jit

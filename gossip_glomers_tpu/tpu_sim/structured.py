@@ -31,8 +31,11 @@ its row block back out.
 
 from __future__ import annotations
 
+from typing import Callable, NamedTuple
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..parallel.topology import grid_cols
@@ -234,9 +237,20 @@ def tree_sharded_exchange(p_local: jnp.ndarray, n: int, n_shards: int,
     k = branching
     assert block * n_shards == n, "node axis must shard evenly"
     assert block % k == 0 and block >= k, "tree halo needs k | block"
-    sub = block // k
     from_parent = tree_parent_payload(p_local, n, n_shards, k, axis_name)
+    from_kids = tree_kids_payload(p_local, n, n_shards, k, axis_name)
+    return from_parent | from_kids
 
+
+def tree_kids_payload(p_local: jnp.ndarray, n: int, n_shards: int,
+                      branching: int = 4,
+                      axis_name: str = "nodes") -> jnp.ndarray:
+    """Per-node CHILDREN payload OR for the heap-ordered k-ary tree,
+    local block -> local block: out[:, j] = OR payload[kj+1 .. kj+k]
+    (the from_kids half of :func:`tree_sharded_exchange`)."""
+    w, block = p_local.shape
+    k = branching
+    sub = block // k
     # ---- from_kids: inbox[j] |= OR payload[kj+1 .. kj+k] -------------
     # Pre-reduce on the child shard: group local cols by parent.
     # Col 0 (i = sB) is the LAST child of parent (sB-1)//k; cols
@@ -265,9 +279,7 @@ def tree_sharded_exchange(p_local: jnp.ndarray, n: int, n_shards: int,
             ek[:, :1], axis_name,
             [(p + 1, p) for p in range(n_shards - 1)])
         ek = ek.at[:, block:].set(ek[:, block:] | back)
-    from_kids = ek[:, 1:]
-
-    return from_parent | from_kids
+    return ek[:, 1:]
 
 
 def grid_sharded_exchange(p_local: jnp.ndarray, n: int, n_shards: int,
@@ -512,3 +524,335 @@ def make_exchange(topology: str, n: int, **kw):
         strides = list(kw["strides"])
         return lambda p: circulant_exchange(p, strides)
     return None
+
+
+# -- partition faults on the structured path ----------------------------
+#
+# Maelstrom's partition nemesis applies at any workload size (reference
+# README.md:18), so it must compose with the words-major structured
+# delivery, not just the adjacency gather.  The key observation: a
+# partition window is per-node group ids — STATIC data — and every
+# structured delivery is a sum of per-DIRECTION terms (roll/shift/
+# parent/child-slot maps), so each direction's receiver-side edge
+# liveness under a window is a host-precomputable (N,) boolean mask:
+# ``same[w, d, i] = group_w[i] == group_w[sender_d(i)]``.  At round t
+# the live mask is ``exists & AND over active windows of same`` — the
+# same masked-adjacency trick the gather path's _edge_live applies per
+# edge (broadcast.py), applied per direction CLASS, so delivery stays
+# gather-free and the partition costs one (D, N) mask AND per round
+# instead of the ~60x slower gather path.
+#
+# Direction-row contract (shared by fault_dir_senders, the masked
+# exchanges, and the masked sync diffs):
+# - tree(k):   row 0 = parent edge at CHILD positions (masks both the
+#              from_parent delivery and the pre-fold kids payload — one
+#              symmetric edge, one mask); rows 1..k = child slot j at
+#              PARENT positions (degree accounting only; row 1+j at
+#              parent p mirrors row 0 at child kp+1+j).
+# - grid:      up (i<-i+cols), down (i<-i-cols), left (i<-i+1, row-
+#              local), right (i<-i-1, row-local).
+# - ring:      +1, -1.   line: fwd (i<-i+1), bwd (i<-i-1).
+# - circulant: +s0, -s0, +s1, -s1, ... per stride.
+#
+# live_deg[i] = live.sum(axis=0)[i] equals the node's live UNDIRECTED
+# degree (each symmetric edge contributes exactly one receiver-side row
+# entry at each endpoint), which is what the message ledgers need.
+
+
+def fault_dir_senders(topology: str, n: int, **kw) -> np.ndarray | None:
+    """(D, N) int64 — sender node index per direction row per receiver
+    position, -1 where the edge does not exist (see the direction-row
+    contract above).  None for unstructured topologies."""
+    idx = np.arange(n, dtype=np.int64)
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        rows = [np.where(idx >= 1, (idx - 1) // k, -1)]
+        for j in range(k):
+            child = k * idx + 1 + j
+            rows.append(np.where(child < n, child, -1))
+        return np.stack(rows)
+    if topology == "grid":
+        cols = kw.get("cols") or grid_cols(n)
+        col = idx % cols
+        up = np.where(idx + cols < n, idx + cols, -1)
+        down = np.where(idx - cols >= 0, idx - cols, -1)
+        left = np.where((col < cols - 1) & (idx + 1 < n), idx + 1, -1)
+        right = np.where(col > 0, idx - 1, -1)
+        return np.stack([up, down, left, right])
+    if topology in ("ring", "circulant"):
+        strides = [1] if topology == "ring" else list(kw["strides"])
+        rows = []
+        for s in strides:
+            rows.append((idx - s) % n)
+            rows.append((idx + s) % n)
+        return np.stack(rows)
+    if topology == "line":
+        fwd = np.where(idx + 1 < n, idx + 1, -1)
+        bwd = np.where(idx - 1 >= 0, idx - 1, -1)
+        return np.stack([fwd, bwd])
+    return None
+
+
+def fault_masks(topology: str, n: int, groups: np.ndarray,
+                **kw) -> tuple[np.ndarray, np.ndarray] | None:
+    """Host-precomputed fault masks for a partition schedule:
+    ``(exists (D, N) bool, same (P, D, N) bool)`` where ``groups`` is
+    the schedule's (P, N) per-window per-node group ids
+    (broadcast.Partitions.group).  None for unstructured topologies."""
+    snd = fault_dir_senders(topology, n, **kw)
+    if snd is None:
+        return None
+    exists = snd >= 0
+    g = np.asarray(groups)
+    sender_groups = g[:, np.clip(snd, 0, n - 1)]      # (P, D, N)
+    same = g[:, None, :] == sender_groups
+    return exists, same
+
+
+def _mask_cols(x: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """Zero the columns of (W, N) ``x`` where (N,) ``m`` is False."""
+    return jnp.where(m[None, :], x, jnp.zeros((), x.dtype))
+
+
+def tree_masked_exchange(payload: jnp.ndarray, live: jnp.ndarray,
+                         branching: int = 4) -> jnp.ndarray:
+    """:func:`tree_exchange` under per-edge liveness: live[0] masks the
+    parent edge at child positions — applied to the from_parent
+    delivery AND to the child payload pre-fold (one symmetric edge)."""
+    w, n = payload.shape
+    k = branching
+    if n == 1:
+        return jnp.zeros_like(payload)
+    m = live[0]
+    n_parents = (n - 1 + k - 1) // k
+    from_parent = jnp.repeat(payload[:, :n_parents], k, axis=1)[:, :n - 1]
+    from_parent = jnp.concatenate([_zeros(payload, 1), from_parent],
+                                  axis=1)
+    from_parent = _mask_cols(from_parent, m)
+    masked = _mask_cols(payload, m)        # col 0 unused below ([1:])
+    mcount = n_parents * k
+    kids = jnp.concatenate([masked[:, 1:],
+                            _zeros(payload, mcount - (n - 1))], axis=1)
+    from_kids = jnp.bitwise_or.reduce(kids.reshape(w, n_parents, k),
+                                      axis=2)
+    from_kids = jnp.concatenate(
+        [from_kids, _zeros(payload, n - n_parents)], axis=1)
+    return from_parent | from_kids
+
+
+def grid_masked_exchange(payload: jnp.ndarray, live: jnp.ndarray,
+                         cols: int) -> jnp.ndarray:
+    """:func:`grid_exchange` under per-edge liveness (the static
+    row-wrap column masks are folded into the exists rows)."""
+    w, n = payload.shape
+    c = min(cols, n)
+    up = jnp.concatenate([payload[:, c:], _zeros(payload, c)], axis=1)
+    down = jnp.concatenate([_zeros(payload, c), payload[:, :n - c]],
+                           axis=1)
+    left = jnp.concatenate([payload[:, 1:], _zeros(payload, 1)], axis=1)
+    right = jnp.concatenate([_zeros(payload, 1), payload[:, :-1]],
+                            axis=1)
+    return (_mask_cols(up, live[0]) | _mask_cols(down, live[1])
+            | _mask_cols(left, live[2]) | _mask_cols(right, live[3]))
+
+
+def circulant_masked_exchange(payload: jnp.ndarray, live: jnp.ndarray,
+                              strides: list[int]) -> jnp.ndarray:
+    out = None
+    for i, s in enumerate(strides):
+        term = (_mask_cols(jnp.roll(payload, s, axis=1), live[2 * i])
+                | _mask_cols(jnp.roll(payload, -s, axis=1),
+                             live[2 * i + 1]))
+        out = term if out is None else out | term
+    return out if out is not None else jnp.zeros_like(payload)
+
+
+def line_masked_exchange(payload: jnp.ndarray,
+                         live: jnp.ndarray) -> jnp.ndarray:
+    fwd = jnp.concatenate([payload[:, 1:], _zeros(payload, 1)], axis=1)
+    bwd = jnp.concatenate([_zeros(payload, 1), payload[:, :-1]], axis=1)
+    return _mask_cols(fwd, live[0]) | _mask_cols(bwd, live[1])
+
+
+def tree_masked_sync_diff(recv: jnp.ndarray, live: jnp.ndarray,
+                          branching: int = 4) -> jnp.ndarray:
+    w, n = recv.shape
+    k = branching
+    if n == 1:
+        return jnp.uint32(0)
+    n_parents = (n - 1 + k - 1) // k
+    parent = jnp.repeat(recv[:, :n_parents], k, axis=1)[:, :n - 1]
+    return _dir_diff(parent, recv[:, 1:], live[0][1:])
+
+
+def grid_masked_sync_diff(recv: jnp.ndarray, live: jnp.ndarray,
+                          cols: int) -> jnp.ndarray:
+    w, n = recv.shape
+    c = min(cols, n)
+    up = jnp.concatenate([recv[:, c:], _zeros(recv, c)], axis=1)
+    left = jnp.concatenate([recv[:, 1:], _zeros(recv, 1)], axis=1)
+    return (_dir_diff(up, recv, live[0])
+            + _dir_diff(left, recv, live[2]))
+
+
+def circulant_masked_sync_diff(recv: jnp.ndarray, live: jnp.ndarray,
+                               strides: list[int]) -> jnp.ndarray:
+    out = jnp.uint32(0)
+    for i, s in enumerate(strides):
+        out = out + _dir_diff(jnp.roll(recv, s, axis=1), recv,
+                              live[2 * i])
+    return out
+
+
+def line_masked_sync_diff(recv: jnp.ndarray,
+                          live: jnp.ndarray) -> jnp.ndarray:
+    fwd = jnp.concatenate([recv[:, 1:], _zeros(recv, 1)], axis=1)
+    return _dir_diff(fwd, recv, live[0])
+
+
+# sharded (halo) masked variants: the live rows shard over the node
+# axis exactly like the state — every mask application lands on LOCAL
+# receiver columns (the tree's kids pre-fold mask is at child
+# positions, local to the child shard), so the masked halo exchange
+# adds zero ICI traffic over the unmasked one.
+
+
+def tree_masked_sharded_exchange(p_local, live_local, n, n_shards,
+                                 branching=4, axis_name="nodes"):
+    m = live_local[0]
+    from_parent = _mask_cols(
+        tree_parent_payload(p_local, n, n_shards, branching, axis_name),
+        m)
+    from_kids = tree_kids_payload(
+        _mask_cols(p_local, m), n, n_shards, branching, axis_name)
+    return from_parent | from_kids
+
+
+def grid_masked_sharded_exchange(p_local, live_local, n, n_shards,
+                                 cols, axis_name="nodes"):
+    up = sharded_shift(p_local, cols, n_shards, axis_name)
+    down = sharded_shift(p_local, -cols, n_shards, axis_name)
+    lf = sharded_shift(p_local, 1, n_shards, axis_name)
+    rt = sharded_shift(p_local, -1, n_shards, axis_name)
+    return (_mask_cols(up, live_local[0]) | _mask_cols(down, live_local[1])
+            | _mask_cols(lf, live_local[2]) | _mask_cols(rt, live_local[3]))
+
+
+def circulant_masked_sharded_exchange(p_local, live_local, n, n_shards,
+                                      strides, axis_name="nodes"):
+    out = None
+    for i, s in enumerate(strides):
+        term = (_mask_cols(sharded_roll(p_local, s, n, n_shards,
+                                        axis_name), live_local[2 * i])
+                | _mask_cols(sharded_roll(p_local, -s, n, n_shards,
+                                          axis_name),
+                             live_local[2 * i + 1]))
+        out = term if out is None else out | term
+    return out
+
+
+def line_masked_sharded_exchange(p_local, live_local, n, n_shards,
+                                 axis_name="nodes"):
+    fwd = sharded_shift(p_local, 1, n_shards, axis_name)
+    bwd = sharded_shift(p_local, -1, n_shards, axis_name)
+    return (_mask_cols(fwd, live_local[0])
+            | _mask_cols(bwd, live_local[1]))
+
+
+class StructuredFaults(NamedTuple):
+    """Everything a words-major BroadcastSim needs to run a partition
+    schedule gather-free: the host-precomputed masks plus the masked
+    exchange/diff closures (built by :func:`make_faulted`).
+
+    - ``exists``: (D, N) bool — static edge-existence per direction row.
+    - ``same``: (P, D, N) bool — per window, per direction, receiver-
+      side same-group mask.
+    - ``exchange(payload, live)`` / ``sync_diff(recv, live)``:
+      full-axis closures; ``live`` is the (D, N) combined mask.
+    - ``sharded_exchange`` / ``sharded_sync_diff``: halo-path closures
+      over local blocks (None when no halo decomposition exists — the
+      caller falls back to the all_gather path with the full-axis
+      closures)."""
+
+    exists: np.ndarray
+    same: np.ndarray
+    exchange: Callable
+    sync_diff: Callable
+    sharded_exchange: Callable | None
+    sharded_sync_diff: Callable | None
+
+
+def make_faulted(topology: str, n: int, groups: np.ndarray,
+                 n_shards: int | None = None, axis_name: str = "nodes",
+                 **kw) -> StructuredFaults | None:
+    """Build the :class:`StructuredFaults` bundle for a topology under
+    a partition schedule (``groups``: the (P, N) per-window group ids
+    of broadcast.Partitions).  None for unstructured topologies; the
+    sharded closures are None when the halo gates fail (same conditions
+    as :func:`make_sharded_exchange`)."""
+    masks = fault_masks(topology, n, groups, **kw)
+    if masks is None:
+        return None
+    exists, same = masks
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        ex = lambda p, lv: tree_masked_exchange(p, lv, k)  # noqa: E731
+        df = lambda r, lv: tree_masked_sync_diff(r, lv, k)  # noqa: E731
+    elif topology == "grid":
+        cols = kw.get("cols") or grid_cols(n)
+        ex = lambda p, lv: grid_masked_exchange(p, lv, cols)  # noqa: E731
+        df = lambda r, lv: grid_masked_sync_diff(r, lv, cols)  # noqa: E731
+    elif topology in ("ring", "circulant"):
+        strides = [1] if topology == "ring" else list(kw["strides"])
+        ex = lambda p, lv: circulant_masked_exchange(  # noqa: E731
+            p, lv, strides)
+        df = lambda r, lv: circulant_masked_sync_diff(  # noqa: E731
+            r, lv, strides)
+    elif topology == "line":
+        ex, df = line_masked_exchange, line_masked_sync_diff
+    else:
+        return None
+
+    sex = sdf = None
+    if n_shards is not None \
+            and make_sharded_exchange(topology, n, n_shards,
+                                      axis_name=axis_name, **kw) is not None:
+        if topology == "tree":
+            k = kw.get("branching", 4)
+            sex = lambda p, lv: tree_masked_sharded_exchange(  # noqa: E731
+                p, lv, n, n_shards, k, axis_name)
+
+            def sdf(r, lv):
+                parent = tree_parent_payload(r, n, n_shards, k, axis_name)
+                return _dir_diff(parent, r, lv[0])
+        elif topology == "grid":
+            cols = kw.get("cols") or grid_cols(n)
+            sex = lambda p, lv: grid_masked_sharded_exchange(  # noqa: E731
+                p, lv, n, n_shards, cols, axis_name)
+
+            def sdf(r, lv):
+                up = sharded_shift(r, cols, n_shards, axis_name)
+                lf = sharded_shift(r, 1, n_shards, axis_name)
+                return (_dir_diff(up, r, lv[0])
+                        + _dir_diff(lf, r, lv[2]))
+        elif topology in ("ring", "circulant"):
+            strides = [1] if topology == "ring" else list(kw["strides"])
+            sex = lambda p, lv: circulant_masked_sharded_exchange(  # noqa: E731
+                p, lv, n, n_shards, strides, axis_name)
+
+            def sdf(r, lv):
+                out = jnp.uint32(0)
+                for i, s in enumerate(strides):
+                    out = out + _dir_diff(
+                        sharded_roll(r, s, n, n_shards, axis_name), r,
+                        lv[2 * i])
+                return out
+        elif topology == "line":
+            sex = lambda p, lv: line_masked_sharded_exchange(  # noqa: E731
+                p, lv, n, n_shards, axis_name)
+
+            def sdf(r, lv):
+                fwd = sharded_shift(r, 1, n_shards, axis_name)
+                return _dir_diff(fwd, r, lv[0])
+
+    return StructuredFaults(exists, same, ex, df, sex, sdf)

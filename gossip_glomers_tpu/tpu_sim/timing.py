@@ -39,14 +39,20 @@ import numpy as np
 
 def structured_sim(topology: str, n: int, n_values: int, *,
                    sync_every: int = 64, srv_ledger: bool = False,
-                   **kw):
+                   parts=None, **kw):
     """A words-major structured BroadcastSim on the picked mesh (halo
     exchanges on >1 device), ledger off by default — the sync-diff
     accounting runs every round under jit, so timed runs keep it out
-    (see structured.py's sync-diff cost note)."""
+    (see structured.py's sync-diff cost note).
+
+    ``parts`` (broadcast.Partitions, windows in rounds): run the
+    schedule on the structured path via the masked-exchange bundle
+    (structured.make_faulted) — Maelstrom's partition nemesis at any
+    scale without falling back to the gather path."""
     from ..parallel.mesh import pick_mesh
     from .broadcast import BroadcastSim
-    from .structured import (make_exchange, make_sharded_exchange,
+    from .structured import (make_exchange, make_faulted,
+                             make_sharded_exchange,
                              make_sharded_sync_diff, make_sync_diff)
 
     mesh = pick_mesh()
@@ -55,15 +61,22 @@ def structured_sim(topology: str, n: int, n_values: int, *,
         sharded = make_sharded_exchange(topology, n, mesh.size, **kw)
         sharded_diff = make_sharded_sync_diff(topology, n, mesh.size,
                                               **kw)
+    faulted = None
+    if parts is not None and parts.starts.shape[0] > 0:
+        faulted = make_faulted(
+            topology, n, np.asarray(parts.group),
+            n_shards=mesh.size if mesh is not None else None, **kw)
     return BroadcastSim(
         _nbrs_for(topology, n, **kw), n_values=n_values,
         sync_every=sync_every, mesh=mesh,
+        parts=parts,
         exchange=make_exchange(topology, n, **kw),
         sharded_exchange=sharded,
         srv_ledger=srv_ledger,
         sync_diff=make_sync_diff(topology, n, **kw) if srv_ledger
         else None,
-        sharded_sync_diff=sharded_diff if srv_ledger else None)
+        sharded_sync_diff=sharded_diff if srv_ledger else None,
+        faulted=faulted)
 
 
 def discover_rounds(topology: str, n: int, n_values: int, **kw) -> int:

@@ -212,7 +212,10 @@ def test_structured_sharded_and_fused_match():
                 == fast.received_node_major(s3)).all()
 
 
-def test_structured_rejects_partitions():
+def test_structured_with_partitions_requires_faulted_bundle():
+    # a words-major run under a partition schedule needs the masked
+    # closures (structured.make_faulted); without them the constructor
+    # must refuse rather than silently ignore the nemesis
     from gossip_glomers_tpu.tpu_sim.structured import make_exchange
 
     n = 16
@@ -220,7 +223,7 @@ def test_structured_rejects_partitions():
     group[0, :8] = 1
     parts = Partitions(jnp.array([0], jnp.int32),
                        jnp.array([4], jnp.int32), jnp.asarray(group))
-    with pytest.raises(ValueError):
+    with pytest.raises(ValueError, match="make_faulted"):
         BroadcastSim(to_padded_neighbors(tree(n)), n_values=4,
                      parts=parts, exchange=make_exchange("tree", n))
 
@@ -388,13 +391,47 @@ def test_delays_sharded_matches_single_device():
     inject = make_inject(n, nv)
     ref = BroadcastSim(nbrs, n_values=nv, delays=delays)
     s1, r1 = ref.run(inject)
-    shd = BroadcastSim(nbrs, n_values=nv, delays=delays, mesh=mesh_1d())
-    s2, r2 = shd.run(inject)
+    for mesh, nodes_dim in ((mesh_1d(), 8), (mesh_2d(), 4)):
+        shd = BroadcastSim(nbrs, n_values=nv, delays=delays, mesh=mesh)
+        st0 = shd.init_state(inject)
+        # the history ring must be node-SHARDED, not replicated: each
+        # shard stores only its own L x block x W_local slice
+        ring_shape = st0.history.sharding.shard_shape(st0.history.shape)
+        w_local = (shd.n_words // 2 if "words" in mesh.axis_names
+                   else shd.n_words)
+        assert ring_shape == (shd.ring, n // nodes_dim, w_local)
+        s2, r2 = shd.run(inject)
+        assert r1 == r2
+        assert (np.asarray(s1.received) == np.asarray(s2.received)).all()
+        assert int(s1.msgs) == int(s2.msgs)
+        s3, r3 = shd.run_fused(inject)
+        assert r1 == r3
+
+
+def test_delays_sharded_large_partitioned_matches():
+    # the memory-motivated config: many nodes, partition window, mixed
+    # delays — the node-sharded ring + per-delay-value widening must
+    # reproduce the single-device run exactly (this is the shape the
+    # 1M benchmark runs at scale)
+    from gossip_glomers_tpu.parallel.topology import circulant
+
+    n, nv = 1024, 32
+    nbrs = circulant(n, [1, 37, 211])
+    rng = np.random.default_rng(3)
+    delays = rng.integers(1, 4, nbrs.shape).astype(np.int32)
+    group = rng.integers(0, 2, n).astype(np.int8)[None, :]
+    parts = Partitions(jnp.array([2], jnp.int32),
+                       jnp.array([9], jnp.int32), jnp.asarray(group))
+    inject = make_inject(n, nv)
+    ref = BroadcastSim(nbrs, n_values=nv, sync_every=6, parts=parts,
+                       delays=delays)
+    s1, r1 = ref.run(inject)
+    shd = BroadcastSim(nbrs, n_values=nv, sync_every=6, parts=parts,
+                       delays=delays, mesh=mesh_1d())
+    s2, r2 = shd.run_fused(inject)
     assert r1 == r2
     assert (np.asarray(s1.received) == np.asarray(s2.received)).all()
     assert int(s1.msgs) == int(s2.msgs)
-    s3, r3 = shd.run_fused(inject)
-    assert r1 == r3
 
 
 def test_delays_checkpoint_roundtrip(tmp_path):
@@ -926,3 +963,157 @@ def test_grid_cols_threads_through_timing():
     assert rref == rounds
     assert (ref.received_node_major(sref)
             == sim.received_node_major(state)).all()
+
+
+# -- partition faults on the structured words-major path ----------------
+
+
+def _window_parts(wins, n):
+    """Partitions from [(start, end, group_row), ...]."""
+    starts = jnp.asarray([w[0] for w in wins], jnp.int32)
+    ends = jnp.asarray([w[1] for w in wins], jnp.int32)
+    group = np.stack([w[2] for w in wins]).astype(np.int8)
+    return Partitions(starts, ends, jnp.asarray(group)), group
+
+
+def _fault_cases(n, seed=0):
+    """Partition-window sets exercising single, overlapping, and
+    repeated windows with varied group shapes."""
+    rng = np.random.default_rng(seed)
+    half = np.zeros(n, np.int8)
+    half[: n // 2] = 1
+    thirds = (np.arange(n) * 3 // n).astype(np.int8)
+    rand = rng.integers(0, 2, n).astype(np.int8)
+    return [
+        [(0, 6, half)],
+        [(2, 8, thirds), (5, 12, rand)],          # overlapping windows
+        [(0, 4, rand), (9, 14, half)],            # repeated windows
+    ]
+
+
+def test_faulted_structured_matches_gather_all_topologies():
+    # the masked words-major exchange under a partition schedule must
+    # be BIT-EXACT with the adjacency-gather path: received, msgs, and
+    # the reference-accounted srv ledger
+    from gossip_glomers_tpu.parallel.topology import (circulant,
+                                                      expander_strides,
+                                                      ring)
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    cases = [("tree", 64, {}),
+             ("tree", 85, {"branching": 4}),       # ragged last level
+             ("grid", 64, {}),
+             ("grid", 60, {}),                     # ragged last row
+             ("ring", 32, {}),
+             ("line", 32, {}),
+             ("circulant", 64, {"strides": expander_strides(64, 6, 1)})]
+    builders = {"ring": lambda n, kw: to_padded_neighbors(ring(n)),
+                "circulant": lambda n, kw: circulant(n, kw["strides"]),
+                "tree": lambda n, kw: to_padded_neighbors(
+                    tree(n, kw.get("branching", 4))),
+                "grid": lambda n, kw: to_padded_neighbors(grid(n)),
+                "line": lambda n, kw: to_padded_neighbors(line(n))}
+    for topo, n, kw in cases:
+        nbrs = builders[topo](n, kw)
+        nv = min(n, 48)
+        inject = make_inject(n, nv)
+        for wins in _fault_cases(n, seed=n):
+            parts, group = _window_parts(wins, n)
+            ref = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                               parts=parts)
+            s1, r1 = ref.run(inject)
+            f = structured.make_faulted(topo, n, group, **kw)
+            fast = BroadcastSim(
+                nbrs, n_values=nv, sync_every=4, parts=parts,
+                exchange=structured.make_exchange(topo, n, **kw),
+                faulted=f)
+            s2, r2 = fast.run(inject)
+            assert r1 == r2, (topo, n, len(wins))
+            assert (ref.received_node_major(s1)
+                    == fast.received_node_major(s2)).all(), (topo, n)
+            assert int(s1.msgs) == int(s2.msgs), (topo, n)
+            assert ref.server_msgs(s1) == fast.server_msgs(s2), \
+                (topo, n, len(wins))
+
+
+def test_faulted_structured_sharded_matches_single_device():
+    # halo mode (masks sharded with the node axis) and the all_gather
+    # fallback must both reproduce the single-device faulted run
+    # exactly — stepwise, fused, and fixed-trip
+    from gossip_glomers_tpu.parallel.topology import circulant
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    cases = [("tree", 64, {}),
+             ("circulant", 128, {"strides": [1, 5, 33]}),
+             ("grid", 256, {}),
+             ("line", 64, {})]
+    builders = {"circulant": lambda n, kw: circulant(n, kw["strides"]),
+                "tree": lambda n, kw: to_padded_neighbors(tree(n)),
+                "grid": lambda n, kw: to_padded_neighbors(grid(n)),
+                "line": lambda n, kw: to_padded_neighbors(line(n))}
+    for topo, n, kw in cases:
+        nbrs = builders[topo](n, kw)
+        nv = 48
+        inject = make_inject(n, nv)
+        half = np.zeros(n, np.int8)
+        half[: n // 2] = 1
+        parts, group = _window_parts([(0, 6, half)], n)
+        f1 = structured.make_faulted(topo, n, group, **kw)
+        ref = BroadcastSim(nbrs, n_values=nv, sync_every=4, parts=parts,
+                           exchange=structured.make_exchange(topo, n, **kw),
+                           faulted=f1)
+        s1, r1 = ref.run(inject)
+        for mesh, pdim in ((mesh_1d(), 8), (mesh_2d(), 4)):
+            for shards in (pdim, None):   # halo mode / fallback mode
+                f = structured.make_faulted(topo, n, group,
+                                            n_shards=shards, **kw)
+                if shards is not None:
+                    assert f.sharded_exchange is not None, (topo, n)
+                sim = BroadcastSim(
+                    nbrs, n_values=nv, sync_every=4, parts=parts,
+                    mesh=mesh,
+                    exchange=structured.make_exchange(topo, n, **kw),
+                    faulted=f)
+                s2, r2 = sim.run(inject)
+                assert r1 == r2, (topo, n, shards, mesh.axis_names)
+                assert (ref.received_node_major(s1)
+                        == sim.received_node_major(s2)).all(), \
+                    (topo, n, shards)
+                assert int(s1.msgs) == int(s2.msgs), (topo, n, shards)
+                if shards is not None:
+                    # srv ledger lives on the halo path only
+                    assert ref.server_msgs(s1) == sim.server_msgs(s2), \
+                        (topo, n, shards)
+                s3, r3 = sim.run_fused(inject)
+                assert r1 == r3
+                assert (ref.received_node_major(s1)
+                        == sim.received_node_major(s3)).all()
+                st0, tgt = sim.stage(inject)
+                s4 = sim.run_staged_fixed(st0, r1)
+                assert (ref.received_node_major(s1)
+                        == sim.received_node_major(s4)).all()
+
+
+def test_faulted_structured_converges_only_after_heal():
+    # mid-partition the cut-off half must know nothing; convergence
+    # happens only after the window lifts (anti-entropy repair)
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    n, nv = 64, 8
+    nbrs = to_padded_neighbors(tree(n))
+    half = np.zeros(n, np.int8)
+    half[: n // 2] = 1
+    parts, group = _window_parts([(0, 10, half)], n)
+    f = structured.make_faulted("tree", n, group)
+    sim = BroadcastSim(nbrs, n_values=nv, sync_every=4, parts=parts,
+                       exchange=structured.make_exchange("tree", n),
+                       faulted=f)
+    inject = make_inject(n, nv, origins=np.zeros(nv, dtype=np.int64))
+    state = sim.init_state(inject)
+    for _ in range(9):
+        state = sim.step(state)
+    reads = sim.read(state)
+    assert all(not r for r in reads[n // 2:])
+    state, rounds = sim.run(inject)
+    assert rounds > 10
+    assert converged_reads(sim, state, nv)
