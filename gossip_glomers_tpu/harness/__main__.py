@@ -103,9 +103,13 @@ def main(argv: list[str] | None = None) -> int:
                         rate=args.rate, latency=args.latency,
                         seed=args.seed)
 
-    print(json.dumps({"workload": args.workload, "ok": res.ok,
-                      **{k: v for k, v in res.stats.items()
-                         if isinstance(v, (int, float, str))}}))
+    out = {"workload": args.workload, "ok": res.ok,
+           **{k: v for k, v in res.stats.items()
+              if isinstance(v, (int, float, str))}}
+    if "linearizable" in res.details:
+        # the knossos-style KV certification verdict (linearize.py)
+        out["linearizable"] = res.details["linearizable"]
+    print(json.dumps(out))
     if res.ok:
         print("Everything looks good! (checker passed)")
         return 0

@@ -146,18 +146,22 @@ def check_linearizable(history: list[Op],
                 "states_explored": len(seen)}
 
 
-def history_from_kv_trace(trace, service_id: str = "seq-kv",
-                          key: str | None = None) -> list[Op]:
-    """Build a checkable history for one key from a virtual-network
-    message trace (harness/tracing.py): pairs each KV request with its
-    reply by msg_id, windows = [request routed, reply routed]."""
+def histories_from_kv_trace(trace, service_id: str = "seq-kv",
+                            ) -> dict[str, list[Op]]:
+    """Build checkable per-key histories in ONE pass over a
+    virtual-network message trace (harness/tracing.py): pairs each KV
+    request with its reply by msg_id, windows = [request routed, reply
+    routed]."""
     pending: dict[tuple[str, int], tuple[float, dict]] = {}
-    ops: list[Op] = []
+    ops: dict[str, list[Op]] = {}
+
+    def emit(req: dict, op: Op) -> None:
+        ops.setdefault(str(req.get("key")), []).append(op)
+
     for t, msg in trace:
         body = msg.body
         if msg.dest == service_id and body.get("msg_id") is not None:
-            if key is None or str(body.get("key")) == key:
-                pending[(msg.src, body["msg_id"])] = (t, body)
+            pending[(msg.src, body["msg_id"])] = (t, body)
         elif msg.src == service_id and body.get("in_reply_to") is not None:
             slot = pending.pop((msg.dest, body["in_reply_to"]), None)
             if slot is None:
@@ -166,11 +170,11 @@ def history_from_kv_trace(trace, service_id: str = "seq-kv",
             kind = req["type"]
             if kind == "read":
                 if body.get("type") == "error":
-                    ops.append(Op(t0, t, "read", (), KEY_MISSING))
+                    emit(req, Op(t0, t, "read", (), KEY_MISSING))
                 else:
-                    ops.append(Op(t0, t, "read", (), body.get("value")))
+                    emit(req, Op(t0, t, "read", (), body.get("value")))
             elif kind == "write":
-                ops.append(Op(t0, t, "write", (req.get("value"),), "ok"))
+                emit(req, Op(t0, t, "write", (req.get("value"),), "ok"))
             elif kind == "cas":
                 if body.get("type") == "cas_ok":
                     res = "ok"
@@ -184,9 +188,9 @@ def history_from_kv_trace(trace, service_id: str = "seq-kv",
                     # from frm (swaps) — modeled exactly as its own op so
                     # a successful ccas with a mismatched frm on an
                     # existing key is correctly rejected.
-                    ops.append(Op(t0, t, "ccas", (frm, to), res))
+                    emit(req, Op(t0, t, "ccas", (frm, to), res))
                 else:
-                    ops.append(Op(t0, t, "cas", (frm, to), res))
+                    emit(req, Op(t0, t, "cas", (frm, to), res))
     # requests whose reply was never observed (drops/timeouts) are
     # indeterminate: they may have taken effect — record them as
     # maybe-ops so the checker considers both branches.  Unanswered
@@ -195,11 +199,21 @@ def history_from_kv_trace(trace, service_id: str = "seq-kv",
     for (_, _), (t0, req) in pending.items():
         kind = req["type"]
         if kind == "write":
-            ops.append(Op(t0, inf, "write", (req.get("value"),), None,
-                          maybe=True))
+            emit(req, Op(t0, inf, "write", (req.get("value"),), None,
+                         maybe=True))
         elif kind == "cas":
             kind2 = "ccas" if req.get("create_if_not_exists") else "cas"
-            ops.append(Op(t0, inf, kind2,
-                          (req.get("from"), req.get("to")), None,
-                          maybe=True))
+            emit(req, Op(t0, inf, kind2,
+                         (req.get("from"), req.get("to")), None,
+                         maybe=True))
     return ops
+
+
+def history_from_kv_trace(trace, service_id: str = "seq-kv",
+                          key: str | None = None) -> list[Op]:
+    """Single-key view of :func:`histories_from_kv_trace` (all keys
+    concatenated when ``key`` is None)."""
+    hists = histories_from_kv_trace(trace, service_id)
+    if key is not None:
+        return hists.get(key, [])
+    return [op for k in sorted(hists) for op in hists[k]]

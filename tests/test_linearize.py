@@ -192,3 +192,64 @@ def test_long_history_no_recursion_limit():
     ok, details = check_linearizable(h)
     assert ok
     assert details["order"] is not None and len(details["order"]) == 3001
+
+
+# -- checker wired into the workloads (knossos-style certification) -----
+
+
+def test_workloads_certify_kv_linearizability():
+    # run_counter / run_kafka / run_kafka_faults now run the checker
+    # over the captured KV trace; healthy services must certify
+    from gossip_glomers_tpu.harness import random_partitions
+    from gossip_glomers_tpu.harness.workloads import (run_counter,
+                                                      run_kafka,
+                                                      run_kafka_faults)
+
+    res = run_counter(n_nodes=3, n_ops=30, latency=0.02, seed=5)
+    assert res.ok and res.details["linearizable"]
+    assert res.details["lin_by_key"]["value"]["n_ops"] > 10
+
+    res = run_kafka(n_nodes=2, n_keys=2, n_ops=60, seed=1)
+    assert res.ok and res.details["linearizable"]
+
+    nodes = [f"n{i}" for i in range(4)]
+    res = run_kafka_faults(
+        n_nodes=4, partitions=random_partitions(
+            nodes, t_end=12.0, seed=2, include=["lin-kv"]), seed=2)
+    assert res.ok and res.details["linearizable"]
+    assert sum(v["n_ops"] for v in res.details["lin_by_key"].values()) > 50
+
+
+def test_linearize_check_bites_on_stale_cas_bug(monkeypatch):
+    # mutation test: inject a stale-CAS bug into the KV service (a CAS
+    # against a stale `from` succeeds anyway — the classic lost-update
+    # bug) and prove the wired-in checker FAILS the workload.  The
+    # injection is seeded and service-side only; nodes are untouched.
+    import random as _random
+
+    from gossip_glomers_tpu.harness import services, workloads
+    from gossip_glomers_tpu.harness.services import KVService
+    from gossip_glomers_tpu.harness.workloads import run_kafka_faults
+
+    class StaleCASKV(KVService):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self._bug_rng = _random.Random(1234)
+
+        def deliver(self, msg):
+            body = msg.body
+            key = str(body.get("key"))
+            if (msg.type == "cas" and key in self.store
+                    and self.store[key] != body.get("from")
+                    and self._bug_rng.random() < 0.5):
+                # BUG: accept the CAS against a stale expectation
+                self.store[key] = body.get("to")
+                self._reply(msg, {"type": "cas_ok"})
+                return
+            super().deliver(msg)
+
+    monkeypatch.setattr(workloads, "KVService", StaleCASKV)
+    res = run_kafka_faults(n_nodes=4, seed=3)
+    assert res.details["linearizable"] is False
+    bad = [k for k, v in res.details["lin_by_key"].items() if not v["ok"]]
+    assert bad, "at least one key history must fail certification"
