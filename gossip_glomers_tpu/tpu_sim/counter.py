@@ -12,12 +12,16 @@ Semantics mirrored from the reference node (counter/add.go, main.go):
 
 Two flush modes:
 
-- **cas** (parity-flavored): one CAS winner per round — the node with
-  the smallest index whose cached value matches the KV (a fresh read)
-  wins; everyone else observes the new value next round (the reference's
-  failed-CAS → re-read → retry loop, one linearization step per round).
-  Drains one contender per round, reproducing the contention behavior of
-  N nodes CAS-ing one key.
+- **cas** (parity-flavored): one CAS winner per round — a seeded
+  per-round pseudo-random pick among the fresh-read contenders (whose
+  cached value matches the KV); everyone else observes the new value
+  next round (the reference's failed-CAS → re-read → retry loop, one
+  linearization step per round).  Drains one contender per round,
+  reproducing the contention behavior of N nodes CAS-ing one key; the
+  randomized pick mirrors the reference's jittered retry contention
+  (add.go:56-58) instead of a systematic lowest-index bias, while the
+  4-messages-per-contender-per-wave ledger is winner-agnostic (pinned
+  by test_counter_ledger_matches_harness_contention).
 - **allreduce** (scaled regime): every reachable node's pending sum is
   applied in one ``psum`` — the g-counter as a collective, for the
   1k-node+ partitioned benchmark (BASELINE.json config 3).
@@ -90,13 +94,20 @@ class CounterSim:
     def __init__(self, n_nodes: int, *, mode: str = "cas",
                  poll_every: int = 4,
                  kv_sched: KVReach | None = None,
-                 mesh: Mesh | None = None) -> None:
+                 mesh: Mesh | None = None, seed: int = 0) -> None:
         if mode not in ("cas", "allreduce"):
             raise ValueError(f"unknown mode {mode!r}")
         self.n_nodes = n_nodes
         self.mode = mode
         self.poll_every = poll_every
         self.mesh = mesh
+        self.seed = seed
+        # cas-winner key layout: per-round hashed priority in the high
+        # bits, row id in the low bits (tie-break + winner recovery);
+        # both must fit an int32 for the pmin collective
+        self._row_bits = max(1, (n_nodes - 1).bit_length())
+        if self._row_bits > 24:
+            raise ValueError("cas winner keys support n_nodes < 2^24")
         self.kv_sched = (kv_sched if kv_sched is not None
                          else KVReach.none(n_nodes))
         self._node_spec = P("nodes") if mesh is not None else None
@@ -147,18 +158,38 @@ class CounterSim:
             attempts = allsum(want.astype(jnp.uint32)) * jnp.uint32(4)
             winner_mask = want
         else:
-            # cas mode: fresh-read holders CAS first; lowest index wins
-            # (the KV linearizes one CAS per round; everyone else fails,
-            # re-reads, retries — add.go:78-88's retry loop).
+            # cas mode: fresh-read holders CAS first; ONE wins (the KV
+            # linearizes one CAS per round; everyone else fails,
+            # re-reads, retries — add.go:78-88's retry loop).  The
+            # winner is a seeded per-round hash-min over the
+            # contenders, mirroring the reference's jittered retry
+            # contention (add.go:56-58) instead of a systematic
+            # lowest-index bias: key = hashed priority (high bits) |
+            # row id (low bits, tie-break + winner recovery).
             fresh = want & (state.cached == state.kv)
-            candidates = jnp.where(fresh, row_ids,
-                                   jnp.int32(self.n_nodes))
+            x = (row_ids.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+                 + (state.t.astype(jnp.uint32)
+                    + jnp.uint32(self.seed)) * jnp.uint32(0x85EBCA6B))
+            x = x ^ (x >> 16)
+            x = x * jnp.uint32(0x7FEB352D)
+            x = x ^ (x >> 15)
+            pri_bits = 31 - self._row_bits
+            # cap the priority below all-ones so a real key can never
+            # collide with the no-candidate sentinel
+            pri = jnp.minimum(
+                (x >> jnp.uint32(32 - pri_bits)).astype(jnp.int32),
+                jnp.int32(2**pri_bits - 2))
+            key = (pri << self._row_bits) | row_ids
+            candidates = jnp.where(fresh, key, jnp.int32(2**31 - 1))
             local_min = jnp.min(candidates)
-            winner = (local_min if psum is None
-                      else lax.pmin(local_min, "nodes"))
+            best = (local_min if psum is None
+                    else lax.pmin(local_min, "nodes"))
+            has_winner = best < jnp.int32(2**31 - 1)
+            winner = jnp.where(has_winner,
+                               best & jnp.int32((1 << self._row_bits) - 1),
+                               jnp.int32(self.n_nodes))
             winner_delta = allsum(
                 jnp.where(row_ids == winner, state.pending, 0))
-            has_winner = winner < self.n_nodes
             kv = state.kv + jnp.where(has_winner, winner_delta, 0)
             winner_mask = (row_ids == winner)
             pending = jnp.where(winner_mask, 0, state.pending)

@@ -118,6 +118,8 @@ class KafkaSim:
         self.kv_retries = kv_retries
         self._run_rounds = None
         self._step = self._build_step()
+        self._poll_batch_fn = None
+        self._alloc_fn = None
 
     def init_state(self) -> KafkaState:
         n, k, c = self.n_nodes, self.n_keys, self.capacity
@@ -414,41 +416,85 @@ class KafkaSim:
     def alloc_offsets(self, state_before: KafkaState,
                       send_key: np.ndarray) -> np.ndarray:
         """(N, S) int32 — the offsets the sends of this round were acked
-        with (``send_ok`` replies), or -1.  Computed host-side with the
-        same (node, slot)-order linearization as the device round."""
-        kv = np.asarray(state_before.kv_val)
-        base = np.where(kv > 0, kv, 1)
-        flat = np.asarray(send_key, np.int32).reshape(-1)
-        seen: dict[int, int] = {}
-        out = np.full(flat.shape, -1, np.int32)
-        for i, k in enumerate(flat):
-            if k < 0:
-                continue
-            r = seen.get(int(k), 0)
-            seen[int(k)] = r + 1
-            off = int(base[k]) + r
-            if off - 1 < self.capacity:
-                out[i] = off
-        return out.reshape(send_key.shape)
+        with (``send_ok`` replies), or -1.  Runs the SAME device
+        program (:func:`_rank_within_key` + base lookup) as the round's
+        allocator — one dispatch per batch, no per-send host loop."""
+        if self._alloc_fn is None:
+            cap = self.capacity
+            k_dim = self.n_keys
+
+            @jax.jit
+            def alloc(kv_val, send_key):
+                flat = send_key.reshape(-1)
+                valid = flat >= 0
+                keys_c = jnp.clip(flat, 0, k_dim - 1)
+                rank = _rank_within_key(keys_c, valid)
+                base = jnp.where(kv_val > 0, kv_val, 1)
+                off = base[keys_c] + rank
+                ok = valid & (off - 1 < cap)
+                return jnp.where(ok, off, -1).reshape(send_key.shape)
+
+            self._alloc_fn = alloc
+        return np.asarray(self._alloc_fn(
+            state_before.kv_val, jnp.asarray(send_key, jnp.int32)))
+
+    def poll_batch_program(self):
+        """The jitted batched-poll device program: ``(present,
+        log_vals, nodes, keys, from_offsets) -> (offsets, msgs)`` with
+        (Q,) query arrays and (Q, capacity) padded outputs (offset -1
+        = empty slot).  Public so benchmarks can drive the device
+        program directly (chained, device-resident) without the host
+        round-trip :meth:`poll_batch` adds."""
+        if self._poll_batch_fn is None:
+            cap = self.capacity
+
+            @jax.jit
+            def pb(present, log_vals, nodes, keys, from_off):
+                pres = present[nodes, keys]             # (Q, C)
+                offs = jnp.arange(1, cap + 1, dtype=jnp.int32)
+                sel = pres & (offs[None, :] >= from_off[:, None])
+                vals = log_vals[keys]                   # (Q, C)
+                return (jnp.where(sel, offs[None, :], -1),
+                        jnp.where(sel, vals, 0))
+
+            self._poll_batch_fn = pb
+        return self._poll_batch_fn
+
+    def poll_batch(self, state: KafkaState, nodes: np.ndarray,
+                   keys: np.ndarray, from_offsets: np.ndarray,
+                   ) -> tuple[np.ndarray, np.ndarray]:
+        """Batched LOCAL-log poll (log.go:79-110) as ONE device
+        program: for Q queries (node, key, from_offset), returns padded
+        ``(offsets, msgs)`` arrays of shape (Q, capacity) — offset -1
+        marks an empty slot (not present locally, or below the
+        requested offset).  Slots are offset-ascending by layout, so
+        each row is a ready [offset, msg] block.  This is the
+        poll-heavy path the benchmark drives at 10k keys; the
+        single-query :meth:`poll` wraps it."""
+        offs, vals = self.poll_batch_program()(
+            state.present, state.log_vals,
+            jnp.asarray(nodes, jnp.int32), jnp.asarray(keys, jnp.int32),
+            jnp.asarray(from_offsets, jnp.int32))
+        return np.asarray(offs), np.asarray(vals)
 
     def poll(self, state: KafkaState, node: int, key: int,
              from_offset: int) -> list[list[int]]:
         """[[offset, msg], ...] from this node's LOCAL log only
-        (log.go:79-110) — present slots at offset >= from_offset."""
-        present = np.asarray(state.present[node, key])
-        vals = np.asarray(state.log_vals[key])
-        out = []
-        for c in np.flatnonzero(present):
-            off = int(c) + 1
-            if off >= from_offset:
-                out.append([off, int(vals[c])])
-        return out
+        (log.go:79-110) — the single-query view of
+        :meth:`poll_batch`."""
+        offs, vals = self.poll_batch(
+            state, np.array([node]), np.array([key]),
+            np.array([from_offset]))
+        sel = offs[0] >= 0
+        return [[int(o), int(v)]
+                for o, v in zip(offs[0][sel], vals[0][sel])]
 
     def list_committed(self, state: KafkaState, node: int) -> dict[int, int]:
         """Per-key committed offsets from the node's LOCAL cache only
         (log.go:131-156)."""
         lc = np.asarray(state.local_committed[node])
-        return {k: int(lc[k]) for k in range(self.n_keys) if lc[k] > 0}
+        (nz,) = np.nonzero(lc > 0)
+        return {int(k): int(lc[k]) for k in nz}
 
     def lin_kv(self, state: KafkaState) -> dict[int, int]:
         """The shared lin-kv cells (key -> value).  After sends this is
