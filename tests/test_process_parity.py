@@ -218,6 +218,23 @@ SYNC_WAVE_EXPECT = {"broadcast": 265, "broadcast_ok": 264,
 SYNC_T = 4.0   # pinned sync interval for both scenario backends
 
 
+def _wait_msgs(net, pred, deadline_s: float, what: str,
+               poll: float = 0.05) -> None:
+    """Event-driven wait: poll the server-message ledger until ``pred``
+    (on server_msgs_by_type) holds, failing loudly at the deadline —
+    the loaded-machine-proof replacement for fixed sleeps."""
+    import time
+
+    t_end = time.monotonic() + deadline_s
+    while time.monotonic() < t_end:
+        if pred(dict(net.server_msgs_by_type)):
+            return
+        time.sleep(poll)
+    raise AssertionError(
+        f"timed out after {deadline_s:.1f}s waiting for {what}; "
+        f"ledger: {dict(net.server_msgs_by_type)}")
+
+
 def _sync_wave_scenario_process():
     import time
     from concurrent.futures import ThreadPoolExecutor
@@ -260,17 +277,25 @@ def _sync_wave_scenario_process():
         blocked["on"] = True
         rep = net.rpc("n0", {"type": "broadcast", "message": 10})
         assert rep["type"] == "broadcast_ok"
-        time.sleep(0.2)                       # flood done, n24's copy lost
+        net.quiesce(idle=0.15, timeout=3.0)   # flood done, n24's copy lost
         blocked["on"] = False                 # heal before the first wave
         assert time.monotonic() < t_first + SYNC_T - 0.3, (
             "scenario precondition: flood + partition window did not "
             "finish before the first sync wave — machine too loaded")
         assert not net.rpc("n24", {"type": "read"}).get("messages",
                                                         []).count(10)
-        # wait past n24's wave 2 (t24+2T) but before anyone's wave 3
-        # (earliest is n0's at ~t_first+3T; the init precondition above
-        # guarantees >1s of clearance)
-        time.sleep(max(0.0, t24 + 2 * SYNC_T + 0.7 - time.monotonic()))
+        # event-driven wave-2 wait: both waves' read fan-outs total 96
+        # (2 x sum of degrees); poll the ledger for the last of them
+        # (n24's wave 2 at ~t24+2T) instead of sleeping a fixed window,
+        # then drain the trailing read_oks/acks via idle detection.
+        # Deadline = just before anyone's wave 3 (earliest ~t_first+3T;
+        # the init precondition above guarantees >1s of clearance).
+        deadline = (t_first + 3 * SYNC_T - 0.6) - time.monotonic()
+        _wait_msgs(net,
+                   lambda m: m.get("read", 0)
+                   >= SYNC_WAVE_EXPECT["read"],
+                   deadline, "both sync waves' reads")
+        net.quiesce(idle=0.25, timeout=2.0)
         snap = dict(net.server_msgs_by_type)
         r24 = sorted(net.rpc("n24", {"type": "read"})["messages"])
         return snap, r24
@@ -329,8 +354,11 @@ def test_sync_waves_process_vs_virtual_vs_analytic():
 
 
 def _counter_session(argv):
-    """2 nodes + seq-kv: three adds, wait out the 200 ms flush cadence
-    and 700 ms read-poll (add.go:62, counter/main.go:53), read both."""
+    """2 nodes + seq-kv: three adds, then poll reads until the 200 ms
+    flush cadence and 700 ms read-poll (add.go:62, counter/main.go:53)
+    have propagated the sum to both nodes' caches — event-driven with a
+    deadline, not a fixed sleep (reads are local-cache-only,
+    add.go:29-31, so polling does not perturb the flush path)."""
     import time
 
     net = ProcessNetwork()
@@ -342,9 +370,17 @@ def _counter_session(argv):
         for d in (3, 4, 5):
             rep = net.rpc(f"n{d % 2}", {"type": "add", "delta": d})
             assert rep["type"] == "add_ok"
-        time.sleep(1.6)
-        return [net.rpc(f"n{i}", {"type": "read"})["value"]
-                for i in range(2)]
+
+        def read_both():
+            return [net.rpc(f"n{i}", {"type": "read"})["value"]
+                    for i in range(2)]
+
+        vals = read_both()
+        t_end = time.monotonic() + 8.0
+        while vals != [12, 12] and time.monotonic() < t_end:
+            time.sleep(0.2)
+            vals = read_both()
+        return vals
     finally:
         net.shutdown()
 
