@@ -5,9 +5,16 @@
   2. broadcast: 25-node grid, 100 ms + parts   (virtual harness, faults)
   3. counter:   1k-node g-counter, partitioned (tpu_sim, all-reduce)
   4. broadcast: 1M-node expander epidemic      (tpu_sim, structured)
+  4b. broadcast: 1M-node uniform random-regular (tpu_sim, gather control)
+  4c. broadcast: 1M-node epidemic + partition window (tpu_sim, masked
+      structured — the nemesis on the scale path)
+  4d. broadcast: 1M-node epidemic, mixed per-edge delays (tpu_sim,
+      gather + node-sharded history ring)
   5. kafka:     10k-key log, collective offsets(tpu_sim, rank-per-round)
   6. broadcast: 1M nodes x 4,096 values (W=128 words axis), tree +
      circulant — the many-values regime (tpu_sim, structured)
+  7. broadcast: node-axis scale sweep 256k -> 16M, W=1/W=128, tree +
+     circulant — the single-chip ceiling table (tpu_sim, structured)
 
 Usage: python benchmarks/run_all.py [--out BENCH_ALL.json]
 The headline driver metric stays in bench.py (config 4's tree variant).
@@ -170,6 +177,100 @@ def config4b_random_regular_1m():
     }
 
 
+def config4c_epidemic_1m_partitioned():
+    """Maelstrom's partition nemesis ON the structured scale path: the
+    1M-node circulant epidemic with a seeded half/half partition window
+    active for rounds [2, 24) — flood frontiers die at the cut, so only
+    the periodic anti-entropy (sync_every=16) repairs the halves after
+    the heal, exactly like the reference's SyncBroadcast role
+    (broadcast.go:81-122).  Runs gather-free: the masked words-major
+    exchange applies host-precomputed per-direction liveness masks
+    (structured.make_faulted), pinned bit-exact against the adjacency-
+    gather path by test_faulted_structured_matches_gather_*."""
+    import jax
+    import jax.numpy as jnp
+
+    from gossip_glomers_tpu.parallel.topology import expander_strides
+    from gossip_glomers_tpu.tpu_sim.broadcast import (Partitions,
+                                                      make_inject)
+    from gossip_glomers_tpu.tpu_sim.timing import (chained_time,
+                                                   structured_sim)
+
+    n = 1 << 20
+    strides = expander_strides(n, degree=8, seed=0)
+    rng = np.random.default_rng(7)
+    group = rng.integers(0, 2, n).astype(np.int8)[None, :]
+    parts = Partitions(jnp.array([2], jnp.int32),
+                       jnp.array([24], jnp.int32), jnp.asarray(group))
+    sim = structured_sim("circulant", n, 32, strides=strides,
+                         sync_every=16, parts=parts)
+    inject = make_inject(n, 32)
+    state_d, rounds = sim.run_fused(inject)     # device discovery
+    state0, target = sim.stage(inject)
+    jax.block_until_ready(state0.received)
+    warm = sim.run_staged_fixed(state0, rounds)
+    jax.block_until_ready(warm.received)
+    dt = chained_time(lambda st: sim.run_staged_fixed(st, rounds),
+                      state0,
+                      lambda st: np.asarray(st.received[:1, :1]),
+                      target_s=1.0)
+    return {
+        "config": "broadcast-1M-epidemic-partitioned",
+        "ok": bool(sim.converged(warm, target) and rounds > 24),
+        "rounds": rounds,
+        "partition_window_rounds": [2, 24],
+        "wall_s": round(dt, 4),
+        "ms_per_round": round(dt / rounds * 1e3, 3),
+        "msgs": int(warm.msgs),
+    }
+
+
+def config4d_epidemic_1m_delayed():
+    """Maelstrom's per-hop latency config at full scale: the 1M-node
+    epidemic with MIXED per-edge delays (1 or 3 rounds, seeded) on the
+    adjacency-gather path.  The payload-history ring is node-sharded
+    (O(L·N/shards) per device; broadcast.py::_gather_or_delayed), so
+    delayed runs no longer replicate an (L, N, W) ring per shard —
+    matching Maelstrom's 100 ms/hop configuration at any size
+    (reference README.md:16)."""
+    import jax
+
+    from gossip_glomers_tpu.parallel.topology import circulant, \
+        expander_strides
+    from gossip_glomers_tpu.tpu_sim.broadcast import (BroadcastSim,
+                                                      make_inject)
+    from gossip_glomers_tpu.tpu_sim.timing import chained_time
+
+    n = 1 << 20
+    strides = expander_strides(n, degree=8, seed=0)
+    nbrs = circulant(n, strides)
+    rng = np.random.default_rng(11)
+    delays = rng.choice([1, 3], size=nbrs.shape, p=[0.7, 0.3]).astype(
+        np.int32)
+    sim = BroadcastSim(nbrs, n_values=32, sync_every=1 << 20,
+                       srv_ledger=False, delays=delays)
+    inject = make_inject(n, 32)
+    _, rounds = sim.run(inject)           # host-stepped discovery
+    state0, target = sim.stage(inject)
+    jax.block_until_ready(state0.received)
+    warm = sim.run_staged_fixed(state0, rounds)
+    jax.block_until_ready(warm.received)
+    dt = chained_time(lambda st: sim.run_staged_fixed(st, rounds),
+                      state0,
+                      lambda st: np.asarray(st.received[:1, :1]),
+                      target_s=2.0)
+    return {
+        "config": "broadcast-1M-epidemic-delayed-edges",
+        "ok": bool(sim.converged(warm, target)),
+        "rounds": rounds,
+        "delay_values": [1, 3],
+        "ring_rounds": sim.ring,
+        "wall_s": round(dt, 4),
+        "ms_per_round": round(dt / rounds * 1e3, 3),
+        "msgs": int(warm.msgs),
+    }
+
+
 def config6_words_axis_w128():
     """The words-axis (many-values) regime: 1M nodes x 4,096 values =
     128 uint32 bitset words per node, tree + circulant structured
@@ -181,6 +282,60 @@ def config6_words_axis_w128():
 
     return {"config": "broadcast-1M-words-axis-w128", "ok": True,
             **words_axis_regime(1 << 20, 4096)}
+
+
+def config7_scale_sweep():
+    """Node-axis scale sweep: 256k -> 1M -> 4M -> 16M nodes, W=1 and
+    W=128 bitset words, tree + circulant structured exchanges — finds
+    the single-chip ceiling (ms/round, effective GB/s, state bytes)
+    and where the mesh path must take over.  Configs that exceed HBM
+    are attempted and recorded as OOM rather than silently skipped."""
+    from gossip_glomers_tpu.parallel.topology import expander_strides
+    from gossip_glomers_tpu.tpu_sim.broadcast import make_inject
+    from gossip_glomers_tpu.tpu_sim.timing import (TimedRun,
+                                                   discover_rounds,
+                                                   structured_sim)
+
+    import os
+
+    n_exps = tuple(int(x) for x in os.environ.get(
+        "GG_SWEEP_NEXP", "18,20,22,24").split(","))
+    entries = []
+    for n_exp in n_exps:
+        n = 1 << n_exp
+        for nv, wlabel in ((32, "w1"), (4096, "w128")):
+            w = nv // 32
+            state_gb = n * w * 4 / 1e9
+            for topo in ("tree", "circulant"):
+                kw = ({"branching": 4} if topo == "tree"
+                      else {"strides": expander_strides(n, degree=8,
+                                                       seed=0)})
+                n_dirs = 5 if topo == "tree" else 16
+                name = f"{topo}-{n >> 10}k-{wlabel}"
+                row = {"n": n, "w": w, "topology": topo,
+                       "state_mb": round(state_gb * 1e3, 1)}
+                try:
+                    sim = structured_sim(topo, n, nv, **kw)
+                    rounds = discover_rounds(topo, n, nv, **kw)
+                    tr = TimedRun(sim, make_inject(n, nv), rounds)
+                    tr.prepare()
+                    tr.sample(repeats=2)
+                    dt, rounds, _state = tr.finish()
+                    row.update({
+                        "rounds": rounds,
+                        "ms_per_round": round(dt / rounds * 1e3, 3),
+                        "gbytes_per_s_lb": round(
+                            (4 + n_dirs) * state_gb * rounds / dt, 1),
+                    })
+                except Exception as e:              # noqa: BLE001
+                    msg = repr(e)
+                    row["error"] = ("OOM" if "RESOURCE_EXHAUSTED" in msg
+                                    or "out of memory" in msg.lower()
+                                    else msg[:200])
+                entries.append((name, row))
+    return {"config": "broadcast-scale-sweep",
+            "ok": any("ms_per_round" in r for _n, r in entries),
+            **{name: row for name, row in entries}}
 
 
 def config5_kafka_10k():
@@ -209,11 +364,41 @@ def config5_kafka_10k():
     sends = rounds * n_nodes * s
     kv = np.asarray(st.kv_val)
     allocated = int(np.where(kv > 0, kv - 1, 0).sum())
+    # poll-heavy read path (log.go:79-110): Q random (node, key, from)
+    # queries per batch as ONE device program (KafkaSim.poll_batch) —
+    # the host-loop poll would pay Q Python iterations per batch.
+    q = 4096
+    pn = rng.integers(0, n_nodes, q).astype(np.int32)
+    pk = rng.integers(0, n_keys, q).astype(np.int32)
+    pf = rng.integers(1, cap + 1, q).astype(np.int32)
+    import jax.numpy as jnp
+    fn = sim.poll_batch_program()
+    sim.poll_batch(st, pn, pk, pf)      # compile + warm
+    pn_d, pk_d, pf_d = (jnp.asarray(a, jnp.int32) for a in (pn, pk, pf))
+
+    @jax.jit
+    def poll_chain(prev):
+        # data dependence on the previous batch so the chained-timing
+        # methodology (timing.py) measures real sequential execution.
+        # The predicate is always-false at runtime (offsets < 2^30)
+        # but NOT provably so to XLA — a bitwise and-with-zero here
+        # would be constant-folded and sever the chain.
+        dep = jnp.where(prev[0, 0] > jnp.int32(2 ** 30),
+                        jnp.int32(1), jnp.int32(0))
+        offs, _vals = fn(st.present, st.log_vals, pn_d, pk_d,
+                         pf_d ^ dep)
+        return offs
+
+    out0 = poll_chain(jnp.zeros((1, 1), jnp.int32))
+    dt_poll = chained_time(poll_chain, out0,
+                           lambda out: np.asarray(out[:1, :1]))
     return {
         "config": "kafka-10k-keys-collective-offsets",
         "ok": bool(allocated == sends),
         "sends_per_s": int(sends / dt),
         "wall_s": round(dt, 4),
+        "polls_per_s": int(q / dt_poll),
+        "poll_batch_ms": round(dt_poll * 1e3, 3),
         "n_devices": 1 if sim.mesh is None else sim.mesh.size,
     }
 
@@ -227,8 +412,12 @@ def main() -> None:
     configs = {
         "1": config1_tree25, "2": config2_grid25_faults,
         "3": config3_counter_1k, "4": config4_epidemic_1m,
-        "4b": config4b_random_regular_1m, "5": config5_kafka_10k,
+        "4b": config4b_random_regular_1m,
+        "4c": config4c_epidemic_1m_partitioned,
+        "4d": config4d_epidemic_1m_delayed,
+        "5": config5_kafka_10k,
         "6": config6_words_axis_w128,
+        "7": config7_scale_sweep,
     }
     pick = (args.only.split(",") if args.only else list(configs))
     results = []
