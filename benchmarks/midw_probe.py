@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Mid-W tree-exchange lowering probe (ARCHITECTURE.md "known next
+lever").
+
+The words-major tree exchange measures ~45 GB/s in-stream at W=128 but
+only ~6 GB/s at W=8: the repeat/reshape/OR-reduce lowering of the
+parent/child maps retiles between (W, N) and (W, N/k, k) lane layouts,
+and the retile cost does not shrink with W.  This probe measures
+alternative XLA lowerings of the SAME exchange (verified bit-exact
+against structured.tree_exchange) at W in {8, 32}, N = 1M, k = 4:
+
+- current:      repeat + shifted reshape-fold (structured.tree_exchange)
+- stride_fold:  from_kids via 4 strided lane slices OR-ed
+                (payload[:, 1::4] | ... | payload[:, 4::4]); from_parent
+                via broadcast_to (W, P, 1) -> (W, P, 4) reshape
+- roll_fold:    from_kids via 3 lane rolls + one strided downselect
+                (z = p | roll(p,-1) | roll(p,-2) | roll(p,-3);
+                f = z[:, 1::4]); from_parent as in stride_fold
+Prints one JSON object with GB/s per variant per W (logical traffic =
+read (W, N) + write (W, N) = 2*W*N*4 bytes) and the speedup of the best
+variant over `current`.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+N = 1 << 20
+K = 4
+
+
+def variants(n: int, k: int):
+    import jax.numpy as jnp
+
+    from gossip_glomers_tpu.tpu_sim.structured import (_zeros,
+                                                       tree_exchange)
+
+    n_parents = (n - 1 + k - 1) // k
+    m = n_parents * k
+
+    def pad_to(x, width):
+        return jnp.concatenate([x, _zeros(x, width - x.shape[1])],
+                               axis=1)
+
+    def from_parent_bcast(payload):
+        # repeat via broadcast_to + reshape instead of jnp.repeat
+        w = payload.shape[0]
+        rep = jnp.broadcast_to(payload[:, :n_parents, None],
+                               (w, n_parents, k)).reshape(w, m)
+        return jnp.concatenate([_zeros(payload, 1), rep[:, :n - 1]],
+                               axis=1)
+
+    def stride_fold(payload):
+        ext = pad_to(payload, m + 1)
+        f = (ext[:, 1::k] | ext[:, 2::k] | ext[:, 3::k] | ext[:, 4::k])
+        return from_parent_bcast(payload) | pad_to(f, n)
+
+    def roll_fold(payload):
+        # pad first so the rolls' lane wraparound only pulls zeros
+        ext = pad_to(payload, n + k)
+        z = ext
+        for s in range(1, k):
+            z = z | jnp.roll(ext, -s, axis=1)
+        f = z[:, 1::k][:, :n_parents]
+        return from_parent_bcast(payload) | pad_to(f, n)
+
+    return {"current": lambda p: tree_exchange(p, k),
+            "stride_fold": stride_fold,
+            "roll_fold": roll_fold}
+
+
+def main() -> None:
+    from gossip_glomers_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from gossip_glomers_tpu.tpu_sim.structured import tree_exchange
+    from gossip_glomers_tpu.tpu_sim.timing import chained_time
+
+    import os
+
+    ws = tuple(int(x) for x in os.environ.get(
+        "GG_MIDW_W", "8,32").split(","))
+    rng = np.random.default_rng(0)
+    out: dict = {"n": N, "k": K}
+    for w in ws:
+        x0 = jnp.asarray(rng.integers(0, 1 << 32, (w, N), dtype=np.uint64)
+                         .astype(np.uint32))
+        ref = np.asarray(jax.jit(lambda p: tree_exchange(p, K))(x0))
+        row: dict = {}
+        for name, fn in variants(N, K).items():
+            jfn = jax.jit(fn)
+            got = np.asarray(jfn(x0))
+            assert (got == ref).all(), (name, w)
+            # chain: output feeds input (same shape), forcing execution
+            dt = chained_time(jfn, x0,
+                              lambda o: np.asarray(o[:1, :1]),
+                              repeats=3)
+            row[name] = {"ms": round(dt * 1e3, 3),
+                         "gbytes_per_s": round(2 * w * N * 4 / dt / 1e9,
+                                               1)}
+        out[f"w{w}"] = row
+        cur = row["current"]["ms"]
+        best = min(row, key=lambda k2: row[k2]["ms"])
+        out[f"w{w}_best"] = {"variant": best,
+                             "speedup_vs_current": round(
+                                 cur / row[best]["ms"], 2)}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

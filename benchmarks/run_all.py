@@ -259,7 +259,7 @@ def config4d_epidemic_1m_delayed():
                       state0,
                       lambda st: np.asarray(st.received[:1, :1]),
                       target_s=2.0)
-    return {
+    out = {
         "config": "broadcast-1M-epidemic-delayed-edges",
         "ok": bool(sim.converged(warm, target)),
         "rounds": rounds,
@@ -269,6 +269,41 @@ def config4d_epidemic_1m_delayed():
         "ms_per_round": round(dt / rounds * 1e3, 3),
         "msgs": int(warm.msgs),
     }
+    # Structured per-DIRECTION-CLASS delays (every +s/-s direction gets
+    # its own 1-or-3-round delay): the same latency regime Maelstrom's
+    # uniform per-hop config induces, delivered gather-free from a ring
+    # of past payloads (structured.make_delayed) — the delayed
+    # counterpart of config 4c's masked faults.
+    from gossip_glomers_tpu.parallel.mesh import pick_mesh
+    from gossip_glomers_tpu.tpu_sim.structured import (make_delayed,
+                                                       make_exchange)
+
+    dd = tuple(int(x) for x in
+               rng.choice([1, 3], size=2 * len(strides), p=[0.7, 0.3]))
+    mesh = pick_mesh()
+    sim_s = BroadcastSim(
+        nbrs, n_values=32, sync_every=1 << 20, srv_ledger=False,
+        mesh=mesh,
+        exchange=make_exchange("circulant", n, strides=strides),
+        delayed=make_delayed(
+            "circulant", n, dd, strides=strides,
+            n_shards=mesh.size if mesh is not None else None))
+    state_s, rounds_s = sim_s.run_fused(inject)
+    st0_s, target_s = sim_s.stage(inject)
+    jax.block_until_ready(st0_s.received)
+    warm_s = sim_s.run_staged_fixed(st0_s, rounds_s)
+    jax.block_until_ready(warm_s.received)
+    dt_s = chained_time(lambda st: sim_s.run_staged_fixed(st, rounds_s),
+                        st0_s,
+                        lambda st: np.asarray(st.received[:1, :1]),
+                        target_s=1.0)
+    out["structured_dir_delays"] = {
+        "ok": bool(sim_s.converged(warm_s, target_s)),
+        "rounds": rounds_s,
+        "wall_s": round(dt_s, 4),
+        "ms_per_round": round(dt_s / rounds_s * 1e3, 3),
+    }
+    return out
 
 
 def config6_words_axis_w128():
@@ -314,6 +349,15 @@ def config7_scale_sweep():
                 name = f"{topo}-{n >> 10}k-{wlabel}"
                 row = {"n": n, "w": w, "topology": topo,
                        "state_mb": round(state_gb * 1e3, 1)}
+                if 3 * state_gb > 14.0:
+                    # received + frontier + exchange temp cannot fit a
+                    # 16 GB single chip; recorded, not silently skipped
+                    # (building the multi-GB host-side inject just to
+                    # watch the device OOM thrashes host memory)
+                    row["error"] = (f"exceeds single-chip HBM: "
+                                    f"~3 x {state_gb:.1f} GB state")
+                    entries.append((name, row))
+                    continue
                 try:
                     sim = structured_sim(topo, n, nv, **kw)
                     rounds = discover_rounds(topo, n, nv, **kw)

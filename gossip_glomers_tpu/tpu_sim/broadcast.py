@@ -367,6 +367,7 @@ def _round_wm(state: BroadcastState, *, deg: jnp.ndarray, sync_every: int,
               = lambda x: x,
               live_rows: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
               deg_slice: Callable[[jnp.ndarray], jnp.ndarray] = lambda x: x,
+              delayed_exchange: Callable | None = None,
               ) -> BroadcastState:
     """Words-major round for structured topologies: state is (W, N) so
     the node axis packs TPU lanes densely (the node-major layout wastes
@@ -415,6 +416,19 @@ def _round_wm(state: BroadcastState, *, deg: jnp.ndarray, sync_every: int,
                 else sync_diff(state.received, live))
         srv = state.srv_msgs + reduce_sum(
             flood + jnp.where(is_sync, base + 2 * diff, jnp.uint32(0)))
+    if delayed_exchange is not None:
+        # per-direction-class delays: push this round's payload into
+        # the ring of past LOCAL payload blocks and deliver each
+        # direction from its class's slice (structured.make_delayed)
+        ring = state.history.shape[0]
+        history = lax.dynamic_update_index_in_dim(
+            state.history, payload, state.t % ring, axis=0)
+        inbox = delayed_exchange(history, state.t)
+        new = inbox & ~state.received
+        return BroadcastState(received=state.received | new,
+                              frontier=new, t=state.t + 1,
+                              msgs=state.msgs + sent, history=history,
+                              srv_msgs=srv)
     inbox = local_slice(exchange(payload_full) if live is None
                         else exchange(payload_full, live))
     new = inbox & ~state.received
@@ -462,6 +476,7 @@ class BroadcastSim:
                  delays: np.ndarray | None = None,
                  srv_ledger: bool = True,
                  faulted=None,
+                 delayed=None,
                  ) -> None:
         """``srv_ledger``: keep the reference-accounted server-message
         ledger (default).  It costs a second adjacency pass per round
@@ -479,7 +494,16 @@ class BroadcastSim:
         on the words-major path — per-direction receiver-side liveness
         masks precomputed per window on the host, applied by the
         masked exchange/diff closures each round (Maelstrom's nemesis
-        at any scale without falling back to the gather path)."""
+        at any scale without falling back to the gather path).
+
+        ``delayed`` (structured.StructuredDelays, from
+        structured.make_delayed): per-direction-class delays on the
+        words-major path — each direction delivers from a ring of past
+        payload blocks at structured speed (Maelstrom's uniform
+        per-hop latency at any scale; per-edge-random delays stay on
+        the gather path via ``delays``).  Not composable with
+        ``parts``/``faulted`` or ``delays``; the srv ledger is off in
+        this mode (the value-message ledger stays exact)."""
         n = nbrs.shape[0]
         self.n_nodes = n
         self.n_values = n_values
@@ -498,6 +522,22 @@ class BroadcastSim:
         self.sync_diff = sync_diff
         self.sharded_sync_diff = sharded_sync_diff
         n_windows = int(self.parts.starts.shape[0])
+        self._delayed = delayed
+        if delayed is not None:
+            if not self.words_major:
+                raise ValueError("delayed needs a structured exchange")
+            if delays is not None:
+                raise ValueError(
+                    "per-edge `delays` and per-direction `delayed` are "
+                    "mutually exclusive")
+            if n_windows > 0 or faulted is not None:
+                raise ValueError(
+                    "delayed structured delivery does not compose with "
+                    "partition schedules yet; use the gather path")
+            if mesh is not None and delayed.sharded_exchange is None:
+                raise ValueError(
+                    "delayed structured delivery on a mesh needs the "
+                    "halo closure (no all_gather fallback)")
         self._faulted = faulted if (self.words_major
                                     and n_windows > 0) else None
         if self.words_major and n_windows > 0 and faulted is None:
@@ -514,7 +554,9 @@ class BroadcastSim:
                     f"vs {n_windows} windows x {n} nodes")
         # the words-major ledger needs a structured per-edge diff: the
         # single-device closure off-mesh, the halo closure on-mesh
-        if self._faulted is not None:
+        if self._delayed is not None:
+            self._srv_on = False
+        elif self._faulted is not None:
             f = self._faulted
             self._srv_on = srv_ledger and (
                 f.sync_diff is not None if mesh is None
@@ -536,7 +578,10 @@ class BroadcastSim:
                 raise ValueError("edge delays are rounds >= 1")
         self.delays = (None if delays is None
                        else jnp.asarray(delays, jnp.int32))
-        self.ring = 1 if delays is None else int(delays.max())
+        if delayed is not None:
+            self.ring = delayed.ring
+        else:
+            self.ring = 1 if delays is None else int(delays.max())
         # distinct delay values, static: delivery runs one masked
         # gather per value, which is what lets the history ring stay
         # node-sharded (one all_gather per value per round instead of a
@@ -615,7 +660,17 @@ class BroadcastSim:
             received = jax.device_put(
                 received, NamedSharding(self.mesh, self._state_spec))
         history = None
-        if self.delays is not None:
+        if self._delayed is not None:
+            # words-major ring of past LOCAL payload blocks (L, W, N),
+            # node-sharded like the state
+            history = jnp.zeros(
+                (self.ring, self.n_words, self.n_nodes), jnp.uint32)
+            if self.mesh is not None:
+                history = jax.device_put(
+                    history,
+                    NamedSharding(self.mesh,
+                                  P(None, *self._state_spec)))
+        elif self.delays is not None:
             # ring of past LOCAL payload blocks, node-SHARDED: each
             # shard stores only its own rows' history (O(L·N/shards)
             # per device); delivery widens the per-delay-value slices
@@ -712,6 +767,13 @@ class BroadcastSim:
         else:
             sync_base_once = lambda b: b  # noqa: E731
         f = self._faulted
+        if self._delayed is not None:
+            # halo-only (constructor enforces sharded_exchange)
+            return _round_wm(
+                state, deg=deg, sync_every=self.sync_every,
+                exchange=self.exchange,
+                reduce_sum=lambda s: lax.psum(s, mesh_axes),
+                delayed_exchange=self._delayed.sharded_exchange)
         if masks is not None:
             live_rows = self._live_rows(*masks)
         else:
@@ -744,8 +806,9 @@ class BroadcastSim:
 
     def _specs(self):
         state_spec = self._state_spec
-        hist_spec = (None if self.delays is None
-                     else P(None, *state_spec))  # node-sharded ring
+        hist_spec = (P(None, *state_spec)       # node-sharded ring
+                     if (self.delays is not None
+                         or self._delayed is not None) else None)
         srv_spec = P() if self._srv_on else None
         return (BroadcastState(state_spec, state_spec, P(), P(),
                                hist_spec, srv_spec),
@@ -753,11 +816,17 @@ class BroadcastSim:
 
     def _wm_round_single(self, state: BroadcastState, deg,
                          masks=None) -> BroadcastState:
-        """Single-device words-major round, faulted or not.  ``deg``
-        and the fault ``masks`` arrive as traced jit arguments (like
-        the shard_map path's explicit args) so the big per-node arrays
-        are not baked into every traced program as constants."""
+        """Single-device words-major round — plain, faulted, or
+        delayed.  ``deg`` and the fault ``masks`` arrive as traced jit
+        arguments (like the shard_map path's explicit args) so the big
+        per-node arrays are not baked into every traced program as
+        constants."""
         f = self._faulted
+        if self._delayed is not None:
+            return _round_wm(state, deg=deg,
+                             sync_every=self.sync_every,
+                             exchange=self.exchange,
+                             delayed_exchange=self._delayed.exchange)
         if masks is None:
             return _round_wm(state, deg=deg,
                              sync_every=self.sync_every,
@@ -1007,7 +1076,7 @@ class BroadcastSim:
         # test_run_staged_fixed_matches_while_runner and
         # test_fixed_flood_specialization_matches_while_runner.
         flood_ok = (wm and not self._srv_on and self.delays is None
-                    and self._faulted is None
+                    and self._faulted is None and self._delayed is None
                     and rounds <= sync_every and rounds > 0)
 
         if self.mesh is None and flood_ok:

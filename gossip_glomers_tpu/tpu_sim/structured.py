@@ -45,42 +45,88 @@ def _zeros(payload: jnp.ndarray, n: int) -> jnp.ndarray:
     return jnp.zeros(payload.shape[:-1] + (n,), payload.dtype)
 
 
+def tree_from_parent(payload: jnp.ndarray,
+                     branching: int = 4) -> jnp.ndarray:
+    """inbox[:, i] = payload[:, (i-1)//k] for i >= 1 (zeros at the
+    root) — the parent->child half of :func:`tree_exchange`."""
+    w, n = payload.shape
+    k = branching
+    n_parents = (n - 1 + k - 1) // k
+    fp = jnp.repeat(payload[:, :n_parents], k, axis=1)[:, :n - 1]
+    return jnp.concatenate([_zeros(payload, 1), fp], axis=1)
+
+
+def tree_from_kids(payload: jnp.ndarray,
+                   branching: int = 4) -> jnp.ndarray:
+    """inbox[:, p] = OR payload[:, kp+1 .. kp+k] — the child->parent
+    half of :func:`tree_exchange`.
+
+    Two lowerings, picked by the MEASURED W-crossover
+    (benchmarks/midw_probe.py, 1M nodes, real chip): the
+    reshape-fold's (W, N) <-> (W, N/k, k) retile cost is flat in W, so
+    at mid W a lane-roll fold (k-1 rolls + one strided downselect) is
+    faster — 1.86x at W=8, 1.53x at W=16 — while at W <= 4 the
+    VMEM-resident reshape-fold wins (roll_fold 4.5x slower at W=1) and
+    at W >= 32 the rolls' physical data movement overtakes it again
+    (1.8x slower).  Both lowerings are bit-identical."""
+    w, n = payload.shape
+    k = branching
+    n_parents = (n - 1 + k - 1) // k
+    m = n_parents * k
+    if 8 <= w <= 16:
+        # pad first so the rolls' lane wraparound only pulls zeros
+        ext = jnp.concatenate([payload, _zeros(payload, k)], axis=1)
+        z = ext
+        for s in range(1, k):
+            z = z | jnp.roll(ext, -s, axis=1)
+        fk = z[:, 1::k][:, :n_parents]
+    else:
+        kids = jnp.concatenate([payload[:, 1:],
+                                _zeros(payload, m - (n - 1))], axis=1)
+        fk = jnp.bitwise_or.reduce(kids.reshape(w, n_parents, k),
+                                   axis=2)
+    return jnp.concatenate([fk, _zeros(payload, n - n_parents)], axis=1)
+
+
 def tree_exchange(payload: jnp.ndarray, branching: int = 4) -> jnp.ndarray:
     """inbox for the k-ary tree of parallel/topology.py::tree — i's
     neighbors are parent (i-1)//k and children ki+1..ki+k."""
-    w, n = payload.shape
-    k = branching
-    if n == 1:
+    if payload.shape[1] == 1:
         return jnp.zeros_like(payload)
-    # from parent: inbox[:, i] |= payload[:, (i-1)//k] for i >= 1
-    n_parents = (n - 1 + k - 1) // k
-    from_parent = jnp.repeat(payload[:, :n_parents], k, axis=1)[:, :n - 1]
-    from_parent = jnp.concatenate([_zeros(payload, 1), from_parent], axis=1)
-    # from children: inbox[:, p] |= OR payload[:, kp+1 .. kp+k]
-    m = n_parents * k
-    kids = jnp.concatenate([payload[:, 1:],
-                            _zeros(payload, m - (n - 1))], axis=1)
-    from_kids = jnp.bitwise_or.reduce(
-        kids.reshape(w, n_parents, k), axis=2)
-    from_kids = jnp.concatenate(
-        [from_kids, _zeros(payload, n - n_parents)], axis=1)
-    return from_parent | from_kids
+    return (tree_from_parent(payload, branching)
+            | tree_from_kids(payload, branching))
 
 
-def grid_exchange(payload: jnp.ndarray, cols: int) -> jnp.ndarray:
-    """inbox for the 2D grid of parallel/topology.py::grid — width
-    ``cols``, neighbors up/down/left/right, last row possibly ragged."""
-    w, n = payload.shape
+def grid_terms(pu: jnp.ndarray, pd: jnp.ndarray, pl: jnp.ndarray,
+               pr: jnp.ndarray, cols: int) -> jnp.ndarray:
+    """Grid delivery from per-DIRECTION source payloads (all equal for
+    the plain exchange; per-delay-class slices for the delayed one):
+    up/down are ±cols shifts, left/right ±1 shifts with the ragged-row
+    wrap masks."""
+    w, n = pu.shape
     c = min(cols, n)
-    up = jnp.concatenate([payload[:, cols:], _zeros(payload, c)], axis=1)
-    down = jnp.concatenate([_zeros(payload, c), payload[:, :n - c]], axis=1)
-    left = jnp.concatenate([payload[:, 1:], _zeros(payload, 1)], axis=1)
-    right = jnp.concatenate([_zeros(payload, 1), payload[:, :-1]], axis=1)
+    up = jnp.concatenate([pu[:, c:], _zeros(pu, c)], axis=1)
+    down = jnp.concatenate([_zeros(pd, c), pd[:, :n - c]], axis=1)
+    left = jnp.concatenate([pl[:, 1:], _zeros(pl, 1)], axis=1)
+    right = jnp.concatenate([_zeros(pr, 1), pr[:, :-1]], axis=1)
     # column masks kill the row wrap-around of the left/right shifts
     col_idx = jnp.arange(n, dtype=jnp.int32) % cols
     left = jnp.where((col_idx < cols - 1)[None, :], left, 0)
     right = jnp.where((col_idx > 0)[None, :], right, 0)
     return up | down | left | right
+
+
+def grid_exchange(payload: jnp.ndarray, cols: int) -> jnp.ndarray:
+    """inbox for the 2D grid of parallel/topology.py::grid — width
+    ``cols``, neighbors up/down/left/right, last row possibly ragged."""
+    return grid_terms(payload, payload, payload, payload, cols)
+
+
+def line_terms(pf: jnp.ndarray, pb: jnp.ndarray) -> jnp.ndarray:
+    """Line delivery from per-direction source payloads."""
+    fwd = jnp.concatenate([pf[:, 1:], _zeros(pf, 1)], axis=1)
+    bwd = jnp.concatenate([_zeros(pb, 1), pb[:, :-1]], axis=1)
+    return fwd | bwd
 
 
 def ring_exchange(payload: jnp.ndarray) -> jnp.ndarray:
@@ -103,9 +149,7 @@ def circulant_exchange(payload: jnp.ndarray,
 
 def line_exchange(payload: jnp.ndarray) -> jnp.ndarray:
     """inbox for parallel/topology.py::line."""
-    fwd = jnp.concatenate([payload[:, 1:], _zeros(payload, 1)], axis=1)
-    bwd = jnp.concatenate([_zeros(payload, 1), payload[:, :-1]], axis=1)
-    return fwd | bwd
+    return line_terms(payload, payload)
 
 
 def sharded_roll(x_local: jnp.ndarray, s: int, n: int, n_shards: int,
@@ -856,3 +900,215 @@ def make_faulted(topology: str, n: int, groups: np.ndarray,
                 return _dir_diff(fwd, r, lv[0])
 
     return StructuredFaults(exists, same, ex, df, sex, sdf)
+
+
+# -- per-direction delay classes on the structured path -----------------
+#
+# Maelstrom's injected latency (reference README.md:16: 100 ms per hop)
+# is per-EDGE; on the structured path a delay is per direction CLASS
+# (every +s edge of a circulant, the parent->child direction of the
+# tree, ...): direction d delivers the payload flooded delta_d rounds
+# ago, read from a ring of past payloads.  That covers the uniform and
+# per-direction latency configurations at full structured speed — the
+# per-edge-RANDOM delay regime stays on the gather path
+# (broadcast._gather_or_delayed), whose ring is node-sharded too.
+#
+# Direction-class order (the contract shared with gather_delays_for):
+# tree(k): (parent->child, child->parent); grid: (up, down, left,
+# right) receiver-side like the fault rows; ring/line: (fwd, bwd) =
+# receiver i <- i+1, i <- i-1; circulant: (+s0, -s0, +s1, ...).
+
+
+class StructuredDelays(NamedTuple):
+    """Delayed structured delivery bundle (from :func:`make_delayed`).
+
+    - ``dir_delays``: per-direction-class delays in rounds (>= 1).
+    - ``ring``: history ring length == max delay.
+    - ``exchange(history, t)``: full-axis closure over the (L, W, N)
+      ring of past payloads -> (W, N) inbox.
+    - ``sharded_exchange``: halo-path closure over the LOCAL (L, W,
+      block) ring (None when no halo decomposition exists; there is no
+      all_gather fallback — use the gather delayed path then)."""
+
+    dir_delays: tuple
+    ring: int
+    exchange: Callable
+    sharded_exchange: Callable | None
+
+
+def gather_delays_for(topology: str, n: int, dir_delays, nbrs,
+                      **kw) -> np.ndarray:
+    """The (N, D_adj) per-edge delays array (for broadcast's gather
+    path) equivalent to per-direction-class ``dir_delays`` — the bridge
+    the equivalence tests and mixed-path runs use.  Pad slots get 1.
+
+    Raises when two direction classes alias the same physical edge
+    with different delays (e.g. a circulant stride with 2s ≡ 0 mod n,
+    where +s and -s are one edge): no per-edge array can represent
+    that, so the bridge contract would silently break."""
+    snd = fault_dir_senders(topology, n, **kw)
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        if len(dir_delays) != 2:
+            raise ValueError("tree takes (down, up) delays")
+        row_delays = [dir_delays[0]] + [dir_delays[1]] * k
+    else:
+        row_delays = list(dir_delays)
+    if len(row_delays) != snd.shape[0]:
+        raise ValueError(
+            f"{topology} takes {snd.shape[0]} direction delays, got "
+            f"{len(dir_delays)}")
+    nbrs = np.asarray(nbrs)
+    out = np.ones(nbrs.shape, np.int32)
+    assigned = np.zeros(nbrs.shape, bool)
+    for d, delay in enumerate(row_delays):
+        s = snd[d]
+        mask = (nbrs == s[:, None]) & (s[:, None] >= 0)
+        clash = assigned & mask & (out != np.int32(delay))
+        if clash.any():
+            raise ValueError(
+                "direction classes alias the same edge with different "
+                f"delays (direction row {d}); per-edge delays cannot "
+                "represent this")
+        out = np.where(mask, np.int32(delay), out)
+        assigned |= mask
+    return out
+
+
+def _take_delayed(hist: jnp.ndarray, t: jnp.ndarray, delay: int,
+                  ring: int) -> jnp.ndarray:
+    """The payload flooded ``delay-1`` rounds before t (zeros before
+    round delay-1: nothing was in flight yet)."""
+    src_t = t - (delay - 1)
+    sl = lax.dynamic_index_in_dim(hist, src_t % ring, axis=0,
+                                  keepdims=False)
+    return jnp.where(src_t >= 0, sl, jnp.zeros_like(sl))
+
+
+def has_sharded_exchange(topology: str, n: int, n_shards: int | None,
+                         axis_name: str = "nodes", **kw) -> bool:
+    """Whether the topology/shape has a halo decomposition — the ONE
+    availability predicate behind every halo-gated builder."""
+    return (n_shards is not None
+            and make_sharded_exchange(topology, n, n_shards,
+                                      axis_name=axis_name,
+                                      **kw) is not None)
+
+
+def make_delayed(topology: str, n: int, dir_delays,
+                 n_shards: int | None = None, axis_name: str = "nodes",
+                 **kw) -> StructuredDelays | None:
+    """Build the :class:`StructuredDelays` bundle.  ``dir_delays``
+    length: tree 2, grid 4, ring/line 2, circulant 2*len(strides).
+    None for unstructured topologies.
+
+    Aliasing note: if two direction classes are the same physical edge
+    (a circulant stride with 2s ≡ 0 mod n), the structured delivery
+    ORs both classes — the edge effectively carries BOTH delays.  The
+    gather bridge (:func:`gather_delays_for`) cannot represent that
+    and raises instead."""
+    dd = tuple(int(x) for x in dir_delays)
+    if any(d < 1 for d in dd):
+        raise ValueError("direction delays are rounds >= 1")
+    ring = max(dd)
+    halo = has_sharded_exchange(topology, n, n_shards,
+                                axis_name=axis_name, **kw)
+
+    def take(hist, t, d):
+        return _take_delayed(hist, t, dd[d], ring)
+
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        if len(dd) != 2:
+            raise ValueError("tree takes (down, up) delays")
+
+        def ex(hist, t):
+            return (tree_from_parent(take(hist, t, 0), k)
+                    | tree_from_kids(take(hist, t, 1), k))
+
+        sex = None
+        if halo:
+            def sex(hist, t):
+                return (tree_parent_payload(take(hist, t, 0), n,
+                                            n_shards, k, axis_name)
+                        | tree_kids_payload(take(hist, t, 1), n,
+                                            n_shards, k, axis_name))
+
+        return StructuredDelays(dd, ring, ex, sex)
+
+    if topology in ("ring", "circulant"):
+        strides = [1] if topology == "ring" else list(kw["strides"])
+        if len(dd) != 2 * len(strides):
+            raise ValueError("circulant takes (+s, -s) delays per stride")
+
+        def ex(hist, t):
+            out = None
+            for i, s in enumerate(strides):
+                term = (jnp.roll(take(hist, t, 2 * i), s, axis=1)
+                        | jnp.roll(take(hist, t, 2 * i + 1), -s,
+                                   axis=1))
+                out = term if out is None else out | term
+            return out
+
+        sex = None
+        if n_shards is not None and n % n_shards == 0:
+            def sex(hist, t):
+                out = None
+                for i, s in enumerate(strides):
+                    term = (sharded_roll(take(hist, t, 2 * i), s, n,
+                                         n_shards, axis_name)
+                            | sharded_roll(take(hist, t, 2 * i + 1),
+                                           -s, n, n_shards, axis_name))
+                    out = term if out is None else out | term
+                return out
+
+        return StructuredDelays(dd, ring, ex, sex)
+
+    if topology == "grid":
+        cols = kw.get("cols") or grid_cols(n)
+        if len(dd) != 4:
+            raise ValueError("grid takes (up, down, left, right) delays")
+
+        def ex(hist, t):
+            return grid_terms(*(take(hist, t, d) for d in range(4)),
+                              cols)
+
+        sex = None
+        if halo:
+            def sex(hist, t):
+                block = hist.shape[2]
+                up = sharded_shift(take(hist, t, 0), cols, n_shards,
+                                   axis_name)
+                down = sharded_shift(take(hist, t, 1), -cols, n_shards,
+                                     axis_name)
+                lf = sharded_shift(take(hist, t, 2), 1, n_shards,
+                                   axis_name)
+                rt = sharded_shift(take(hist, t, 3), -1, n_shards,
+                                   axis_name)
+                start = jax.lax.axis_index(axis_name) * block
+                col_idx = (start + jnp.arange(block, dtype=jnp.int32)) \
+                    % cols
+                lf = jnp.where((col_idx < cols - 1)[None, :], lf, 0)
+                rt = jnp.where((col_idx > 0)[None, :], rt, 0)
+                return up | down | lf | rt
+
+        return StructuredDelays(dd, ring, ex, sex)
+
+    if topology == "line":
+        if len(dd) != 2:
+            raise ValueError("line takes (fwd, bwd) delays")
+
+        def ex(hist, t):
+            return line_terms(take(hist, t, 0), take(hist, t, 1))
+
+        sex = None
+        if halo:
+            def sex(hist, t):
+                return (sharded_shift(take(hist, t, 0), 1, n_shards,
+                                      axis_name)
+                        | sharded_shift(take(hist, t, 1), -1, n_shards,
+                                        axis_name))
+
+        return StructuredDelays(dd, ring, ex, sex)
+
+    return None

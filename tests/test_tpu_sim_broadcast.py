@@ -1117,3 +1117,153 @@ def test_faulted_structured_converges_only_after_heal():
     state, rounds = sim.run(inject)
     assert rounds > 10
     assert converged_reads(sim, state, nv)
+
+
+# -- per-direction delay classes on the structured path -----------------
+
+
+def test_delayed_structured_matches_gather_all_topologies():
+    # the delayed structured delivery must equal the gather path run
+    # with the equivalent per-edge delays array (gather_delays_for):
+    # received, msgs, and rounds — for uniform and asymmetric
+    # per-direction delays
+    from gossip_glomers_tpu.parallel.topology import circulant, ring
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    cases = [("tree", 64, {}, [(2, 2), (1, 3)]),
+             ("grid", 64, {}, [(2, 2, 2, 2), (1, 2, 3, 1)]),
+             ("ring", 32, {}, [(2, 2), (3, 1)]),
+             ("line", 32, {}, [(2, 2), (1, 2)]),
+             ("circulant", 64, {"strides": [1, 5, 21]},
+              [(2,) * 6, (1, 2, 3, 1, 2, 3)])]
+    builders = {"ring": lambda n, kw: to_padded_neighbors(ring(n)),
+                "circulant": lambda n, kw: circulant(n, kw["strides"]),
+                "tree": lambda n, kw: to_padded_neighbors(tree(n)),
+                "grid": lambda n, kw: to_padded_neighbors(grid(n)),
+                "line": lambda n, kw: to_padded_neighbors(line(n))}
+    for topo, n, kw, delay_cases in cases:
+        nbrs = builders[topo](n, kw)
+        nv = min(n, 48)
+        inject = make_inject(n, nv)
+        for dd in delay_cases:
+            gd = structured.gather_delays_for(topo, n, dd, nbrs, **kw)
+            ref = BroadcastSim(nbrs, n_values=nv, sync_every=6,
+                               delays=gd, srv_ledger=False)
+            s1, r1 = ref.run(inject)
+            fast = BroadcastSim(
+                nbrs, n_values=nv, sync_every=6, srv_ledger=False,
+                exchange=structured.make_exchange(topo, n, **kw),
+                delayed=structured.make_delayed(topo, n, dd, **kw))
+            s2, r2 = fast.run(inject)
+            assert r1 == r2, (topo, n, dd)
+            assert (ref.received_node_major(s1)
+                    == fast.received_node_major(s2)).all(), (topo, dd)
+            assert int(s1.msgs) == int(s2.msgs), (topo, dd)
+
+
+def test_delayed_structured_sharded_matches_single_device():
+    from gossip_glomers_tpu.parallel.topology import circulant
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    cases = [("tree", 64, {}, (1, 3)),
+             ("circulant", 128, {"strides": [1, 5, 33]},
+              (2, 1, 3, 2, 1, 3)),
+             ("grid", 256, {}, (2, 1, 2, 1)),
+             ("line", 64, {}, (3, 2))]
+    builders = {"circulant": lambda n, kw: circulant(n, kw["strides"]),
+                "tree": lambda n, kw: to_padded_neighbors(tree(n)),
+                "grid": lambda n, kw: to_padded_neighbors(grid(n)),
+                "line": lambda n, kw: to_padded_neighbors(line(n))}
+    for topo, n, kw, dd in cases:
+        nbrs = builders[topo](n, kw)
+        nv = 48
+        inject = make_inject(n, nv)
+        ref = BroadcastSim(
+            nbrs, n_values=nv, sync_every=6, srv_ledger=False,
+            exchange=structured.make_exchange(topo, n, **kw),
+            delayed=structured.make_delayed(topo, n, dd, **kw))
+        s1, r1 = ref.run(inject)
+        for mesh, pdim in ((mesh_1d(), 8), (mesh_2d(), 4)):
+            dl = structured.make_delayed(topo, n, dd, n_shards=pdim,
+                                         **kw)
+            assert dl.sharded_exchange is not None, (topo, n)
+            sim = BroadcastSim(
+                nbrs, n_values=nv, sync_every=6, srv_ledger=False,
+                mesh=mesh,
+                exchange=structured.make_exchange(topo, n, **kw),
+                delayed=dl)
+            st0 = sim.init_state(inject)
+            ring_shape = st0.history.sharding.shard_shape(
+                st0.history.shape)
+            w_local = (sim.n_words // 2 if "words" in mesh.axis_names
+                       else sim.n_words)
+            assert ring_shape == (sim.ring, w_local, n // pdim)
+            s2, r2 = sim.run(inject)
+            assert r1 == r2, (topo, mesh.axis_names)
+            assert (ref.received_node_major(s1)
+                    == sim.received_node_major(s2)).all()
+            assert int(s1.msgs) == int(s2.msgs)
+            s3, r3 = sim.run_fused(inject)
+            assert r1 == r3
+            st0b, _tg = sim.stage(inject)
+            s4 = sim.run_staged_fixed(st0b, r1)
+            assert (ref.received_node_major(s1)
+                    == sim.received_node_major(s4)).all()
+
+
+def test_delayed_structured_uniform_scales_eccentricity():
+    # line with delay 3 in both directions: end-to-end takes 3*(n-1)
+    # rounds, like the gather path's uniform-delay test
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    n = 6
+    nbrs = to_padded_neighbors(line(n))
+    sim = BroadcastSim(
+        nbrs, n_values=1, sync_every=1 << 20, srv_ledger=False,
+        exchange=structured.make_exchange("line", n),
+        delayed=structured.make_delayed("line", n, (3, 3)))
+    state, rounds = sim.run(make_inject(n, 1, origins=np.array([0])))
+    assert rounds == 3 * (n - 1)
+    assert all(sorted(r) == [0] for r in sim.read(state))
+
+
+def test_tree_exchange_midw_roll_lowering_matches_gather():
+    # the W-gated roll-fold lowering (tree_from_kids, 8 <= W <= 16)
+    # must stay bit-identical to the gather path — cover both sides of
+    # the gate and the boundary widths
+    from gossip_glomers_tpu.tpu_sim.structured import make_exchange
+
+    n = 85                              # ragged last level
+    nbrs = to_padded_neighbors(tree(n))
+    for nv in (224, 256, 512, 544, 1024):   # W = 7, 8, 16, 17, 32
+        inject = make_inject(n, nv)
+        ref = BroadcastSim(nbrs, n_values=nv, sync_every=4)
+        fast = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                            exchange=make_exchange("tree", n))
+        s1, r1 = ref.run(inject)
+        s2, r2 = fast.run(inject)
+        assert r1 == r2, nv
+        assert (ref.received_node_major(s1)
+                == fast.received_node_major(s2)).all(), nv
+        assert int(s1.msgs) == int(s2.msgs), nv
+
+
+def test_gather_delays_bridge_rejects_aliased_directions():
+    # a circulant stride with 2s == 0 (mod n): +s and -s are ONE edge;
+    # no per-edge array can carry two different delays for it
+    from gossip_glomers_tpu.parallel.topology import circulant
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    n, strides = 8, [4]
+    nbrs = circulant(n, strides)
+    with pytest.raises(ValueError, match="alias"):
+        structured.gather_delays_for("circulant", n, (1, 3), nbrs,
+                                     strides=strides)
+    # equal delays on the aliased pair are representable
+    gd = structured.gather_delays_for("circulant", n, (2, 2), nbrs,
+                                      strides=strides)
+    assert (gd == 2).all()
+    # wrong-length dir_delays raise instead of silently truncating
+    tn = to_padded_neighbors(tree(16))
+    with pytest.raises(ValueError, match="tree takes"):
+        structured.gather_delays_for("tree", 16, (1, 2, 3), tn)
