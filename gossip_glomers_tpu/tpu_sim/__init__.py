@@ -32,12 +32,16 @@ from .broadcast import (BroadcastSim, BroadcastState, Partitions,
 from .counter import CounterSim, CounterState, KVReach
 from .echo import EchoSim, EchoState
 from .kafka import KafkaSim, KafkaState
-from .structured import StructuredFaults, make_faulted
+from .structured import (FaultedDelayed, StructuredDelays,
+                         StructuredFaults, make_delayed,
+                         make_delayed_faulted, make_faulted)
 from .unique_ids import UniqueIdsSim, UniqueIdsState
 
 __all__ = ["BroadcastSim", "BroadcastState", "Partitions", "make_inject",
            "CounterSim", "CounterState", "KVReach",
            "KafkaSim", "KafkaState",
            "StructuredFaults", "make_faulted",
+           "StructuredDelays", "make_delayed",
+           "FaultedDelayed", "make_delayed_faulted",
            "UniqueIdsSim", "UniqueIdsState",
            "EchoSim", "EchoState"]

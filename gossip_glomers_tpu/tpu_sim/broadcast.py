@@ -218,14 +218,18 @@ def _degree_masks(np_deg: np.ndarray):
 def _flood_loop(exchange, rounds: int):
     """Pure exchange+merge fori_loop body over (received, frontier) —
     the timed benchmark program (no bookkeeping: in-loop reduces and
-    selects defeat XLA's loop fusion)."""
+    selects defeat XLA's loop fusion).  unroll=2: measured up to ~15%
+    faster in one session at 1M nodes / W=1 and parity in another
+    (within tunnel-session variance) — kept because it never measured
+    slower; higher unrolls did."""
     def loop(rec, fr):
         def one(i, c):
             rec, fr = c
             new = exchange(fr) & ~rec
             return (rec | new, new)
 
-        return lax.fori_loop(0, rounds, one, (rec, fr))
+        return lax.fori_loop(0, rounds, one, (rec, fr),
+                             unroll=2 if rounds > 1 else 1)
 
     return loop
 
@@ -496,14 +500,17 @@ class BroadcastSim:
         masked exchange/diff closures each round (Maelstrom's nemesis
         at any scale without falling back to the gather path).
 
-        ``delayed`` (structured.StructuredDelays, from
-        structured.make_delayed): per-direction-class delays on the
-        words-major path — each direction delivers from a ring of past
-        payload blocks at structured speed (Maelstrom's uniform
-        per-hop latency at any scale; per-edge-random delays stay on
-        the gather path via ``delays``).  Not composable with
-        ``parts``/``faulted`` or ``delays``; the srv ledger is off in
-        this mode (the value-message ledger stays exact)."""
+        ``delayed``: per-direction-class delays on the words-major
+        path — each direction delivers from a ring of past payload
+        blocks at structured speed (Maelstrom's uniform per-hop
+        latency at any scale; per-edge-random delays stay on the
+        gather path via ``delays``).  Pass a
+        structured.StructuredDelays (make_delayed) for the fault-free
+        case, or a structured.FaultedDelayed (make_delayed_faulted) to
+        COMPOSE delays with a partition schedule — the bundle carries
+        its own masks, so do not also pass ``faulted``.  The srv
+        ledger is off in both delayed modes (the value-message ledger
+        stays exact)."""
         n = nbrs.shape[0]
         self.n_nodes = n
         self.n_values = n_values
@@ -523,6 +530,9 @@ class BroadcastSim:
         self.sharded_sync_diff = sharded_sync_diff
         n_windows = int(self.parts.starts.shape[0])
         self._delayed = delayed
+        # composed mode: a FaultedDelayed bundle carries its own masks
+        # (delays AND partition windows on the structured path)
+        self._df = delayed is not None and hasattr(delayed, "same")
         if delayed is not None:
             if not self.words_major:
                 raise ValueError("delayed needs a structured exchange")
@@ -530,17 +540,34 @@ class BroadcastSim:
                 raise ValueError(
                     "per-edge `delays` and per-direction `delayed` are "
                     "mutually exclusive")
-            if n_windows > 0 or faulted is not None:
+            if self._df:
+                if faulted is not None:
+                    raise ValueError(
+                        "pass EITHER faulted= or a FaultedDelayed "
+                        "bundle — the bundle carries its own masks")
+                if n_windows == 0:
+                    raise ValueError(
+                        "FaultedDelayed needs a partition schedule; "
+                        "use make_delayed for the fault-free case")
+                if delayed.same.shape[0] != n_windows \
+                        or delayed.same.shape[-1] != n:
+                    raise ValueError(
+                        "FaultedDelayed masks do not match the "
+                        "partition schedule")
+            elif n_windows > 0 or faulted is not None:
                 raise ValueError(
-                    "delayed structured delivery does not compose with "
-                    "partition schedules yet; use the gather path")
+                    "composing delays with partitions on the "
+                    "structured path needs a FaultedDelayed bundle "
+                    "(structured.make_delayed_faulted)")
             if mesh is not None and delayed.sharded_exchange is None:
                 raise ValueError(
                     "delayed structured delivery on a mesh needs the "
                     "halo closure (no all_gather fallback)")
         self._faulted = faulted if (self.words_major
-                                    and n_windows > 0) else None
-        if self.words_major and n_windows > 0 and faulted is None:
+                                    and n_windows > 0
+                                    and not self._df) else None
+        if (self.words_major and n_windows > 0 and faulted is None
+                and not self._df):
             raise ValueError(
                 "a words-major structured run under a partition "
                 "schedule needs the masked closures: pass "
@@ -618,14 +645,16 @@ class BroadcastSim:
             self.deg = (jax.device_put(jnp.asarray(deg),
                                        NamedSharding(mesh, P("nodes")))
                         if mesh is not None else jnp.asarray(deg))
-            if self._faulted is not None:
-                ex = jnp.asarray(self._faulted.exists)
-                sm = jnp.asarray(self._faulted.same)
+            masked_src = (self._faulted if self._faulted is not None
+                          else self._delayed if self._df else None)
+            if masked_src is not None:
+                ex = jnp.asarray(masked_src.exists)
+                sm = jnp.asarray(masked_src.same)
                 if mesh is not None:
                     # halo mode: receiver-side rows shard with the node
                     # axis; all_gather fallback: replicated (the full-
                     # axis masked exchange needs full-axis masks)
-                    if self._faulted.sharded_exchange is not None:
+                    if masked_src.sharded_exchange is not None:
                         e_spec = P(None, "nodes")
                         s_spec = P(None, None, "nodes")
                     else:
@@ -769,6 +798,15 @@ class BroadcastSim:
         f = self._faulted
         if self._delayed is not None:
             # halo-only (constructor enforces sharded_exchange)
+            if masks is not None:      # composed faulted-delayed mode
+                lr = self._live_rows(*masks)
+                dex = self._delayed.sharded_exchange
+                return _round_wm(
+                    state, deg=deg, sync_every=self.sync_every,
+                    exchange=self.exchange,
+                    reduce_sum=lambda s: lax.psum(s, mesh_axes),
+                    live_rows=lr,
+                    delayed_exchange=lambda h, t: dex(h, t, lr))
             return _round_wm(
                 state, deg=deg, sync_every=self.sync_every,
                 exchange=self.exchange,
@@ -823,6 +861,13 @@ class BroadcastSim:
         constants."""
         f = self._faulted
         if self._delayed is not None:
+            if masks is not None:      # composed faulted-delayed mode
+                lr = self._live_rows(*masks)
+                dex = self._delayed.exchange
+                return _round_wm(
+                    state, deg=deg, sync_every=self.sync_every,
+                    exchange=self.exchange, live_rows=lr,
+                    delayed_exchange=lambda h, t: dex(h, t, lr))
             return _round_wm(state, deg=deg,
                              sync_every=self.sync_every,
                              exchange=self.exchange,
@@ -838,18 +883,19 @@ class BroadcastSim:
             live_rows=self._live_rows(*masks))
 
     def _wm_extra_args(self):
-        """The faulted words-major mode's extra traced arguments: mask
-        arrays + window rounds (empty when unfaulted)."""
-        if self._faulted is None:
+        """The masked words-major modes' extra traced arguments: mask
+        arrays + window rounds (empty when neither faulted nor
+        faulted-delayed)."""
+        if self._faulted is None and not self._df:
             return ()
         return (self._f_exists, self._f_same, self.parts.starts,
                 self.parts.ends)
 
     def _wm_mesh_extra(self):
         """Extra (in_specs, args) the sharded words-major programs
-        thread through shard_map in faulted mode: the mask arrays and
+        thread through shard_map in masked modes: the mask arrays and
         the window rounds (explicit args, not closure captures)."""
-        if self._faulted is None:
+        if self._faulted is None and not self._df:
             return (), ()
         e_spec, s_spec = self._f_specs
         return ((e_spec, s_spec, P(), P()), self._wm_extra_args())

@@ -995,6 +995,147 @@ def has_sharded_exchange(topology: str, n: int, n_shards: int | None,
                                       **kw) is not None)
 
 
+def _delayed_impl(topology: str, n: int, dd: tuple,
+                  n_shards: int | None, axis_name: str, **kw):
+    """ONE implementation of per-direction-class delayed delivery per
+    topology, shared by :func:`make_delayed` (unmasked) and
+    :func:`make_delayed_faulted` (window-masked): returns
+    ``(ex_impl, sex_impl | None)`` where each takes ``(hist, t, lv)``
+    with ``lv`` either None (no partitions) or a {delay: (D, rows)
+    liveness} dict evaluated at each delay's send round.  Masks apply
+    at the same positions as the masked exchanges (receiver columns;
+    the tree's child-position mask pre-fold)."""
+    ring = max(dd)
+
+    def take(hist, t, d):
+        return _take_delayed(hist, t, dd[d], ring)
+
+    def m(x, lv, d, row):
+        return x if lv is None else _mask_cols(x, lv[dd[d]][row])
+
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        if len(dd) != 2:
+            raise ValueError("tree takes (down, up) delays")
+
+        def ex(hist, t, lv):
+            fp = m(tree_from_parent(take(hist, t, 0), k), lv, 0, 0)
+            fk = tree_from_kids(m(take(hist, t, 1), lv, 1, 0), k)
+            return fp | fk
+
+        sex = None
+        if has_sharded_exchange(topology, n, n_shards,
+                                axis_name=axis_name, **kw):
+            def sex(hist, t, lv):
+                fp = m(tree_parent_payload(take(hist, t, 0), n,
+                                           n_shards, k, axis_name),
+                       lv, 0, 0)
+                fk = tree_kids_payload(m(take(hist, t, 1), lv, 1, 0),
+                                       n, n_shards, k, axis_name)
+                return fp | fk
+
+        return ex, sex
+
+    if topology in ("ring", "circulant"):
+        strides = [1] if topology == "ring" else list(kw["strides"])
+        if len(dd) != 2 * len(strides):
+            raise ValueError("circulant takes (+s, -s) delays per stride")
+
+        def ex(hist, t, lv):
+            out = None
+            for i, s in enumerate(strides):
+                term = (m(jnp.roll(take(hist, t, 2 * i), s, axis=1),
+                          lv, 2 * i, 2 * i)
+                        | m(jnp.roll(take(hist, t, 2 * i + 1), -s,
+                                     axis=1), lv, 2 * i + 1, 2 * i + 1))
+                out = term if out is None else out | term
+            return out
+
+        sex = None
+        if n_shards is not None and n % n_shards == 0:
+            def sex(hist, t, lv):
+                out = None
+                for i, s in enumerate(strides):
+                    term = (m(sharded_roll(take(hist, t, 2 * i), s, n,
+                                           n_shards, axis_name),
+                              lv, 2 * i, 2 * i)
+                            | m(sharded_roll(take(hist, t, 2 * i + 1),
+                                             -s, n, n_shards,
+                                             axis_name),
+                                lv, 2 * i + 1, 2 * i + 1))
+                    out = term if out is None else out | term
+                return out
+
+        return ex, sex
+
+    if topology == "grid":
+        cols = kw.get("cols") or grid_cols(n)
+        if len(dd) != 4:
+            raise ValueError("grid takes (up, down, left, right) delays")
+
+        def ex(hist, t, lv):
+            pu, pd_, pl_, pr = (take(hist, t, d) for d in range(4))
+            if lv is None:
+                return grid_terms(pu, pd_, pl_, pr, cols)
+            # grid_terms folds the static row-wrap masks; window masks
+            # land per direction on the delivered terms, so apply them
+            # via four single-direction grid_terms calls:
+            z = _zeros(pu, pu.shape[1])
+            up = m(grid_terms(pu, z, z, z, cols), lv, 0, 0)
+            down = m(grid_terms(z, pd_, z, z, cols), lv, 1, 1)
+            left = m(grid_terms(z, z, pl_, z, cols), lv, 2, 2)
+            right = m(grid_terms(z, z, z, pr, cols), lv, 3, 3)
+            return up | down | left | right
+
+        sex = None
+        if has_sharded_exchange(topology, n, n_shards,
+                                axis_name=axis_name, **kw):
+            def sex(hist, t, lv):
+                block = hist.shape[2]
+                up = m(sharded_shift(take(hist, t, 0), cols, n_shards,
+                                     axis_name), lv, 0, 0)
+                down = m(sharded_shift(take(hist, t, 1), -cols,
+                                       n_shards, axis_name), lv, 1, 1)
+                lf = sharded_shift(take(hist, t, 2), 1, n_shards,
+                                   axis_name)
+                rt = sharded_shift(take(hist, t, 3), -1, n_shards,
+                                   axis_name)
+                start = jax.lax.axis_index(axis_name) * block
+                col_idx = (start
+                           + jnp.arange(block, dtype=jnp.int32)) % cols
+                lf = jnp.where((col_idx < cols - 1)[None, :], lf, 0)
+                rt = jnp.where((col_idx > 0)[None, :], rt, 0)
+                return up | down | m(lf, lv, 2, 2) | m(rt, lv, 3, 3)
+
+        return ex, sex
+
+    if topology == "line":
+        if len(dd) != 2:
+            raise ValueError("line takes (fwd, bwd) delays")
+
+        def ex(hist, t, lv):
+            pf, pb = take(hist, t, 0), take(hist, t, 1)
+            if lv is None:
+                return line_terms(pf, pb)
+            z = _zeros(pf, pf.shape[1])
+            return (m(line_terms(pf, z), lv, 0, 0)
+                    | m(line_terms(z, pb), lv, 1, 1))
+
+        sex = None
+        if has_sharded_exchange(topology, n, n_shards,
+                                axis_name=axis_name, **kw):
+            def sex(hist, t, lv):
+                return (m(sharded_shift(take(hist, t, 0), 1, n_shards,
+                                        axis_name), lv, 0, 0)
+                        | m(sharded_shift(take(hist, t, 1), -1,
+                                          n_shards, axis_name),
+                            lv, 1, 1))
+
+        return ex, sex
+
+    return None
+
+
 def make_delayed(topology: str, n: int, dir_delays,
                  n_shards: int | None = None, axis_name: str = "nodes",
                  **kw) -> StructuredDelays | None:
@@ -1010,105 +1151,69 @@ def make_delayed(topology: str, n: int, dir_delays,
     dd = tuple(int(x) for x in dir_delays)
     if any(d < 1 for d in dd):
         raise ValueError("direction delays are rounds >= 1")
-    ring = max(dd)
-    halo = has_sharded_exchange(topology, n, n_shards,
-                                axis_name=axis_name, **kw)
+    impl = _delayed_impl(topology, n, dd, n_shards, axis_name, **kw)
+    if impl is None:
+        return None
+    ex_impl, sex_impl = impl
+    sex = (None if sex_impl is None
+           else (lambda h, t: sex_impl(h, t, None)))
+    return StructuredDelays(dd, max(dd),
+                            lambda h, t: ex_impl(h, t, None), sex)
 
-    def take(hist, t, d):
-        return _take_delayed(hist, t, dd[d], ring)
 
-    if topology == "tree":
-        k = kw.get("branching", 4)
-        if len(dd) != 2:
-            raise ValueError("tree takes (down, up) delays")
+class FaultedDelayed(NamedTuple):
+    """Delays AND partition windows composed on the structured path
+    (from :func:`make_delayed_faulted`): each direction class delivers
+    its past payload masked by the window liveness AT ITS SEND ROUND —
+    drops happen at send time, exactly like the gather path's
+    ``live_at_send`` (broadcast._gather_or_delayed) and Maelstrom.
 
-        def ex(hist, t):
-            return (tree_from_parent(take(hist, t, 0), k)
-                    | tree_from_kids(take(hist, t, 1), k))
+    ``exchange(history, t, live_rows)`` / the sharded variant take the
+    per-round liveness closure (BroadcastSim._live_rows over
+    ``exists``/``same``) and evaluate it at each direction's send
+    round; ``exists``/``same`` follow the StructuredFaults layout."""
 
-        sex = None
-        if halo:
-            def sex(hist, t):
-                return (tree_parent_payload(take(hist, t, 0), n,
-                                            n_shards, k, axis_name)
-                        | tree_kids_payload(take(hist, t, 1), n,
-                                            n_shards, k, axis_name))
+    exists: np.ndarray
+    same: np.ndarray
+    dir_delays: tuple
+    ring: int
+    exchange: Callable
+    sharded_exchange: Callable | None
 
-        return StructuredDelays(dd, ring, ex, sex)
 
-    if topology in ("ring", "circulant"):
-        strides = [1] if topology == "ring" else list(kw["strides"])
-        if len(dd) != 2 * len(strides):
-            raise ValueError("circulant takes (+s, -s) delays per stride")
+def make_delayed_faulted(topology: str, n: int, dir_delays,
+                         groups: np.ndarray,
+                         n_shards: int | None = None,
+                         axis_name: str = "nodes",
+                         **kw) -> FaultedDelayed | None:
+    """Compose per-direction-class delays with a partition schedule,
+    gather-free.  Masks follow :func:`fault_masks`; delays and the
+    delivery bodies are shared with :func:`make_delayed` via
+    :func:`_delayed_impl` (same direction-class order and aliasing
+    caveat)."""
+    masks = fault_masks(topology, n, groups, **kw)
+    if masks is None:
+        return None
+    exists, same = masks
+    dd = tuple(int(x) for x in dir_delays)
+    if any(d < 1 for d in dd):
+        raise ValueError("direction delays are rounds >= 1")
+    impl = _delayed_impl(topology, n, dd, n_shards, axis_name, **kw)
+    if impl is None:
+        return None
+    ex_impl, sex_impl = impl
 
-        def ex(hist, t):
-            out = None
-            for i, s in enumerate(strides):
-                term = (jnp.roll(take(hist, t, 2 * i), s, axis=1)
-                        | jnp.roll(take(hist, t, 2 * i + 1), -s,
-                                   axis=1))
-                out = term if out is None else out | term
-            return out
+    def lv_by_delay(live_rows, t):
+        # one liveness evaluation per DISTINCT send round, shared by
+        # all directions with that delay
+        return {d: live_rows(t - (d - 1)) for d in sorted(set(dd))}
 
-        sex = None
-        if n_shards is not None and n % n_shards == 0:
-            def sex(hist, t):
-                out = None
-                for i, s in enumerate(strides):
-                    term = (sharded_roll(take(hist, t, 2 * i), s, n,
-                                         n_shards, axis_name)
-                            | sharded_roll(take(hist, t, 2 * i + 1),
-                                           -s, n, n_shards, axis_name))
-                    out = term if out is None else out | term
-                return out
+    def ex(hist, t, live_rows):
+        return ex_impl(hist, t, lv_by_delay(live_rows, t))
 
-        return StructuredDelays(dd, ring, ex, sex)
+    sex = None
+    if sex_impl is not None:
+        def sex(hist, t, live_rows):
+            return sex_impl(hist, t, lv_by_delay(live_rows, t))
 
-    if topology == "grid":
-        cols = kw.get("cols") or grid_cols(n)
-        if len(dd) != 4:
-            raise ValueError("grid takes (up, down, left, right) delays")
-
-        def ex(hist, t):
-            return grid_terms(*(take(hist, t, d) for d in range(4)),
-                              cols)
-
-        sex = None
-        if halo:
-            def sex(hist, t):
-                block = hist.shape[2]
-                up = sharded_shift(take(hist, t, 0), cols, n_shards,
-                                   axis_name)
-                down = sharded_shift(take(hist, t, 1), -cols, n_shards,
-                                     axis_name)
-                lf = sharded_shift(take(hist, t, 2), 1, n_shards,
-                                   axis_name)
-                rt = sharded_shift(take(hist, t, 3), -1, n_shards,
-                                   axis_name)
-                start = jax.lax.axis_index(axis_name) * block
-                col_idx = (start + jnp.arange(block, dtype=jnp.int32)) \
-                    % cols
-                lf = jnp.where((col_idx < cols - 1)[None, :], lf, 0)
-                rt = jnp.where((col_idx > 0)[None, :], rt, 0)
-                return up | down | lf | rt
-
-        return StructuredDelays(dd, ring, ex, sex)
-
-    if topology == "line":
-        if len(dd) != 2:
-            raise ValueError("line takes (fwd, bwd) delays")
-
-        def ex(hist, t):
-            return line_terms(take(hist, t, 0), take(hist, t, 1))
-
-        sex = None
-        if halo:
-            def sex(hist, t):
-                return (sharded_shift(take(hist, t, 0), 1, n_shards,
-                                      axis_name)
-                        | sharded_shift(take(hist, t, 1), -1, n_shards,
-                                        axis_name))
-
-        return StructuredDelays(dd, ring, ex, sex)
-
-    return None
+    return FaultedDelayed(exists, same, dd, max(dd), ex, sex)

@@ -1267,3 +1267,87 @@ def test_gather_delays_bridge_rejects_aliased_directions():
     tn = to_padded_neighbors(tree(16))
     with pytest.raises(ValueError, match="tree takes"):
         structured.gather_delays_for("tree", 16, (1, 2, 3), tn)
+
+
+def test_delayed_faulted_structured_matches_gather():
+    # delays AND partition windows composed on the structured path
+    # must equal the gather path run with the equivalent per-edge
+    # delays array + the same Partitions (liveness at send time)
+    from gossip_glomers_tpu.parallel.topology import circulant, ring
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    cases = [("tree", 64, {}, (1, 3)),
+             ("grid", 64, {}, (2, 1, 1, 2)),
+             ("ring", 32, {}, (2, 1)),
+             ("line", 32, {}, (1, 2)),
+             ("circulant", 64, {"strides": [1, 5, 21]},
+              (1, 2, 3, 1, 2, 3))]
+    builders = {"ring": lambda n, kw: to_padded_neighbors(ring(n)),
+                "circulant": lambda n, kw: circulant(n, kw["strides"]),
+                "tree": lambda n, kw: to_padded_neighbors(tree(n)),
+                "grid": lambda n, kw: to_padded_neighbors(grid(n)),
+                "line": lambda n, kw: to_padded_neighbors(line(n))}
+    for topo, n, kw, dd in cases:
+        nbrs = builders[topo](n, kw)
+        nv = min(n, 48)
+        inject = make_inject(n, nv)
+        for wins in _fault_cases(n, seed=7 * n):
+            parts, group = _window_parts(wins, n)
+            gd = structured.gather_delays_for(topo, n, dd, nbrs, **kw)
+            ref = BroadcastSim(nbrs, n_values=nv, sync_every=6,
+                               parts=parts, delays=gd,
+                               srv_ledger=False)
+            s1, r1 = ref.run(inject)
+            df = structured.make_delayed_faulted(topo, n, dd, group,
+                                                 **kw)
+            fast = BroadcastSim(
+                nbrs, n_values=nv, sync_every=6, parts=parts,
+                srv_ledger=False,
+                exchange=structured.make_exchange(topo, n, **kw),
+                delayed=df)
+            s2, r2 = fast.run(inject)
+            assert r1 == r2, (topo, n, dd, len(wins))
+            assert (ref.received_node_major(s1)
+                    == fast.received_node_major(s2)).all(), (topo, dd)
+            assert int(s1.msgs) == int(s2.msgs), (topo, dd)
+
+
+def test_delayed_faulted_structured_sharded_matches():
+    from gossip_glomers_tpu.parallel.topology import circulant
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    n, nv = 128, 48
+    strides = [1, 5, 33]
+    dd = (1, 2, 3, 1, 2, 3)
+    nbrs = circulant(n, strides)
+    rng = np.random.default_rng(9)
+    group = rng.integers(0, 2, n).astype(np.int8)[None, :]
+    parts, group = _window_parts([(2, 9, group[0])], n)
+    inject = make_inject(n, nv)
+    ref = BroadcastSim(
+        nbrs, n_values=nv, sync_every=6, parts=parts, srv_ledger=False,
+        exchange=structured.make_exchange("circulant", n,
+                                          strides=strides),
+        delayed=structured.make_delayed_faulted(
+            "circulant", n, dd, group, strides=strides))
+    s1, r1 = ref.run(inject)
+    for mesh, pdim in ((mesh_1d(), 8), (mesh_2d(), 4)):
+        sim = BroadcastSim(
+            nbrs, n_values=nv, sync_every=6, parts=parts,
+            srv_ledger=False, mesh=mesh,
+            exchange=structured.make_exchange("circulant", n,
+                                              strides=strides),
+            delayed=structured.make_delayed_faulted(
+                "circulant", n, dd, group, n_shards=pdim,
+                strides=strides))
+        s2, r2 = sim.run(inject)
+        assert r1 == r2, mesh.axis_names
+        assert (ref.received_node_major(s1)
+                == sim.received_node_major(s2)).all()
+        assert int(s1.msgs) == int(s2.msgs)
+        s3, r3 = sim.run_fused(inject)
+        assert r1 == r3
+        st0, _tg = sim.stage(inject)
+        s4 = sim.run_staged_fixed(st0, r1)
+        assert (ref.received_node_major(s1)
+                == sim.received_node_major(s4)).all()
