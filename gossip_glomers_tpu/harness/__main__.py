@@ -29,7 +29,7 @@ def main(argv: list[str] | None = None) -> int:
     t = sub.add_parser("test", help="run one workload under the harness")
     t.add_argument("-w", "--workload", required=True,
                    choices=["echo", "unique-ids", "broadcast", "counter",
-                            "kafka"])
+                            "kafka", "kafka-faults"])
     t.add_argument("--node-count", type=int, default=None)
     t.add_argument("--rate", type=float, default=10.0,
                    help="client ops per (virtual) second")
@@ -39,20 +39,23 @@ def main(argv: list[str] | None = None) -> int:
     t.add_argument("--topology", default=None,
                    help="broadcast topology (tree/grid/ring/line); "
                         "broadcast only")
-    t.add_argument("--latency", type=float, default=0.0,
-                   help="per-hop delivery latency in virtual seconds")
+    t.add_argument("--latency", type=float, default=None,
+                   help="per-hop delivery latency in virtual seconds "
+                        "(default 0; kafka-faults defaults to 0.05 so "
+                        "its retry windows exist)")
     t.add_argument("--nemesis", choices=["partition"], default=None)
     t.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 
     from .workloads import (run_broadcast, run_counter, run_echo,
-                            run_kafka, run_unique_ids)
+                            run_kafka, run_kafka_faults, run_unique_ids)
 
     # a flag the chosen workload cannot honor is an error, not a silent
     # default — a green run must mean the requested configuration ran
     if args.topology is not None and args.workload != "broadcast":
         ap.error(f"--topology applies to broadcast, not {args.workload}")
-    if args.nemesis and args.workload not in ("broadcast", "counter"):
+    if args.nemesis and args.workload not in ("broadcast", "counter",
+                                              "kafka-faults"):
         ap.error(f"--nemesis is not wired for {args.workload}")
     if args.workload == "echo":
         if args.node_count not in (None, 1):
@@ -72,21 +75,24 @@ def main(argv: list[str] | None = None) -> int:
                      "--time-limit too short for the partition period")
         return parts
 
+    # an explicit --latency 0 is honored literally; only the UNSET
+    # default differs per workload (kafka-faults needs retry windows)
+    lat = 0.0 if args.latency is None else args.latency
     # quiescence: anti-entropy interval (2 s) x a few waves, plus heal
     # time when partitioning and a latency allowance
-    quiescence = 6.0 + (4.0 if args.nemesis else 0.0) + 20 * args.latency
+    quiescence = 6.0 + (4.0 if args.nemesis else 0.0) + 20 * lat
     n_ops = max(1, int(args.rate * args.time_limit))
     res = None
     if args.workload == "echo":
         res = run_echo(n_ops=n_ops, seed=args.seed)
     elif args.workload == "unique-ids":
         res = run_unique_ids(n_nodes=args.node_count or 3, n_ops=n_ops,
-                             latency=args.latency, seed=args.seed)
+                             latency=lat, seed=args.seed)
     elif args.workload == "broadcast":
         n = args.node_count or 25
         res = run_broadcast(
             n_nodes=n, topology=args.topology or "tree",
-            n_values=n_ops, rate=args.rate, latency=args.latency,
+            n_values=n_ops, rate=args.rate, latency=lat,
             quiescence=quiescence, partitions=make_partitions(n),
             seed=args.seed)
     elif args.workload == "counter":
@@ -94,14 +100,27 @@ def main(argv: list[str] | None = None) -> int:
         # counter nodes talk only to seq-kv: a partition that never
         # covers the service would be a silent no-op
         res = run_counter(n_nodes=n, n_ops=n_ops, rate=args.rate,
-                          quiescence=quiescence, latency=args.latency,
+                          quiescence=quiescence, latency=lat,
                           partitions=make_partitions(
                               n, include=["seq-kv"]),
                           seed=args.seed)
     elif args.workload == "kafka":
         res = run_kafka(n_nodes=args.node_count or 2, n_ops=n_ops,
-                        rate=args.rate, latency=args.latency,
+                        rate=args.rate, latency=lat,
                         seed=args.seed)
+    elif args.workload == "kafka-faults":
+        # the contention campaign: hot-key send bursts + racing
+        # commits under injected latency (and optionally partitions),
+        # with the lin-kv history certified per key.  Each burst is
+        # one send per node, so --rate/--time-limit set the burst
+        # count (the CLI's flag-honoring rule: the requested op volume
+        # must actually run)
+        n = args.node_count or 4
+        res = run_kafka_faults(
+            n_nodes=n, n_bursts=max(1, -(-n_ops // n)),
+            latency=0.05 if args.latency is None else lat,
+            partitions=make_partitions(n, include=["lin-kv"]),
+            seed=args.seed)
 
     out = {"workload": args.workload, "ok": res.ok,
            **{k: v for k, v in res.stats.items()

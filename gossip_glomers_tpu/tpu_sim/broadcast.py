@@ -85,9 +85,12 @@ class BroadcastState(NamedTuple):
     frontier: jnp.ndarray    # (N, W) uint32
     t: jnp.ndarray           # () int32 — round counter
     msgs: jnp.ndarray        # () uint32 — value-messages sent (wraps @2^32)
-    # latency mode only: (L, N, W) ring of past full-axis payloads, so a
-    # delay-d edge delivers the payload flooded d rounds ago (Maelstrom's
-    # variable per-edge latency as data).  None when all edges are 1 hop.
+    # latency modes only: ring of past payload blocks — (L, N, W)
+    # node-major for per-edge `delays` (gather path), (L, W, N)
+    # words-major for per-direction `delayed` (structured path); in
+    # both, node-SHARDED under a mesh so a delay-d edge/direction
+    # delivers the payload flooded d rounds ago (Maelstrom's latency
+    # as data) at O(L*N/shards) memory.  None when all edges are 1 hop.
     history: jnp.ndarray | None = None
     # reference-accounted server-to-server message total — what
     # Maelstrom's ledger would read for the same run.  Floods: one

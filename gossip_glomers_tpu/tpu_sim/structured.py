@@ -995,7 +995,7 @@ def has_sharded_exchange(topology: str, n: int, n_shards: int | None,
                                       **kw) is not None)
 
 
-def _delayed_impl(topology: str, n: int, dd: tuple,
+def _delayed_impl(topology: str, n: int, dir_delays,
                   n_shards: int | None, axis_name: str, **kw):
     """ONE implementation of per-direction-class delayed delivery per
     topology, shared by :func:`make_delayed` (unmasked) and
@@ -1004,7 +1004,12 @@ def _delayed_impl(topology: str, n: int, dd: tuple,
     with ``lv`` either None (no partitions) or a {delay: (D, rows)
     liveness} dict evaluated at each delay's send round.  Masks apply
     at the same positions as the masked exchanges (receiver columns;
-    the tree's child-position mask pre-fold)."""
+    the tree's child-position mask pre-fold).  Coerces and validates
+    ``dir_delays`` once for both entry points; returns (dd, ex_impl,
+    sex_impl | None)."""
+    dd = tuple(int(x) for x in dir_delays)
+    if any(d < 1 for d in dd):
+        raise ValueError("direction delays are rounds >= 1")
     ring = max(dd)
 
     def take(hist, t, d):
@@ -1034,7 +1039,7 @@ def _delayed_impl(topology: str, n: int, dd: tuple,
                                        n, n_shards, k, axis_name)
                 return fp | fk
 
-        return ex, sex
+        return dd, ex, sex
 
     if topology in ("ring", "circulant"):
         strides = [1] if topology == "ring" else list(kw["strides"])
@@ -1066,7 +1071,7 @@ def _delayed_impl(topology: str, n: int, dd: tuple,
                     out = term if out is None else out | term
                 return out
 
-        return ex, sex
+        return dd, ex, sex
 
     if topology == "grid":
         cols = kw.get("cols") or grid_cols(n)
@@ -1107,7 +1112,7 @@ def _delayed_impl(topology: str, n: int, dd: tuple,
                 rt = jnp.where((col_idx > 0)[None, :], rt, 0)
                 return up | down | m(lf, lv, 2, 2) | m(rt, lv, 3, 3)
 
-        return ex, sex
+        return dd, ex, sex
 
     if topology == "line":
         if len(dd) != 2:
@@ -1131,7 +1136,7 @@ def _delayed_impl(topology: str, n: int, dd: tuple,
                                           n_shards, axis_name),
                             lv, 1, 1))
 
-        return ex, sex
+        return dd, ex, sex
 
     return None
 
@@ -1148,13 +1153,11 @@ def make_delayed(topology: str, n: int, dir_delays,
     ORs both classes — the edge effectively carries BOTH delays.  The
     gather bridge (:func:`gather_delays_for`) cannot represent that
     and raises instead."""
-    dd = tuple(int(x) for x in dir_delays)
-    if any(d < 1 for d in dd):
-        raise ValueError("direction delays are rounds >= 1")
-    impl = _delayed_impl(topology, n, dd, n_shards, axis_name, **kw)
+    impl = _delayed_impl(topology, n, dir_delays, n_shards, axis_name,
+                         **kw)
     if impl is None:
         return None
-    ex_impl, sex_impl = impl
+    dd, ex_impl, sex_impl = impl
     sex = (None if sex_impl is None
            else (lambda h, t: sex_impl(h, t, None)))
     return StructuredDelays(dd, max(dd),
@@ -1195,13 +1198,11 @@ def make_delayed_faulted(topology: str, n: int, dir_delays,
     if masks is None:
         return None
     exists, same = masks
-    dd = tuple(int(x) for x in dir_delays)
-    if any(d < 1 for d in dd):
-        raise ValueError("direction delays are rounds >= 1")
-    impl = _delayed_impl(topology, n, dd, n_shards, axis_name, **kw)
+    impl = _delayed_impl(topology, n, dir_delays, n_shards, axis_name,
+                         **kw)
     if impl is None:
         return None
-    ex_impl, sex_impl = impl
+    dd, ex_impl, sex_impl = impl
 
     def lv_by_delay(live_rows, t):
         # one liveness evaluation per DISTINCT send round, shared by

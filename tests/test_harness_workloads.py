@@ -221,6 +221,16 @@ def test_workload_cli_maelstrom_ux():
     assert p.returncode == 0, p.stderr
     assert json.loads(p.stdout.splitlines()[0])["ok"]
 
+    # kafka fault campaign: nemesis + the knossos-style per-key
+    # certification verdict surfaced in the summary line
+    p = run("-w", "kafka-faults", "--node-count", "4",
+            "--nemesis", "partition", "--time-limit", "12",
+            "--seed", "2")
+    assert p.returncode == 0, p.stderr
+    stats = json.loads(p.stdout.splitlines()[0])
+    assert stats["ok"] and stats["linearizable"] is True
+    assert stats["dropped_msgs"] > 0
+
     # a flag the workload cannot honor is a usage error, not a silent
     # green run
     p = run("-w", "kafka", "--topology", "ring")
