@@ -73,73 +73,52 @@ def config2_grid25_faults():
     }
 
 
-def config3_counter_1k():
+def _counter_bench(n: int, name: str) -> dict:
+    """Shared partitioned-g-counter methodology for configs 3 and 3b:
+    half the nodes cut off the KV for 8 of 16 rounds, allreduce flush,
+    read-after-quiescence sum check, chained amortized timing (see
+    timing.py — per-call numbers on the tunnel lie in both
+    directions)."""
     import jax
     import jax.numpy as jnp
 
     from gossip_glomers_tpu.tpu_sim.counter import CounterSim, KVReach
+    from gossip_glomers_tpu.tpu_sim.timing import chained_time
 
-    n = 1024
     rng = np.random.default_rng(0)
     deltas = rng.integers(0, 10, n).astype(np.int32)
     blocked = np.zeros((1, n), bool)
     blocked[0, : n // 2] = True
     sched = KVReach(jnp.array([0], jnp.int32), jnp.array([8], jnp.int32),
                     jnp.asarray(blocked))
-    from gossip_glomers_tpu.tpu_sim.timing import chained_time
-
     sim = CounterSim(n, mode="allreduce", poll_every=2, kv_sched=sched)
     st0 = sim.add(sim.init_state(), deltas)
-    # 8 partitioned rounds + 8 to heal; chained amortized timing (see
-    # timing.py — per-call numbers on the tunnel lie in both directions)
     dt = chained_time(lambda st: sim.run(st, 16), st0,
                       lambda st: np.asarray(st.kv))
     st = sim.run(st0, 16)
     jax.block_until_ready(st.kv)
     reads = sim.reads(st)
     return {
-        "config": "counter-1k-partitioned",
+        "config": name,
         "ok": bool(sim.kv_value(st) == int(deltas.sum())
                    and (reads == int(deltas.sum())).all()),
         "rounds": 16,
         "wall_s": round(dt, 4),
+        "ms_per_round": round(dt / 16 * 1e3, 3),
         "kv_msgs": int(st.msgs),
     }
+
+
+def config3_counter_1k():
+    return _counter_bench(1024, "counter-1k-partitioned")
 
 
 def config3b_counter_1m():
     """The g-counter at the scale axis: 1M nodes, allreduce flush mode
     (the psum collective the CRDT merge becomes at scale), partition
     window masking half the nodes off the KV — the 1k-node config 3
-    grown 1024x."""
-    import jax
-    import jax.numpy as jnp
-
-    from gossip_glomers_tpu.tpu_sim.counter import CounterSim, KVReach
-    from gossip_glomers_tpu.tpu_sim.timing import chained_time
-
-    n = 1 << 20
-    rng = np.random.default_rng(0)
-    deltas = rng.integers(0, 10, n).astype(np.int32)
-    blocked = np.zeros((1, n), bool)
-    blocked[0, : n // 2] = True
-    sched = KVReach(jnp.array([0], jnp.int32), jnp.array([8], jnp.int32),
-                    jnp.asarray(blocked))
-    sim = CounterSim(n, mode="allreduce", poll_every=2, kv_sched=sched)
-    st0 = sim.add(sim.init_state(), deltas)
-    dt = chained_time(lambda st: sim.run(st, 16), st0,
-                      lambda st: np.asarray(st.kv))
-    st = sim.run(st0, 16)
-    jax.block_until_ready(st.kv)
-    reads = sim.reads(st)
-    return {
-        "config": "counter-1M-partitioned",
-        "ok": bool(sim.kv_value(st) == int(deltas.sum())
-                   and (reads == int(deltas.sum())).all()),
-        "rounds": 16,
-        "wall_s": round(dt, 4),
-        "ms_per_round": round(dt / 16 * 1e3, 3),
-    }
+    grown 1024x (same methodology, `_counter_bench`)."""
+    return _counter_bench(1 << 20, "counter-1M-partitioned")
 
 
 def config4_epidemic_1m():

@@ -63,16 +63,19 @@ def main(argv: list[str] | None = None) -> int:
         if args.latency:
             ap.error("echo has no network to delay")
 
-    def make_partitions(n: int, include: list | None = None):
+    def make_partitions(n: int, include: list | None = None,
+                        t_end: float | None = None):
         if args.nemesis != "partition":
             return None
         from . import random_partitions
         parts = random_partitions(
-            [f"n{i}" for i in range(n)], t_end=args.time_limit,
+            [f"n{i}" for i in range(n)],
+            t_end=t_end if t_end is not None else args.time_limit,
             seed=args.seed, include=include)
         if not parts.windows:
             ap.error("--nemesis partition scheduled no windows: "
-                     "--time-limit too short for the partition period")
+                     "the workload window is too short for the "
+                     "partition period")
         return parts
 
     # an explicit --latency 0 is honored literally; only the UNSET
@@ -116,10 +119,17 @@ def main(argv: list[str] | None = None) -> int:
         # count (the CLI's flag-honoring rule: the requested op volume
         # must actually run)
         n = args.node_count or 4
+        n_bursts = max(1, -(-n_ops // n))
+        kf_lat = 0.05 if args.latency is None else lat
+        # the campaign's VIRTUAL span is set by its burst/drain
+        # cadence, not --time-limit — schedule the nemesis over the
+        # actual run so windows cover the send bursts instead of
+        # silently healing in the first fraction of the run
+        kf_span = kf_lat * 8 + n_bursts * kf_lat * 20 + 7.0
         res = run_kafka_faults(
-            n_nodes=n, n_bursts=max(1, -(-n_ops // n)),
-            latency=0.05 if args.latency is None else lat,
-            partitions=make_partitions(n, include=["lin-kv"]),
+            n_nodes=n, n_bursts=n_bursts, latency=kf_lat,
+            partitions=make_partitions(n, include=["lin-kv"],
+                                       t_end=kf_span),
             seed=args.seed)
 
     out = {"workload": args.workload, "ok": res.ok,

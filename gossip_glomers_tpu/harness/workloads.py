@@ -448,7 +448,7 @@ def run_kafka_faults(n_nodes: int = 4, n_keys: int = 2,
     client = net.client("c1")
     rng = net.rng
     send_acks: list[tuple[str, int, int]] = []
-    send_errors = [0]
+    send_errors: dict[str, int] = {}
     polls: list[dict[str, list[list[int]]]] = []
     committed_reads: list[dict[str, int]] = []
     next_msg = [0]
@@ -465,7 +465,9 @@ def run_kafka_faults(n_nodes: int = 4, n_keys: int = 2,
                 if rep.type == "send_ok":
                     send_acks.append((key, rep.body["offset"], value))
                 else:
-                    send_errors[0] += 1
+                    # indeterminate: the allocation CAS may have landed
+                    # at lin-kv even though the client saw an error
+                    send_errors[key] = send_errors.get(key, 0) + 1
 
             client.rpc(f"n{i}", {"type": "send", "key": key,
                                  "msg": value}, on_ack)
@@ -507,9 +509,10 @@ def run_kafka_faults(n_nodes: int = 4, n_keys: int = 2,
     net.run_for(2.0)
 
     committed = committed_reads[-1] if committed_reads else {}
-    ok, details = checkers.check_kafka(send_acks, polls, committed)
+    ok, details = checkers.check_kafka(send_acks, polls, committed,
+                                       unacked_sends=send_errors)
     details["n_acked"] = len(send_acks)
-    details["n_send_errors"] = send_errors[0]
+    details["n_send_errors"] = sum(send_errors.values())
     # lin-kv must actually be linearizable per key under the fault
     # campaign — Maelstrom certifies its lin-kv with knossos; this is
     # the same certification run on OUR service's observed history

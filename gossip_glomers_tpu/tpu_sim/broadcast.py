@@ -512,8 +512,10 @@ class BroadcastSim:
         case, or a structured.FaultedDelayed (make_delayed_faulted) to
         COMPOSE delays with a partition schedule — the bundle carries
         its own masks, so do not also pass ``faulted``.  The srv
-        ledger is off in both delayed modes (the value-message ledger
-        stays exact)."""
+        ledger follows the gather path's documented current-state
+        approximation under delays: supply ``sync_diff``/
+        ``sharded_sync_diff`` for the plain delayed mode (the
+        FaultedDelayed bundle carries its own masked diffs)."""
         n = nbrs.shape[0]
         self.n_nodes = n
         self.n_values = n_values
@@ -584,8 +586,20 @@ class BroadcastSim:
                     f"vs {n_windows} windows x {n} nodes")
         # the words-major ledger needs a structured per-edge diff: the
         # single-device closure off-mesh, the halo closure on-mesh
-        if self._delayed is not None:
-            self._srv_on = False
+        if self._df:
+            fd = self._delayed
+            self._srv_on = srv_ledger and (
+                fd.sync_diff is not None if mesh is None
+                else fd.sharded_exchange is not None
+                and fd.sharded_sync_diff is not None)
+        elif self._delayed is not None:
+            # plain delayed: same gating as plain words-major — the
+            # caller-supplied sync_diff closures drive the gather
+            # path's documented current-state accounting approximation
+            self._srv_on = srv_ledger and (
+                sync_diff is not None if mesh is None
+                else (self._delayed.sharded_exchange is not None
+                      and sharded_sync_diff is not None))
         elif self._faulted is not None:
             f = self._faulted
             self._srv_on = srv_ledger and (
@@ -809,11 +823,15 @@ class BroadcastSim:
                     exchange=self.exchange,
                     reduce_sum=lambda s: lax.psum(s, mesh_axes),
                     live_rows=lr,
+                    sync_diff=self._delayed.sharded_sync_diff,
+                    sync_base_once=sync_base_once,
                     delayed_exchange=lambda h, t: dex(h, t, lr))
             return _round_wm(
                 state, deg=deg, sync_every=self.sync_every,
                 exchange=self.exchange,
                 reduce_sum=lambda s: lax.psum(s, mesh_axes),
+                sync_diff=self.sharded_sync_diff,
+                sync_base_once=sync_base_once,
                 delayed_exchange=self._delayed.sharded_exchange)
         if masks is not None:
             live_rows = self._live_rows(*masks)
@@ -870,10 +888,12 @@ class BroadcastSim:
                 return _round_wm(
                     state, deg=deg, sync_every=self.sync_every,
                     exchange=self.exchange, live_rows=lr,
+                    sync_diff=self._delayed.sync_diff,
                     delayed_exchange=lambda h, t: dex(h, t, lr))
             return _round_wm(state, deg=deg,
                              sync_every=self.sync_every,
                              exchange=self.exchange,
+                             sync_diff=self.sync_diff,
                              delayed_exchange=self._delayed.exchange)
         if masks is None:
             return _round_wm(state, deg=deg,

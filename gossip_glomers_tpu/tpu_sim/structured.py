@@ -826,6 +826,69 @@ class StructuredFaults(NamedTuple):
     sharded_sync_diff: Callable | None
 
 
+def _masked_diffs(topology: str, n: int, n_shards: int | None,
+                  axis_name: str = "nodes", halo: bool | None = None,
+                  **kw):
+    """The masked per-edge sync-diff closures ``(df, sdf | None)`` —
+    ``df(recv, live)`` full-axis, ``sdf`` halo-path — shared by
+    :func:`make_faulted` and :func:`make_delayed_faulted` (one
+    definition of the accounting per topology).  None for unstructured
+    topologies; ``sdf`` is None when the halo gates fail (``halo``:
+    the precomputed :func:`has_sharded_exchange` predicate, probed
+    here only when the caller has not already)."""
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        df = lambda r, lv: tree_masked_sync_diff(r, lv, k)  # noqa: E731
+    elif topology == "grid":
+        cols = kw.get("cols") or grid_cols(n)
+        df = lambda r, lv: grid_masked_sync_diff(r, lv, cols)  # noqa: E731
+    elif topology in ("ring", "circulant"):
+        strides = [1] if topology == "ring" else list(kw["strides"])
+        df = lambda r, lv: circulant_masked_sync_diff(  # noqa: E731
+            r, lv, strides)
+    elif topology == "line":
+        df = line_masked_sync_diff
+    else:
+        return None
+
+    sdf = None
+    if halo is None:
+        halo = has_sharded_exchange(topology, n, n_shards,
+                                    axis_name=axis_name, **kw)
+    if halo:
+        if topology == "tree":
+            k = kw.get("branching", 4)
+
+            def sdf(r, lv):
+                parent = tree_parent_payload(r, n, n_shards, k,
+                                             axis_name)
+                return _dir_diff(parent, r, lv[0])
+        elif topology == "grid":
+            cols = kw.get("cols") or grid_cols(n)
+
+            def sdf(r, lv):
+                up = sharded_shift(r, cols, n_shards, axis_name)
+                lf = sharded_shift(r, 1, n_shards, axis_name)
+                return (_dir_diff(up, r, lv[0])
+                        + _dir_diff(lf, r, lv[2]))
+        elif topology in ("ring", "circulant"):
+            strides = [1] if topology == "ring" else list(kw["strides"])
+
+            def sdf(r, lv):
+                out = jnp.uint32(0)
+                for i, s in enumerate(strides):
+                    out = out + _dir_diff(
+                        sharded_roll(r, s, n, n_shards, axis_name), r,
+                        lv[2 * i])
+                return out
+        elif topology == "line":
+            def sdf(r, lv):
+                fwd = sharded_shift(r, 1, n_shards, axis_name)
+                return _dir_diff(fwd, r, lv[0])
+
+    return df, sdf
+
+
 def make_faulted(topology: str, n: int, groups: np.ndarray,
                  n_shards: int | None = None, axis_name: str = "nodes",
                  **kw) -> StructuredFaults | None:
@@ -841,63 +904,39 @@ def make_faulted(topology: str, n: int, groups: np.ndarray,
     if topology == "tree":
         k = kw.get("branching", 4)
         ex = lambda p, lv: tree_masked_exchange(p, lv, k)  # noqa: E731
-        df = lambda r, lv: tree_masked_sync_diff(r, lv, k)  # noqa: E731
     elif topology == "grid":
         cols = kw.get("cols") or grid_cols(n)
         ex = lambda p, lv: grid_masked_exchange(p, lv, cols)  # noqa: E731
-        df = lambda r, lv: grid_masked_sync_diff(r, lv, cols)  # noqa: E731
     elif topology in ("ring", "circulant"):
         strides = [1] if topology == "ring" else list(kw["strides"])
         ex = lambda p, lv: circulant_masked_exchange(  # noqa: E731
             p, lv, strides)
-        df = lambda r, lv: circulant_masked_sync_diff(  # noqa: E731
-            r, lv, strides)
     elif topology == "line":
-        ex, df = line_masked_exchange, line_masked_sync_diff
+        ex = line_masked_exchange
     else:
         return None
+    halo = has_sharded_exchange(topology, n, n_shards,
+                                axis_name=axis_name, **kw)
+    df, sdf = _masked_diffs(topology, n, n_shards,
+                            axis_name=axis_name, halo=halo, **kw)
 
-    sex = sdf = None
-    if n_shards is not None \
-            and make_sharded_exchange(topology, n, n_shards,
-                                      axis_name=axis_name, **kw) is not None:
+    sex = None
+    if halo:
         if topology == "tree":
             k = kw.get("branching", 4)
             sex = lambda p, lv: tree_masked_sharded_exchange(  # noqa: E731
                 p, lv, n, n_shards, k, axis_name)
-
-            def sdf(r, lv):
-                parent = tree_parent_payload(r, n, n_shards, k, axis_name)
-                return _dir_diff(parent, r, lv[0])
         elif topology == "grid":
             cols = kw.get("cols") or grid_cols(n)
             sex = lambda p, lv: grid_masked_sharded_exchange(  # noqa: E731
                 p, lv, n, n_shards, cols, axis_name)
-
-            def sdf(r, lv):
-                up = sharded_shift(r, cols, n_shards, axis_name)
-                lf = sharded_shift(r, 1, n_shards, axis_name)
-                return (_dir_diff(up, r, lv[0])
-                        + _dir_diff(lf, r, lv[2]))
         elif topology in ("ring", "circulant"):
             strides = [1] if topology == "ring" else list(kw["strides"])
             sex = lambda p, lv: circulant_masked_sharded_exchange(  # noqa: E731
                 p, lv, n, n_shards, strides, axis_name)
-
-            def sdf(r, lv):
-                out = jnp.uint32(0)
-                for i, s in enumerate(strides):
-                    out = out + _dir_diff(
-                        sharded_roll(r, s, n, n_shards, axis_name), r,
-                        lv[2 * i])
-                return out
         elif topology == "line":
             sex = lambda p, lv: line_masked_sharded_exchange(  # noqa: E731
                 p, lv, n, n_shards, axis_name)
-
-            def sdf(r, lv):
-                fwd = sharded_shift(r, 1, n_shards, axis_name)
-                return _dir_diff(fwd, r, lv[0])
 
     return StructuredFaults(exists, same, ex, df, sex, sdf)
 
@@ -996,7 +1035,8 @@ def has_sharded_exchange(topology: str, n: int, n_shards: int | None,
 
 
 def _delayed_impl(topology: str, n: int, dir_delays,
-                  n_shards: int | None, axis_name: str, **kw):
+                  n_shards: int | None, axis_name: str,
+                  halo: bool | None = None, **kw):
     """ONE implementation of per-direction-class delayed delivery per
     topology, shared by :func:`make_delayed` (unmasked) and
     :func:`make_delayed_faulted` (window-masked): returns
@@ -1182,6 +1222,12 @@ class FaultedDelayed(NamedTuple):
     ring: int
     exchange: Callable
     sharded_exchange: Callable | None
+    # masked per-edge sync-diff closures for the srv (Maelstrom-
+    # comparable) ledger — the gather path's documented current-state
+    # approximation under delays, with the diff over live edges at
+    # round t (shared with make_faulted via _masked_diffs)
+    sync_diff: Callable | None = None
+    sharded_sync_diff: Callable | None = None
 
 
 def make_delayed_faulted(topology: str, n: int, dir_delays,
@@ -1198,11 +1244,15 @@ def make_delayed_faulted(topology: str, n: int, dir_delays,
     if masks is None:
         return None
     exists, same = masks
+    halo = has_sharded_exchange(topology, n, n_shards,
+                                axis_name=axis_name, **kw)
     impl = _delayed_impl(topology, n, dir_delays, n_shards, axis_name,
-                         **kw)
+                         halo=halo, **kw)
     if impl is None:
         return None
     dd, ex_impl, sex_impl = impl
+    df, sdf = _masked_diffs(topology, n, n_shards,
+                            axis_name=axis_name, halo=halo, **kw)
 
     def lv_by_delay(live_rows, t):
         # one liveness evaluation per DISTINCT send round, shared by
@@ -1217,4 +1267,4 @@ def make_delayed_faulted(topology: str, n: int, dir_delays,
         def sex(hist, t, live_rows):
             return sex_impl(hist, t, lv_by_delay(live_rows, t))
 
-    return FaultedDelayed(exists, same, dd, max(dd), ex, sex)
+    return FaultedDelayed(exists, same, dd, max(dd), ex, sex, df, sdf)

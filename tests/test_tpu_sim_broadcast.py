@@ -1147,18 +1147,24 @@ def test_delayed_structured_matches_gather_all_topologies():
         inject = make_inject(n, nv)
         for dd in delay_cases:
             gd = structured.gather_delays_for(topo, n, dd, nbrs, **kw)
+            # srv ON both sides: the structured delayed srv ledger must
+            # reproduce the gather path's current-state accounting
+            # approximation exactly
             ref = BroadcastSim(nbrs, n_values=nv, sync_every=6,
-                               delays=gd, srv_ledger=False)
+                               delays=gd)
             s1, r1 = ref.run(inject)
             fast = BroadcastSim(
-                nbrs, n_values=nv, sync_every=6, srv_ledger=False,
+                nbrs, n_values=nv, sync_every=6,
                 exchange=structured.make_exchange(topo, n, **kw),
+                sync_diff=structured.make_sync_diff(topo, n, **kw),
                 delayed=structured.make_delayed(topo, n, dd, **kw))
             s2, r2 = fast.run(inject)
             assert r1 == r2, (topo, n, dd)
             assert (ref.received_node_major(s1)
                     == fast.received_node_major(s2)).all(), (topo, dd)
             assert int(s1.msgs) == int(s2.msgs), (topo, dd)
+            assert ref.server_msgs(s1) == fast.server_msgs(s2), \
+                (topo, dd)
 
 
 def test_delayed_structured_sharded_matches_single_device():
@@ -1179,8 +1185,9 @@ def test_delayed_structured_sharded_matches_single_device():
         nv = 48
         inject = make_inject(n, nv)
         ref = BroadcastSim(
-            nbrs, n_values=nv, sync_every=6, srv_ledger=False,
+            nbrs, n_values=nv, sync_every=6,
             exchange=structured.make_exchange(topo, n, **kw),
+            sync_diff=structured.make_sync_diff(topo, n, **kw),
             delayed=structured.make_delayed(topo, n, dd, **kw))
         s1, r1 = ref.run(inject)
         for mesh, pdim in ((mesh_1d(), 8), (mesh_2d(), 4)):
@@ -1188,9 +1195,12 @@ def test_delayed_structured_sharded_matches_single_device():
                                          **kw)
             assert dl.sharded_exchange is not None, (topo, n)
             sim = BroadcastSim(
-                nbrs, n_values=nv, sync_every=6, srv_ledger=False,
+                nbrs, n_values=nv, sync_every=6,
                 mesh=mesh,
                 exchange=structured.make_exchange(topo, n, **kw),
+                sync_diff=structured.make_sync_diff(topo, n, **kw),
+                sharded_sync_diff=structured.make_sharded_sync_diff(
+                    topo, n, pdim, **kw),
                 delayed=dl)
             st0 = sim.init_state(inject)
             ring_shape = st0.history.sharding.shard_shape(
@@ -1203,6 +1213,8 @@ def test_delayed_structured_sharded_matches_single_device():
             assert (ref.received_node_major(s1)
                     == sim.received_node_major(s2)).all()
             assert int(s1.msgs) == int(s2.msgs)
+            assert ref.server_msgs(s1) == sim.server_msgs(s2), \
+                (topo, mesh.axis_names)
             s3, r3 = sim.run_fused(inject)
             assert r1 == r3
             st0b, _tg = sim.stage(inject)
@@ -1295,14 +1307,12 @@ def test_delayed_faulted_structured_matches_gather():
             parts, group = _window_parts(wins, n)
             gd = structured.gather_delays_for(topo, n, dd, nbrs, **kw)
             ref = BroadcastSim(nbrs, n_values=nv, sync_every=6,
-                               parts=parts, delays=gd,
-                               srv_ledger=False)
+                               parts=parts, delays=gd)
             s1, r1 = ref.run(inject)
             df = structured.make_delayed_faulted(topo, n, dd, group,
                                                  **kw)
             fast = BroadcastSim(
                 nbrs, n_values=nv, sync_every=6, parts=parts,
-                srv_ledger=False,
                 exchange=structured.make_exchange(topo, n, **kw),
                 delayed=df)
             s2, r2 = fast.run(inject)
@@ -1310,6 +1320,8 @@ def test_delayed_faulted_structured_matches_gather():
             assert (ref.received_node_major(s1)
                     == fast.received_node_major(s2)).all(), (topo, dd)
             assert int(s1.msgs) == int(s2.msgs), (topo, dd)
+            assert ref.server_msgs(s1) == fast.server_msgs(s2), \
+                (topo, dd, len(wins))
 
 
 def test_delayed_faulted_structured_sharded_matches():
@@ -1325,7 +1337,7 @@ def test_delayed_faulted_structured_sharded_matches():
     parts, group = _window_parts([(2, 9, group[0])], n)
     inject = make_inject(n, nv)
     ref = BroadcastSim(
-        nbrs, n_values=nv, sync_every=6, parts=parts, srv_ledger=False,
+        nbrs, n_values=nv, sync_every=6, parts=parts,
         exchange=structured.make_exchange("circulant", n,
                                           strides=strides),
         delayed=structured.make_delayed_faulted(
@@ -1334,7 +1346,7 @@ def test_delayed_faulted_structured_sharded_matches():
     for mesh, pdim in ((mesh_1d(), 8), (mesh_2d(), 4)):
         sim = BroadcastSim(
             nbrs, n_values=nv, sync_every=6, parts=parts,
-            srv_ledger=False, mesh=mesh,
+            mesh=mesh,
             exchange=structured.make_exchange("circulant", n,
                                               strides=strides),
             delayed=structured.make_delayed_faulted(
@@ -1345,6 +1357,8 @@ def test_delayed_faulted_structured_sharded_matches():
         assert (ref.received_node_major(s1)
                 == sim.received_node_major(s2)).all()
         assert int(s1.msgs) == int(s2.msgs)
+        assert ref.server_msgs(s1) == sim.server_msgs(s2), \
+            mesh.axis_names
         s3, r3 = sim.run_fused(inject)
         assert r1 == r3
         st0, _tg = sim.stage(inject)
