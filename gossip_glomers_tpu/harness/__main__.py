@@ -118,6 +118,8 @@ def main(argv: list[str] | None = None) -> int:
         # one send per node, so --rate/--time-limit set the burst
         # count (the CLI's flag-honoring rule: the requested op volume
         # must actually run)
+        from .workloads import kafka_faults_span
+
         n = args.node_count or 4
         n_bursts = max(1, -(-n_ops // n))
         kf_lat = 0.05 if args.latency is None else lat
@@ -125,11 +127,11 @@ def main(argv: list[str] | None = None) -> int:
         # cadence, not --time-limit — schedule the nemesis over the
         # actual run so windows cover the send bursts instead of
         # silently healing in the first fraction of the run
-        kf_span = kf_lat * 8 + n_bursts * kf_lat * 20 + 7.0
         res = run_kafka_faults(
             n_nodes=n, n_bursts=n_bursts, latency=kf_lat,
-            partitions=make_partitions(n, include=["lin-kv"],
-                                       t_end=kf_span),
+            partitions=make_partitions(
+                n, include=["lin-kv"],
+                t_end=kafka_faults_span(n_bursts, kf_lat)),
             seed=args.seed)
 
     out = {"workload": args.workload, "ok": res.ok,

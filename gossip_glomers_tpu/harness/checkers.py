@@ -82,15 +82,18 @@ def check_kafka(send_acks: list[tuple[str, int, int]],
       logmap.go:255-285);
     - poll results are sorted by offset with no duplicate offsets, and
       each (key, offset) maps to the message acked at that offset;
-    - committed offsets are bounded by ``max acked + 1 + unacked_k``:
-      the allocator and the commit dance share one lin-kv key, so a
-      dance whose read satisfies the request legitimately LEARNS the
-      allocator's next-offset value — one past the last allocation
-      (the overshoot quirk, logmap.go:156-158) — and under message
-      loss each indeterminate send (``unacked_sends`` per key: CAS
+    - committed offsets: with ``unacked_sends=None`` (the
+      deterministic, loss-free regime where every replicate lands
+      before any commit can race it) the tight ``committed <= max
+      acked`` bound holds; with a dict (async/faulted regimes) the
+      bound is ``max acked + 1 + unacked_k``: the allocator and the
+      commit dance share one lin-kv key, so a dance whose read
+      satisfies the request legitimately LEARNS the allocator's
+      next-offset value — one past the last allocation (the overshoot
+      quirk, logmap.go:156-158) — and each indeterminate send (CAS
       possibly landed, ack never seen) may have bumped the cell once
-      more.  An idealized ``committed <= max acked`` bound would fail
-      correct reference behavior (survey §7 "weak semantics").
+      more.  An idealized always-tight bound would fail correct
+      reference behavior (survey §7 "weak semantics").
     """
     problems: list[str] = []
     by_key: dict[str, dict[int, int]] = {}
@@ -113,14 +116,17 @@ def check_kafka(send_acks: list[tuple[str, int, int]],
                     problems.append(
                         f"poll {key}@{o} = {m}, acked send was {want}")
 
+    weak = unacked_sends is not None
     unacked = unacked_sends or {}
     for key, coff in committed.items():
         max_off = max(by_key.get(key, {0: 0}))
-        bound = max_off + 1 + unacked.get(key, 0)
+        bound = (max_off + 1 + unacked.get(key, 0) if weak
+                 else max_off)
         if coff > bound:
             problems.append(
-                f"committed {key}@{coff} > max alloc {max_off} + "
-                f"overshoot 1 + {unacked.get(key, 0)} indeterminate")
+                f"committed {key}@{coff} > max alloc {max_off}"
+                + (f" + overshoot 1 + {unacked.get(key, 0)} "
+                   "indeterminate" if weak else ""))
 
     return not problems, {"n_sends": len(send_acks),
                           "n_keys": len(by_key),

@@ -1051,6 +1051,9 @@ def _delayed_impl(topology: str, n: int, dir_delays,
     if any(d < 1 for d in dd):
         raise ValueError("direction delays are rounds >= 1")
     ring = max(dd)
+    if halo is None:
+        halo = has_sharded_exchange(topology, n, n_shards,
+                                    axis_name=axis_name, **kw)
 
     def take(hist, t, d):
         return _take_delayed(hist, t, dd[d], ring)
@@ -1069,8 +1072,7 @@ def _delayed_impl(topology: str, n: int, dir_delays,
             return fp | fk
 
         sex = None
-        if has_sharded_exchange(topology, n, n_shards,
-                                axis_name=axis_name, **kw):
+        if halo:
             def sex(hist, t, lv):
                 fp = m(tree_parent_payload(take(hist, t, 0), n,
                                            n_shards, k, axis_name),
@@ -1133,8 +1135,7 @@ def _delayed_impl(topology: str, n: int, dir_delays,
             return up | down | left | right
 
         sex = None
-        if has_sharded_exchange(topology, n, n_shards,
-                                axis_name=axis_name, **kw):
+        if halo:
             def sex(hist, t, lv):
                 block = hist.shape[2]
                 up = m(sharded_shift(take(hist, t, 0), cols, n_shards,
@@ -1167,8 +1168,7 @@ def _delayed_impl(topology: str, n: int, dir_delays,
                     | m(line_terms(z, pb), lv, 1, 1))
 
         sex = None
-        if has_sharded_exchange(topology, n, n_shards,
-                                axis_name=axis_name, **kw):
+        if halo:
             def sex(hist, t, lv):
                 return (m(sharded_shift(take(hist, t, 0), 1, n_shards,
                                         axis_name), lv, 0, 0)
