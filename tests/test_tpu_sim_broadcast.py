@@ -1397,3 +1397,32 @@ def test_delayed_structured_checkpoint_roundtrip(tmp_path):
         a, b = sim.step(a), sim.step(b)
     assert (np.asarray(a.received) == np.asarray(b.received)).all()
     assert int(a.msgs) == int(b.msgs)
+
+
+def test_fault_dir_senders_cover_adjacency_exactly():
+    # the direction-row contract everything leans on (masked
+    # exchanges, delay classes, gather bridges): for every node, the
+    # existing per-direction senders must be EXACTLY its neighbor
+    # multiset from the topology builders — no edge missed, none
+    # invented, none duplicated
+    from collections import Counter
+
+    from gossip_glomers_tpu.parallel.topology import circulant, ring
+    from gossip_glomers_tpu.tpu_sim.structured import fault_dir_senders
+
+    cases = [("tree", 85, {}, to_padded_neighbors(tree(85))),
+             ("tree", 64, {"branching": 2},
+              to_padded_neighbors(tree(64, 2))),
+             ("grid", 60, {}, to_padded_neighbors(grid(60))),
+             ("grid", 64, {"cols": 5},
+              to_padded_neighbors(grid(64, 5))),
+             ("ring", 32, {}, to_padded_neighbors(ring(32))),
+             ("line", 17, {}, to_padded_neighbors(line(17))),
+             ("circulant", 64, {"strides": [1, 5, 21]},
+              circulant(64, [1, 5, 21]))]
+    for topo, n, kw, nbrs in cases:
+        snd = fault_dir_senders(topo, n, **kw)
+        for i in range(n):
+            from_rows = Counter(int(s) for s in snd[:, i] if s >= 0)
+            from_adj = Counter(int(x) for x in nbrs[i] if x >= 0)
+            assert from_rows == from_adj, (topo, n, i)
