@@ -1420,6 +1420,32 @@ def test_fault_dir_senders_cover_adjacency_exactly():
              ("line", 17, {}, to_padded_neighbors(line(17))),
              ("circulant", 64, {"strides": [1, 5, 21]},
               circulant(64, [1, 5, 21]))]
+
+    def circulant_by_hand(n, strides):
+        # independent construction (set arithmetic, not the (i±s)%n
+        # formula the production code shares) so the circulant case is
+        # not a tautology
+        out = []
+        for i in range(n):
+            row = []
+            for s in strides:
+                for j in range(n):
+                    if (j - i) % n == s % n or (i - j) % n == s % n:
+                        row.extend([j] * (2 if (2 * s) % n == 0
+                                          and (j - i) % n == s % n
+                                          else 1))
+            out.append(row)
+        return out
+
+    by_hand = circulant_by_hand(64, [1, 5, 21])
+    cases.append(("circulant", 64, {"strides": [1, 5, 21]},
+                  [r + [-1] for r in by_hand]))
+    # the self-aliasing stride n/2: the builder and the direction rows
+    # both list the single physical edge TWICE (one row per direction)
+    # — a documented quirk expander_strides avoids; the two sources
+    # must still agree exactly
+    cases.append(("circulant", 8, {"strides": [4]},
+                  circulant(8, [4])))
     for topo, n, kw, nbrs in cases:
         snd = fault_dir_senders(topo, n, **kw)
         for i in range(n):
