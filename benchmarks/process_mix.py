@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Head-to-head msgs/op: our stdio nodes vs the reference Go binary.
+
+The reference README publishes ONE efficiency number — "fewer than 20
+messages per broadcast operation" (/root/reference/README.md:17) —
+measured by Maelstrom as whole-run server-to-server messages divided by
+ALL completed client ops (reads included, Maelstrom 3d/3e accounting).
+This benchmark runs the IDENTICAL mixed broadcast+read workload through
+the in-repo process harness (harness/process_net.py — real OS
+processes, pipes, one shared router/ledger) against BOTH stacks and
+reports both numbers under the same ledger:
+
+- the checked-in Go artifact (/root/reference/broadcast/
+  maelstrom-broadcast) — pure eager flood (the artifact predates its
+  source's anti-entropy; pinned by
+  tests/test_process_parity.py::test_go_binary_has_no_anti_entropy);
+- our node (gossip_glomers_tpu.nodes.broadcast), run BOTH in the same
+  flood-only regime (GG_SYNC_INTERVAL pushed out of the window — the
+  apples-to-apples row) and in its default anti-entropy regime (the
+  robustness the artifact lacks, priced separately).
+
+Topology, node count, rate, read share, duration, and seed are shared;
+the op stream is generated once per (topology, seed) so both stacks
+see the same sequence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import random
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gossip_glomers_tpu.harness.process_net import ProcessNetwork  # noqa: E402
+from gossip_glomers_tpu.parallel.topology import (grid, to_name_map,  # noqa: E402
+                                                  tree)
+
+GO_BROADCAST = "/root/reference/broadcast/maelstrom-broadcast"
+PY_NODE = [sys.executable, "-m", "gossip_glomers_tpu.nodes.broadcast"]
+
+
+def make_ops(n_nodes: int, rate: float, duration: float,
+             read_share: float, seed: int) -> list[tuple[str, str, int]]:
+    """The shared client op stream: [(op, node, value|-1), ...] —
+    generated once so every stack sees the identical sequence."""
+    rng = random.Random(seed)
+    ops = []
+    next_value = 0
+    for _ in range(int(rate * duration)):
+        nid = f"n{rng.randrange(n_nodes)}"
+        if rng.random() < read_share:
+            ops.append(("read", nid, -1))
+        else:
+            ops.append(("broadcast", nid, next_value))
+            next_value += 1
+    return ops
+
+
+def run_mix(argv: list[str], *, n_nodes: int = 25,
+            topology: str = "tree", rate: float = 50.0,
+            duration: float = 12.0, read_share: float = 0.5,
+            seed: int = 0, extra_env: dict | None = None,
+            quiesce_s: float = 3.0) -> dict:
+    """Drive the mixed workload into one stack; return the Maelstrom-
+    accounted ledger (server msgs / ALL completed client ops)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    ops = make_ops(n_nodes, rate, duration, read_share, seed)
+    adj = tree(n_nodes) if topology == "tree" else grid(n_nodes)
+    net = ProcessNetwork()
+    try:
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            list(pool.map(lambda i: net.spawn(f"n{i}", argv,
+                                              extra_env=extra_env),
+                          range(n_nodes)))
+        net.init_cluster(timeout=60.0)
+        net.set_topology(to_name_map(adj))
+        n_ops = 0
+        n_broadcast = 0
+        acked = set()
+        t0 = time.monotonic()
+        for i, (op, nid, val) in enumerate(ops):
+            # rate pacing on the wall clock (Maelstrom-style open loop,
+            # collapsed to closed-loop rpc per op: at these rates the
+            # rpc round-trip is far below the inter-op gap)
+            lag = t0 + i / rate - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            body = ({"type": "read"} if op == "read"
+                    else {"type": "broadcast", "message": val})
+            try:
+                rep = net.rpc(nid, body, timeout=30.0)
+            except TimeoutError:
+                rep = {}     # unacked op: not counted, run not aborted
+            if op == "read":
+                if rep.get("type") == "read_ok":
+                    n_ops += 1
+            elif rep.get("type") == "broadcast_ok":
+                n_ops += 1
+                n_broadcast += 1
+                acked.add(val)
+        # whole-run accounting: let in-flight gossip drain (and any
+        # anti-entropy waves fire) before reading the ledger
+        time.sleep(quiesce_s)
+        net.quiesce(idle=0.3, timeout=10.0)
+        server_msgs = net.server_to_server
+        reads = {}
+        for i in range(n_nodes):
+            try:
+                rep = net.rpc(f"n{i}", {"type": "read"}, timeout=30.0)
+            except TimeoutError:
+                rep = {}     # missing read -> converged=False below
+            reads[f"n{i}"] = sorted(rep.get("messages") or [])
+        want = sorted(acked)
+        converged = all(r == want for r in reads.values())
+        return {
+            "ok": bool(converged and n_ops == len(ops)),
+            "n_ops": n_ops,
+            "n_broadcast": n_broadcast,
+            "server_msgs": server_msgs,
+            "msgs_per_op": round(server_msgs / max(n_ops, 1), 2),
+            "server_msgs_by_type": dict(net.server_msgs_by_type),
+        }
+    finally:
+        net.shutdown()
+
+
+def head_to_head(topology: str, *, n_nodes: int = 25,
+                 rate: float = 50.0, duration: float = 12.0,
+                 read_share: float = 0.5, seed: int = 0) -> dict:
+    """All three rows for one topology: Go artifact, ours flood-only
+    (identical regime), ours with default anti-entropy."""
+    kw = dict(n_nodes=n_nodes, topology=topology, rate=rate,
+              duration=duration, read_share=read_share, seed=seed)
+    rows = {}
+    if os.path.exists(GO_BROADCAST):
+        rows["go"] = run_mix([GO_BROADCAST], **kw)
+    rows["ours_flood"] = run_mix(
+        PY_NODE, extra_env={"GG_SYNC_INTERVAL": "600"}, **kw)
+    rows["ours_anti_entropy"] = run_mix(PY_NODE, **kw)
+    out = {
+        "config": f"process-mix-{topology}-{n_nodes}",
+        "accounting": "maelstrom (server msgs / ALL client ops, "
+                      "reads included)",
+        "rate_ops_per_s": rate, "duration_s": duration,
+        "read_share": read_share,
+        **rows,
+    }
+    if "go" in rows:
+        out["ours_vs_go"] = round(
+            rows["ours_flood"]["msgs_per_op"]
+            / max(rows["go"]["msgs_per_op"], 1e-9), 3)
+        out["ok"] = bool(
+            rows["go"]["ok"] and rows["ours_flood"]["ok"]
+            and rows["ours_flood"]["msgs_per_op"]
+            <= rows["go"]["msgs_per_op"] + 1e-9)
+    else:
+        out["ok"] = bool(rows["ours_flood"]["ok"])
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--topology", default="tree,grid")
+    ap.add_argument("--nodes", type=int, default=25)
+    ap.add_argument("--rate", type=float, default=50.0)
+    ap.add_argument("--duration", type=float, default=12.0)
+    args = ap.parse_args()
+    for topo in args.topology.split(","):
+        print(json.dumps(head_to_head(topo, n_nodes=args.nodes,
+                                      rate=args.rate,
+                                      duration=args.duration)))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,7 +41,8 @@ def _check_kv_linearizable(trace, service_id: str,
     for k, hist in sorted(histories_from_kv_trace(trace,
                                                   service_id).items()):
         k_ok, d = check_linearizable(hist)
-        by_key[k] = {"ok": k_ok, "n_ops": d["n_ops"]}
+        by_key[k] = {"ok": k_ok, "n_ops": d["n_ops"],
+                     "verdict": d["verdict"]}
         ok = ok and k_ok
     details["linearizable"] = ok
     details["lin_by_key"] = by_key

@@ -484,6 +484,7 @@ class BroadcastSim:
                  srv_ledger: bool = True,
                  faulted=None,
                  delayed=None,
+                 edge_delayed=None,
                  ) -> None:
         """``srv_ledger``: keep the reference-accounted server-message
         ledger (default).  It costs a second adjacency pass per round
@@ -515,7 +516,18 @@ class BroadcastSim:
         ledger follows the gather path's documented current-state
         approximation under delays: supply ``sync_diff``/
         ``sharded_sync_diff`` for the plain delayed mode (the
-        FaultedDelayed bundle carries its own masked diffs)."""
+        FaultedDelayed bundle carries its own masked diffs).
+
+        ``edge_delayed`` (structured.EdgeDelays, make_edge_delayed):
+        RANDOM per-edge delays over a small static value set on the
+        words-major path — Maelstrom's default latency model
+        (random per hop) at structured speed.  The delay rows ride as
+        one traced (D, N) array (node-sharded on the halo path).
+        Mutually exclusive with ``delays``/``delayed``/``faulted`` and
+        with partition schedules (compose via the gather path for
+        now); the srv ledger gates exactly like the plain delayed
+        mode (caller-supplied sync_diff closures, current-state
+        approximation)."""
         n = nbrs.shape[0]
         self.n_nodes = n
         self.n_values = n_values
@@ -535,6 +547,23 @@ class BroadcastSim:
         self.sharded_sync_diff = sharded_sync_diff
         n_windows = int(self.parts.starts.shape[0])
         self._delayed = delayed
+        self._edge = edge_delayed
+        if edge_delayed is not None:
+            if not self.words_major:
+                raise ValueError("edge_delayed needs a structured "
+                                 "exchange")
+            if delays is not None or delayed is not None \
+                    or faulted is not None or n_windows > 0:
+                raise ValueError(
+                    "edge_delayed is mutually exclusive with delays/"
+                    "delayed/faulted and partition schedules (compose "
+                    "random per-edge delays with faults via the gather "
+                    "path)")
+            if mesh is not None and edge_delayed.sharded_exchange \
+                    is None:
+                raise ValueError(
+                    "edge-delayed structured delivery on a mesh needs "
+                    "the halo closure (no all_gather fallback)")
         # composed mode: a FaultedDelayed bundle carries its own masks
         # (delays AND partition windows on the structured path)
         self._df = delayed is not None and hasattr(delayed, "same")
@@ -600,6 +629,12 @@ class BroadcastSim:
                 sync_diff is not None if mesh is None
                 else (self._delayed.sharded_exchange is not None
                       and sharded_sync_diff is not None))
+        elif self._edge is not None:
+            # edge-delayed: gates exactly like plain delayed
+            self._srv_on = srv_ledger and (
+                sync_diff is not None if mesh is None
+                else (self._edge.sharded_exchange is not None
+                      and sharded_sync_diff is not None))
         elif self._faulted is not None:
             f = self._faulted
             self._srv_on = srv_ledger and (
@@ -624,6 +659,8 @@ class BroadcastSim:
                        else jnp.asarray(delays, jnp.int32))
         if delayed is not None:
             self.ring = delayed.ring
+        elif edge_delayed is not None:
+            self.ring = edge_delayed.ring
         else:
             self.ring = 1 if delays is None else int(delays.max())
         # distinct delay values, static: delivery runs one masked
@@ -662,6 +699,16 @@ class BroadcastSim:
             self.deg = (jax.device_put(jnp.asarray(deg),
                                        NamedSharding(mesh, P("nodes")))
                         if mesh is not None else jnp.asarray(deg))
+            if self._edge is not None:
+                # delay rows ride as one traced (D, N) array, sharded
+                # with the node axis on the halo path (receiver-side
+                # rows, local masking, zero extra ICI)
+                rows = jnp.asarray(self._edge.delay_rows, jnp.int32)
+                if mesh is not None:
+                    self._ed_spec = P(None, "nodes")
+                    rows = jax.device_put(
+                        rows, NamedSharding(mesh, self._ed_spec))
+                self._ed_rows = rows
             masked_src = (self._faulted if self._faulted is not None
                           else self._delayed if self._df else None)
             if masked_src is not None:
@@ -706,7 +753,7 @@ class BroadcastSim:
             received = jax.device_put(
                 received, NamedSharding(self.mesh, self._state_spec))
         history = None
-        if self._delayed is not None:
+        if self._delayed is not None or self._edge is not None:
             # words-major ring of past LOCAL payload blocks (L, W, N),
             # node-sharded like the state
             history = jnp.zeros(
@@ -813,6 +860,18 @@ class BroadcastSim:
         else:
             sync_base_once = lambda b: b  # noqa: E731
         f = self._faulted
+        if self._edge is not None:
+            # halo-only (constructor enforces sharded_exchange); the
+            # delay rows arrive node-sharded, masking is local
+            (rows,) = masks
+            eex = self._edge.sharded_exchange
+            return _round_wm(
+                state, deg=deg, sync_every=self.sync_every,
+                exchange=self.exchange,
+                reduce_sum=lambda s: lax.psum(s, mesh_axes),
+                sync_diff=self.sharded_sync_diff,
+                sync_base_once=sync_base_once,
+                delayed_exchange=lambda h, t: eex(h, t, rows))
         if self._delayed is not None:
             # halo-only (constructor enforces sharded_exchange)
             if masks is not None:      # composed faulted-delayed mode
@@ -867,7 +926,8 @@ class BroadcastSim:
         state_spec = self._state_spec
         hist_spec = (P(None, *state_spec)       # node-sharded ring
                      if (self.delays is not None
-                         or self._delayed is not None) else None)
+                         or self._delayed is not None
+                         or self._edge is not None) else None)
         srv_spec = P() if self._srv_on else None
         return (BroadcastState(state_spec, state_spec, P(), P(),
                                hist_spec, srv_spec),
@@ -881,6 +941,13 @@ class BroadcastSim:
         per-node arrays are not baked into every traced program as
         constants."""
         f = self._faulted
+        if self._edge is not None:
+            (rows,) = masks
+            eex = self._edge.exchange
+            return _round_wm(
+                state, deg=deg, sync_every=self.sync_every,
+                exchange=self.exchange, sync_diff=self.sync_diff,
+                delayed_exchange=lambda h, t: eex(h, t, rows))
         if self._delayed is not None:
             if masks is not None:      # composed faulted-delayed mode
                 lr = self._live_rows(*masks)
@@ -907,8 +974,10 @@ class BroadcastSim:
 
     def _wm_extra_args(self):
         """The masked words-major modes' extra traced arguments: mask
-        arrays + window rounds (empty when neither faulted nor
-        faulted-delayed)."""
+        arrays + window rounds (faulted modes) or the delay rows
+        (edge-delayed mode); empty otherwise."""
+        if self._edge is not None:
+            return (self._ed_rows,)
         if self._faulted is None and not self._df:
             return ()
         return (self._f_exists, self._f_same, self.parts.starts,
@@ -918,6 +987,8 @@ class BroadcastSim:
         """Extra (in_specs, args) the sharded words-major programs
         thread through shard_map in masked modes: the mask arrays and
         the window rounds (explicit args, not closure captures)."""
+        if self._edge is not None:
+            return ((self._ed_spec,), (self._ed_rows,))
         if self._faulted is None and not self._df:
             return (), ()
         e_spec, s_spec = self._f_specs
@@ -1146,6 +1217,7 @@ class BroadcastSim:
         # test_fixed_flood_specialization_matches_while_runner.
         flood_ok = (wm and not self._srv_on and self.delays is None
                     and self._faulted is None and self._delayed is None
+                    and self._edge is None
                     and rounds <= sync_every and rounds > 0)
 
         if self.mesh is None and flood_ok:

@@ -94,20 +94,42 @@ class CounterSim:
     def __init__(self, n_nodes: int, *, mode: str = "cas",
                  poll_every: int = 4,
                  kv_sched: KVReach | None = None,
-                 mesh: Mesh | None = None, seed: int = 0) -> None:
+                 mesh: Mesh | None = None, seed: int = 0,
+                 winner_key: str = "auto") -> None:
         if mode not in ("cas", "allreduce"):
             raise ValueError(f"unknown mode {mode!r}")
+        if winner_key not in ("auto", "packed", "wide"):
+            raise ValueError(f"unknown winner_key {winner_key!r}")
         self.n_nodes = n_nodes
         self.mode = mode
         self.poll_every = poll_every
         self.mesh = mesh
         self.seed = seed
-        # cas-winner key layout: per-round hashed priority in the high
-        # bits, row id in the low bits (tie-break + winner recovery);
-        # both must fit an int32 for the pmin collective
+        # cas-winner key layouts:
+        # - "packed" (n < 2^24): per-round hashed priority in the high
+        #   bits, row id in the low bits (tie-break + winner recovery),
+        #   packed into one int32 for a single pmin collective.
+        # - "wide" (any n < 2^31): the packed key would truncate the
+        #   priority below useful entropy, so the argmin splits into TWO
+        #   collectives — pmin the full 32-bit hashed priority, then
+        #   pmin the row id among rows achieving it (lowest-row
+        #   tie-break, matching the packed layout's semantics).  This
+        #   lifts the 2^24-node cap to the broadcast path's demonstrated
+        #   16.8M+ reach at the cost of one extra pmin per round.
+        # "auto" keeps the measured-and-pinned packed behavior wherever
+        # it fits and switches to wide only when it must.
         self._row_bits = max(1, (n_nodes - 1).bit_length())
-        if self._row_bits > 24:
-            raise ValueError("cas winner keys support n_nodes < 2^24")
+        # strict: at n == 2^31 the wide row sentinel (int32 max) would
+        # collide with the last row id, and int32(n) itself overflows
+        if mode == "cas" and n_nodes >= 2**31:
+            raise ValueError("cas winner keys support n_nodes < 2^31")
+        if winner_key == "packed" and self._row_bits > 24:
+            raise ValueError(
+                "packed cas winner keys support n_nodes < 2^24 (the "
+                "int31 key leaves too few priority bits beyond that); "
+                "use winner_key='wide' or 'auto'")
+        self._wide = (winner_key == "wide"
+                      or (winner_key == "auto" and self._row_bits > 24))
         self.kv_sched = (kv_sched if kv_sched is not None
                          else KVReach.none(n_nodes))
         self._node_spec = P("nodes") if mesh is not None else None
@@ -173,21 +195,40 @@ class CounterSim:
             x = x ^ (x >> 16)
             x = x * jnp.uint32(0x7FEB352D)
             x = x ^ (x >> 15)
-            pri_bits = 31 - self._row_bits
-            # cap the priority below all-ones so a real key can never
-            # collide with the no-candidate sentinel
-            pri = jnp.minimum(
-                (x >> jnp.uint32(32 - pri_bits)).astype(jnp.int32),
-                jnp.int32(2**pri_bits - 2))
-            key = (pri << self._row_bits) | row_ids
-            candidates = jnp.where(fresh, key, jnp.int32(2**31 - 1))
-            local_min = jnp.min(candidates)
-            best = (local_min if psum is None
-                    else lax.pmin(local_min, "nodes"))
-            has_winner = best < jnp.int32(2**31 - 1)
-            winner = jnp.where(has_winner,
-                               best & jnp.int32((1 << self._row_bits) - 1),
-                               jnp.int32(self.n_nodes))
+            if self._wide:
+                # wide layout: argmin as two pmins — full-hash priority
+                # first (capped below the all-ones no-candidate
+                # sentinel), then lowest row id among its achievers
+                prix = jnp.minimum(x, jnp.uint32(0xFFFFFFFE))
+                cand_pri = jnp.where(fresh, prix,
+                                     jnp.uint32(0xFFFFFFFF))
+                lp = jnp.min(cand_pri)
+                best_pri = lp if psum is None else lax.pmin(lp, "nodes")
+                has_winner = best_pri < jnp.uint32(0xFFFFFFFF)
+                cand_row = jnp.where(fresh & (prix == best_pri),
+                                     row_ids, jnp.int32(2**31 - 1))
+                lr = jnp.min(cand_row)
+                best_row = (lr if psum is None
+                            else lax.pmin(lr, "nodes"))
+                winner = jnp.where(has_winner, best_row,
+                                   jnp.int32(self.n_nodes))
+            else:
+                pri_bits = 31 - self._row_bits
+                # cap the priority below all-ones so a real key can
+                # never collide with the no-candidate sentinel
+                pri = jnp.minimum(
+                    (x >> jnp.uint32(32 - pri_bits)).astype(jnp.int32),
+                    jnp.int32(2**pri_bits - 2))
+                key = (pri << self._row_bits) | row_ids
+                candidates = jnp.where(fresh, key, jnp.int32(2**31 - 1))
+                local_min = jnp.min(candidates)
+                best = (local_min if psum is None
+                        else lax.pmin(local_min, "nodes"))
+                has_winner = best < jnp.int32(2**31 - 1)
+                winner = jnp.where(
+                    has_winner,
+                    best & jnp.int32((1 << self._row_bits) - 1),
+                    jnp.int32(self.n_nodes))
             winner_delta = allsum(
                 jnp.where(row_ids == winner, state.pending, 0))
             kv = state.kv + jnp.where(has_winner, winner_delta, 0)

@@ -31,6 +31,7 @@ its row block back out.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, NamedTuple
 
 import jax
@@ -39,6 +40,28 @@ import numpy as np
 from jax import lax
 
 from ..parallel.topology import grid_cols
+
+
+def _roll_fold_window() -> tuple[int, int]:
+    """[lo, hi] W-window where tree_from_kids picks the lane-roll fold
+    over the reshape-fold.  The default was measured on this image's
+    tunneled TPU chip (benchmarks/midw_probe.py; one chip generation,
+    single session) — other generations may cross over elsewhere, so
+    the window is overridable via ``GG_ROLL_FOLD_W=lo,hi`` (e.g. "0,0"
+    disables the roll fold entirely).  Both lowerings are pinned
+    bit-identical, so the knob is performance-only."""
+    raw = os.environ.get("GG_ROLL_FOLD_W", "8,16")
+    parts = raw.split(",")
+    try:
+        lo, hi = (int(parts[0]), int(parts[1])) if len(parts) == 2 \
+            else (None, None)
+    except ValueError:
+        lo = None
+    if lo is None:
+        raise ValueError(
+            f"GG_ROLL_FOLD_W must be 'lo,hi' (two comma-separated "
+            f"ints), got {raw!r}")
+    return lo, hi
 
 
 def _zeros(payload: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -73,7 +96,8 @@ def tree_from_kids(payload: jnp.ndarray,
     k = branching
     n_parents = (n - 1 + k - 1) // k
     m = n_parents * k
-    if 8 <= w <= 16:
+    lo, hi = _roll_fold_window()
+    if lo <= w <= hi:
         # pad first so the rolls' lane wraparound only pulls zeros
         ext = jnp.concatenate([payload, _zeros(payload, k)], axis=1)
         z = ext
@@ -1268,3 +1292,301 @@ def make_delayed_faulted(topology: str, n: int, dir_delays,
             return sex_impl(hist, t, lv_by_delay(live_rows, t))
 
     return FaultedDelayed(exists, same, dd, max(dd), ex, sex, df, sdf)
+
+
+# -- per-EDGE random delays on the structured path ----------------------
+#
+# Maelstrom's default latency model is random per EDGE (reference
+# README.md:16 plus jitter), not per direction class — previously only
+# the adjacency gather could run it (~390x slower per round at 1M
+# nodes).  The decomposition that made partitions gather-free applies
+# here too: delays take values from a SMALL STATIC set, so a random
+# (D, N) per-direction-per-receiver delay matrix splits into
+# |delay_set| receiver-side boolean masks per direction —
+# ``rows[d] == v`` — and delivery is
+#
+#   inbox = OR over (d, v) of mask_cols(term_d(history@v), rows[d]==v)
+#
+# i.e. each direction reads each delay class's ring slice, masked to
+# the receivers whose edge has that delay.  Cost: D x |delay_set|
+# structured terms per round (still zero random access) instead of the
+# gather's per-edge reads.  The delay rows ride along as ONE traced
+# (D, N) int32 array (sharded with the node axis on the halo path);
+# the masks are computed on the fly by an elementwise compare.
+#
+# Row contract: grid/ring/line/circulant follow the fault direction
+# rows (receiver-side, :func:`fault_dir_senders` order).  The tree
+# takes TWO rows, both indexed at CHILD positions: row 0 = the
+# parent->child edge's delay (receiver = the child), row 1 = the
+# child->parent edge's delay (receiver = the parent; child-position
+# indexing is what lets the kids delivery mask the payload PRE-fold,
+# exactly like the fault mask).
+
+
+class EdgeDelays(NamedTuple):
+    """Per-edge-random delayed structured delivery (from
+    :func:`make_edge_delayed`).
+
+    - ``delay_rows``: (D, N) int32 host array (see the row contract
+      above); passed each round as a traced array, not baked into the
+      program.
+    - ``delay_set``: distinct delay values (static).
+    - ``ring``: history ring length == max delay.
+    - ``exchange(history, t, rows)``: full-axis closure over the
+      (L, W, N) ring -> (W, N) inbox.
+    - ``sharded_exchange(history, t, rows_local)``: halo-path closure
+      over LOCAL blocks (None when no halo decomposition exists; no
+      all_gather fallback — use the gather delayed path then)."""
+
+    delay_rows: np.ndarray
+    delay_set: tuple
+    ring: int
+    exchange: Callable
+    sharded_exchange: Callable | None
+
+
+def make_edge_delayed(topology: str, n: int, delay_rows,
+                      n_shards: int | None = None,
+                      axis_name: str = "nodes",
+                      **kw) -> EdgeDelays | None:
+    """Build the :class:`EdgeDelays` bundle for random per-edge delays
+    over a small static value set.  ``delay_rows``: (D, N) ints >= 1,
+    D = 2 for tree (see row contract), else the fault direction-row
+    count.  None for unstructured topologies.
+
+    Aliasing note: as with :func:`make_delayed`, two direction classes
+    that are one physical edge (circulant stride 2s ≡ 0 mod n) OR
+    their terms — the edge carries both rows' delays; the gather
+    bridge (:func:`gather_delays_from_rows`) raises instead."""
+    dr = np.asarray(delay_rows, np.int32)
+    if dr.min() < 1:
+        raise ValueError("edge delays are rounds >= 1")
+    delay_set = tuple(int(v) for v in np.unique(dr))
+    ring = max(delay_set)
+    # host-side presence: (d, v) pairs with no receiver are skipped
+    # entirely — a constant-rows matrix costs exactly make_delayed
+    present = {(d, v): bool((dr[d] == v).any())
+               for d in range(dr.shape[0]) for v in delay_set}
+    halo = has_sharded_exchange(topology, n, n_shards,
+                                axis_name=axis_name, **kw)
+
+    def take(hist, t, v):
+        return _take_delayed(hist, t, v, ring)
+
+    def acc(out, term):
+        return term if out is None else out | term
+
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        if dr.shape != (2, n):
+            raise ValueError("tree takes (2, N) delay rows "
+                             "(down, up — both at child positions)")
+
+        def ex(hist, t, rows):
+            out = None
+            for v in delay_set:
+                pv = take(hist, t, v)
+                if present[(0, v)]:
+                    out = acc(out, _mask_cols(tree_from_parent(pv, k),
+                                              rows[0] == v))
+                if present[(1, v)]:
+                    out = acc(out, tree_from_kids(
+                        _mask_cols(pv, rows[1] == v), k))
+            return out
+
+        sex = None
+        if halo:
+            def sex(hist, t, rows):
+                out = None
+                for v in delay_set:
+                    pv = take(hist, t, v)
+                    if present[(0, v)]:
+                        out = acc(out, _mask_cols(
+                            tree_parent_payload(pv, n, n_shards, k,
+                                                axis_name),
+                            rows[0] == v))
+                    if present[(1, v)]:
+                        out = acc(out, tree_kids_payload(
+                            _mask_cols(pv, rows[1] == v), n, n_shards,
+                            k, axis_name))
+                return out
+
+        return EdgeDelays(dr, delay_set, ring, ex, sex)
+
+    if topology in ("ring", "circulant"):
+        strides = [1] if topology == "ring" else list(kw["strides"])
+        if dr.shape != (2 * len(strides), n):
+            raise ValueError("circulant takes (2*len(strides), N) "
+                             "delay rows")
+
+        def ex(hist, t, rows):
+            out = None
+            for v in delay_set:
+                pv = take(hist, t, v)
+                for i, s in enumerate(strides):
+                    if present[(2 * i, v)]:
+                        out = acc(out, _mask_cols(
+                            jnp.roll(pv, s, axis=1), rows[2 * i] == v))
+                    if present[(2 * i + 1, v)]:
+                        out = acc(out, _mask_cols(
+                            jnp.roll(pv, -s, axis=1),
+                            rows[2 * i + 1] == v))
+            return out
+
+        sex = None
+        if n_shards is not None and n % n_shards == 0:
+            def sex(hist, t, rows):
+                out = None
+                for v in delay_set:
+                    pv = take(hist, t, v)
+                    for i, s in enumerate(strides):
+                        if present[(2 * i, v)]:
+                            out = acc(out, _mask_cols(
+                                sharded_roll(pv, s, n, n_shards,
+                                             axis_name),
+                                rows[2 * i] == v))
+                        if present[(2 * i + 1, v)]:
+                            out = acc(out, _mask_cols(
+                                sharded_roll(pv, -s, n, n_shards,
+                                             axis_name),
+                                rows[2 * i + 1] == v))
+                return out
+
+        return EdgeDelays(dr, delay_set, ring, ex, sex)
+
+    if topology == "grid":
+        cols = kw.get("cols") or grid_cols(n)
+        if dr.shape != (4, n):
+            raise ValueError("grid takes (4, N) delay rows "
+                             "(up, down, left, right)")
+
+        def ex(hist, t, rows):
+            out = None
+            for v in delay_set:
+                pv = take(hist, t, v)
+                z = _zeros(pv, pv.shape[1])
+                terms = (grid_terms(pv, z, z, z, cols),
+                         grid_terms(z, pv, z, z, cols),
+                         grid_terms(z, z, pv, z, cols),
+                         grid_terms(z, z, z, pv, cols))
+                for d in range(4):
+                    if present[(d, v)]:
+                        out = acc(out, _mask_cols(terms[d],
+                                                  rows[d] == v))
+            return out
+
+        sex = None
+        if halo:
+            def sex(hist, t, rows):
+                block = hist.shape[2]
+                start = jax.lax.axis_index(axis_name) * block
+                col_idx = (start
+                           + jnp.arange(block, dtype=jnp.int32)) % cols
+                lm = (col_idx < cols - 1)[None, :]
+                rm = (col_idx > 0)[None, :]
+                out = None
+                for v in delay_set:
+                    pv = take(hist, t, v)
+                    if present[(0, v)]:
+                        out = acc(out, _mask_cols(
+                            sharded_shift(pv, cols, n_shards,
+                                          axis_name), rows[0] == v))
+                    if present[(1, v)]:
+                        out = acc(out, _mask_cols(
+                            sharded_shift(pv, -cols, n_shards,
+                                          axis_name), rows[1] == v))
+                    if present[(2, v)]:
+                        lf = jnp.where(
+                            lm, sharded_shift(pv, 1, n_shards,
+                                              axis_name), 0)
+                        out = acc(out, _mask_cols(lf, rows[2] == v))
+                    if present[(3, v)]:
+                        rt = jnp.where(
+                            rm, sharded_shift(pv, -1, n_shards,
+                                              axis_name), 0)
+                        out = acc(out, _mask_cols(rt, rows[3] == v))
+                return out
+
+        return EdgeDelays(dr, delay_set, ring, ex, sex)
+
+    if topology == "line":
+        if dr.shape != (2, n):
+            raise ValueError("line takes (2, N) delay rows (fwd, bwd)")
+
+        def ex(hist, t, rows):
+            out = None
+            for v in delay_set:
+                pv = take(hist, t, v)
+                z = _zeros(pv, pv.shape[1])
+                if present[(0, v)]:
+                    out = acc(out, _mask_cols(line_terms(pv, z),
+                                              rows[0] == v))
+                if present[(1, v)]:
+                    out = acc(out, _mask_cols(line_terms(z, pv),
+                                              rows[1] == v))
+            return out
+
+        sex = None
+        if halo:
+            def sex(hist, t, rows):
+                out = None
+                for v in delay_set:
+                    pv = take(hist, t, v)
+                    if present[(0, v)]:
+                        out = acc(out, _mask_cols(
+                            sharded_shift(pv, 1, n_shards, axis_name),
+                            rows[0] == v))
+                    if present[(1, v)]:
+                        out = acc(out, _mask_cols(
+                            sharded_shift(pv, -1, n_shards, axis_name),
+                            rows[1] == v))
+                return out
+
+        return EdgeDelays(dr, delay_set, ring, ex, sex)
+
+    return None
+
+
+def gather_delays_from_rows(topology: str, n: int, delay_rows, nbrs,
+                            **kw) -> np.ndarray:
+    """The (N, D_adj) per-edge delays array (broadcast's gather path)
+    equivalent to per-direction-per-receiver ``delay_rows`` — the
+    bridge the EdgeDelays equivalence tests and mixed-path runs use.
+    Pad slots get 1.  Raises when aliased direction classes (circulant
+    2s ≡ 0 mod n) carry different delays for one physical edge."""
+    snd = fault_dir_senders(topology, n, **kw)
+    dr = np.asarray(delay_rows, np.int64)
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        if dr.shape != (2, n):
+            raise ValueError("tree takes (2, N) delay rows")
+        # receiver-side rows for the full fault-row layout: row 0 is
+        # already receiver-side (child); rows 1..k (child slot j at
+        # PARENT positions) read the up-delay at the child position
+        rows_recv = [dr[0]]
+        for j in range(k):
+            c = snd[1 + j]
+            rows_recv.append(np.where(
+                c >= 0, dr[1][np.clip(c, 0, n - 1)], 1))
+    else:
+        if dr.shape != (snd.shape[0], n):
+            raise ValueError(
+                f"{topology} takes ({snd.shape[0]}, N) delay rows")
+        rows_recv = list(dr)
+    nbrs = np.asarray(nbrs)
+    out = np.ones(nbrs.shape, np.int32)
+    assigned = np.zeros(nbrs.shape, bool)
+    for d, vals in enumerate(rows_recv):
+        s = snd[d]
+        mask = (nbrs == s[:, None]) & (s[:, None] >= 0)
+        want = np.broadcast_to(vals[:, None].astype(np.int32),
+                               nbrs.shape)
+        clash = assigned & mask & (out != want)
+        if clash.any():
+            raise ValueError(
+                "direction classes alias the same edge with different "
+                f"delays (direction row {d}); per-edge delays cannot "
+                "represent this")
+        out = np.where(mask, want, out)
+        assigned |= mask
+    return out

@@ -253,3 +253,29 @@ def test_linearize_check_bites_on_stale_cas_bug(monkeypatch):
     assert res.details["linearizable"] is False
     bad = [k for k, v in res.details["lin_by_key"].items() if not v["ok"]]
     assert bad, "at least one key history must fail certification"
+
+
+def test_state_budget_yields_unknown_not_hang():
+    # a pathological history (many concurrent indeterminate CASes) is
+    # exponential for Wing-Gong; the in-workload certification must
+    # stop at the max_states budget with verdict "unknown" (not a
+    # failure: budget exhaustion is not a linearizability violation)
+    # concurrent indeterminate writes + a read of a never-written
+    # value: every order is illegal, so the DFS must backtrack through
+    # exponentially many dead states before it could prove "fail"
+    ops = [Op(0.0, float("inf"), "write", (i,), None, maybe=True)
+           for i in range(12)]
+    ops.append(Op(0.0, 1.0, "read", (), 999))
+    ok, d = check_linearizable(ops, max_states=5)
+    assert ok is True
+    assert d["verdict"] == "unknown"
+    assert d["states_explored"] <= 5
+    # verdicts on decided searches stay "ok"/"fail"
+    ok2, d2 = check_linearizable(
+        [Op(0.0, 1.0, "write", (7,), "ok"),
+         Op(2.0, 3.0, "read", (), 7)])
+    assert ok2 and d2["verdict"] == "ok"
+    ok3, d3 = check_linearizable(
+        [Op(0.0, 1.0, "write", (7,), "ok"),
+         Op(2.0, 3.0, "read", (), 8)])
+    assert not ok3 and d3["verdict"] == "fail"

@@ -1452,3 +1452,156 @@ def test_fault_dir_senders_cover_adjacency_exactly():
             from_rows = Counter(int(s) for s in snd[:, i] if s >= 0)
             from_adj = Counter(int(x) for x in nbrs[i] if x >= 0)
             assert from_rows == from_adj, (topo, n, i)
+
+
+def test_roll_fold_window_env_override(monkeypatch):
+    # the W-gate for the tree_from_kids roll-fold lowering was measured
+    # on one chip generation; other generations can re-aim it without a
+    # code change — and every window choice stays bit-identical
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.integers(0, 1 << 32, (8, 85),
+                                 dtype=np.uint64).astype(np.uint32))
+    monkeypatch.setenv("GG_ROLL_FOLD_W", "0,0")      # reshape-fold
+    assert structured._roll_fold_window() == (0, 0)
+    a = np.asarray(structured.tree_from_kids(x))
+    monkeypatch.setenv("GG_ROLL_FOLD_W", "1,64")     # roll-fold
+    assert structured._roll_fold_window() == (1, 64)
+    b = np.asarray(structured.tree_from_kids(x))
+    monkeypatch.delenv("GG_ROLL_FOLD_W")
+    assert structured._roll_fold_window() == (8, 16)  # measured default
+    assert (a == b).all()
+
+
+def test_edge_delayed_structured_matches_gather_all_topologies():
+    # RANDOM per-edge delays on the structured path (EdgeDelays) must
+    # equal the gather path run with the bridged per-edge delays array
+    # (gather_delays_from_rows): received, rounds, msgs, and the srv
+    # ledger — Maelstrom's default latency model, gather-free
+    from gossip_glomers_tpu.parallel.topology import circulant, ring
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    cases = [("tree", 64, {}, 2),
+             ("grid", 64, {}, 4),
+             ("ring", 32, {}, 2),
+             ("line", 32, {}, 2),
+             ("circulant", 64, {"strides": [1, 5, 21]}, 6)]
+    builders = {"ring": lambda n, kw: to_padded_neighbors(ring(n)),
+                "circulant": lambda n, kw: circulant(n, kw["strides"]),
+                "tree": lambda n, kw: to_padded_neighbors(tree(n)),
+                "grid": lambda n, kw: to_padded_neighbors(grid(n)),
+                "line": lambda n, kw: to_padded_neighbors(line(n))}
+    rng = np.random.default_rng(17)
+    for topo, n, kw, n_dirs in cases:
+        nbrs = builders[topo](n, kw)
+        nv = min(n, 48)
+        inject = make_inject(n, nv)
+        rows = rng.choice([1, 2, 3], size=(n_dirs, n)).astype(np.int32)
+        gd = structured.gather_delays_from_rows(topo, n, rows, nbrs,
+                                                **kw)
+        ref = BroadcastSim(nbrs, n_values=nv, sync_every=6, delays=gd)
+        s1, r1 = ref.run(inject)
+        fast = BroadcastSim(
+            nbrs, n_values=nv, sync_every=6,
+            exchange=structured.make_exchange(topo, n, **kw),
+            sync_diff=structured.make_sync_diff(topo, n, **kw),
+            edge_delayed=structured.make_edge_delayed(topo, n, rows,
+                                                      **kw))
+        s2, r2 = fast.run(inject)
+        assert r1 == r2, (topo, n)
+        assert (ref.received_node_major(s1)
+                == fast.received_node_major(s2)).all(), topo
+        assert int(s1.msgs) == int(s2.msgs), topo
+        assert ref.server_msgs(s1) == fast.server_msgs(s2), topo
+        # constant rows must also reproduce make_delayed exactly
+        const = np.full((n_dirs, n), 2, np.int32)
+        dd = (2,) * n_dirs
+        a = BroadcastSim(
+            nbrs, n_values=nv, sync_every=6,
+            exchange=structured.make_exchange(topo, n, **kw),
+            delayed=structured.make_delayed(topo, n, dd, **kw))
+        b = BroadcastSim(
+            nbrs, n_values=nv, sync_every=6,
+            exchange=structured.make_exchange(topo, n, **kw),
+            edge_delayed=structured.make_edge_delayed(topo, n, const,
+                                                      **kw))
+        sa, ra = a.run(inject)
+        sb, rb = b.run(inject)
+        assert ra == rb and (a.received_node_major(sa)
+                             == b.received_node_major(sb)).all(), topo
+
+
+def test_edge_delayed_sharded_matches_single_device():
+    from gossip_glomers_tpu.parallel.topology import circulant
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    cases = [("tree", 64, {}, 2),
+             ("circulant", 128, {"strides": [1, 5, 33]}, 6),
+             ("grid", 256, {}, 4),
+             ("line", 64, {}, 2)]
+    builders = {"circulant": lambda n, kw: circulant(n, kw["strides"]),
+                "tree": lambda n, kw: to_padded_neighbors(tree(n)),
+                "grid": lambda n, kw: to_padded_neighbors(grid(n)),
+                "line": lambda n, kw: to_padded_neighbors(line(n))}
+    rng = np.random.default_rng(23)
+    for topo, n, kw, n_dirs in cases:
+        nbrs = builders[topo](n, kw)
+        nv = 48
+        inject = make_inject(n, nv)
+        rows = rng.choice([1, 3], size=(n_dirs, n)).astype(np.int32)
+        ref = BroadcastSim(
+            nbrs, n_values=nv, sync_every=6,
+            exchange=structured.make_exchange(topo, n, **kw),
+            sync_diff=structured.make_sync_diff(topo, n, **kw),
+            edge_delayed=structured.make_edge_delayed(topo, n, rows,
+                                                      **kw))
+        s1, r1 = ref.run(inject)
+        for mesh, pdim in ((mesh_1d(), 8), (mesh_2d(), 4)):
+            ed = structured.make_edge_delayed(topo, n, rows,
+                                              n_shards=pdim, **kw)
+            assert ed.sharded_exchange is not None, (topo, n)
+            sim = BroadcastSim(
+                nbrs, n_values=nv, sync_every=6, mesh=mesh,
+                exchange=structured.make_exchange(topo, n, **kw),
+                sync_diff=structured.make_sync_diff(topo, n, **kw),
+                sharded_sync_diff=structured.make_sharded_sync_diff(
+                    topo, n, pdim, **kw),
+                edge_delayed=ed)
+            st0 = sim.init_state(inject)
+            ring_shape = st0.history.sharding.shard_shape(
+                st0.history.shape)
+            w_local = (sim.n_words // 2 if "words" in mesh.axis_names
+                       else sim.n_words)
+            assert ring_shape == (sim.ring, w_local, n // pdim)
+            s2, r2 = sim.run(inject)
+            assert r1 == r2, (topo, mesh.axis_names)
+            assert (ref.received_node_major(s1)
+                    == sim.received_node_major(s2)).all()
+            assert int(s1.msgs) == int(s2.msgs)
+            assert ref.server_msgs(s1) == sim.server_msgs(s2), \
+                (topo, mesh.axis_names)
+            s3, r3 = sim.run_fused(inject)
+            assert r1 == r3
+            st0b, _tg = sim.stage(inject)
+            s4 = sim.run_staged_fixed(st0b, r1)
+            assert (ref.received_node_major(s1)
+                    == sim.received_node_major(s4)).all()
+
+
+def test_edge_delays_bridge_rejects_aliased_directions():
+    # circulant stride with 2s == 0 (mod n): +s and -s are one edge —
+    # different per-edge delays on the two rows cannot be represented
+    from gossip_glomers_tpu.parallel.topology import circulant
+    from gossip_glomers_tpu.tpu_sim import structured
+
+    n, strides = 8, [4]
+    nbrs = circulant(n, strides)
+    rows = np.stack([np.full(n, 1, np.int32), np.full(n, 3, np.int32)])
+    with pytest.raises(ValueError, match="alias"):
+        structured.gather_delays_from_rows("circulant", n, rows, nbrs,
+                                           strides=strides)
+    rows_eq = np.full((2, n), 2, np.int32)
+    out = structured.gather_delays_from_rows("circulant", n, rows_eq,
+                                             nbrs, strides=strides)
+    assert (out[nbrs >= 0] == 2).all()

@@ -8,6 +8,7 @@ and collectives as real multi-chip TPU).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh
 
 from gossip_glomers_tpu.tpu_sim import (CounterSim, EchoSim, KafkaSim,
@@ -404,3 +405,52 @@ def test_kafka_poll_batch_and_alloc_match_host_reference():
         assert got_pairs == expect, i
         # and the single-query wrapper agrees
         assert sim.poll(st, int(pn[i]), int(pk[i]), int(pf[i])) == expect
+
+
+def test_counter_cas_wide_winner_backends_and_sum():
+    # the wide (two-pmin) winner layout — the >= 2^24-node regime,
+    # exercised at small n via the winner_key knob — must stay
+    # bit-exact between the single-device and sharded backends and
+    # drain to the exact sum
+    n = 16
+    deltas = np.arange(1, n + 1, dtype=np.int32)
+    ref = CounterSim(n, mode="cas", poll_every=2, seed=3,
+                     winner_key="wide")
+    st1 = ref.run(ref.add(ref.init_state(), deltas), 2 * n)
+    shd = CounterSim(n, mode="cas", poll_every=2, mesh=mesh_1d(),
+                     seed=3, winner_key="wide")
+    st2 = shd.run(shd.add(shd.init_state(), deltas), 2 * n)
+    assert (np.asarray(st1.pending) == np.asarray(st2.pending)).all()
+    assert (np.asarray(st1.cached) == np.asarray(st2.cached)).all()
+    assert int(st1.kv) == int(st2.kv) == int(deltas.sum())
+    assert int(st1.msgs) == int(st2.msgs)
+
+
+def test_counter_cas_wide_winner_distribution_uniform():
+    # the wide layout keeps the randomized (not lowest-index) winner
+    import collections
+
+    n, trials = 8, 400
+    wins = collections.Counter()
+    for seed in range(trials):
+        sim = CounterSim(n, mode="cas", poll_every=0, seed=seed,
+                         winner_key="wide")
+        st = sim.add(sim.init_state(), np.ones(n, np.int32))
+        st2 = sim.step(st)
+        drained = np.asarray(st.pending) - np.asarray(st2.pending)
+        (winner,) = np.nonzero(drained)[0]
+        wins[int(winner)] += 1
+    assert len(wins) == n, f"some nodes never win: {dict(wins)}"
+    expect = trials / n
+    assert all(0.4 * expect <= c <= 1.9 * expect
+               for c in wins.values()), dict(wins)
+
+
+def test_counter_cas_node_cap_lifted():
+    # n >= 2^24 used to raise; it now auto-selects the wide layout
+    # (the 16.8M-node reach the broadcast path demonstrated)
+    sim = CounterSim(1 << 25, mode="cas")
+    assert sim._wide
+    assert not CounterSim(1 << 10, mode="cas")._wide
+    with pytest.raises(ValueError, match="2\\^31"):
+        CounterSim(1 << 31, mode="cas")
