@@ -1,19 +1,47 @@
 #!/usr/bin/env python
-"""Pallas kernel probe for the mid-W tree exchange.
+"""Pallas kernel probe for the mid-W tree exchange — run, with outcome.
 
-benchmarks/midw_probe.py measured XLA lowerings only; ARCHITECTURE.md's
-claim that a kernel cannot beat the retile was argument.  This probe
-writes the actual kernel: one fused pass per N-tile that DMAs the
-tile's kids range (4T+8 lanes) and parent range (T/4+8 lanes) from HBM
-into VMEM, computes from_parent | from_kids with VMEM-resident
-roll/repeat folds, and writes one (W, T) output tile — ~5.3 logical
-passes over the bitset per round, the same traffic the XLA tree
-exchange needs, but with the lane shuffles guaranteed VMEM-local.
+benchmarks/midw_probe.py measured the XLA lowerings (reshape-fold vs
+roll-fold; the measured W-gate lives in structured.tree_from_kids).
+ARCHITECTURE.md's claim that a hand kernel cannot take the mid-W lever
+was, until this ran, argument.  This probe settles it empirically on
+the real chip.
 
-Verified bit-exact against structured.tree_exchange, then timed with
-the chained methodology at W in {8, 16, 32} (1M nodes, k=4) against
-the production tree_exchange (which already picks its lowering by the
-measured W-gate).  Prints one JSON line.
+The kernel idea: one fused pass per N-tile that DMAs the tile's kids
+range (4T+8 lanes) and parent range (T/4+8 lanes) from HBM into VMEM
+and computes ``from_parent | from_kids`` with VMEM-resident lane
+shuffles — the same logical traffic as the XLA tree exchange but with
+the retile guaranteed VMEM-local.
+
+MEASURED OUTCOME (v5e, jax 0.9.0 Mosaic): the kernel is
+**unlowerable**.  The child fold needs a 4:1 lane compress
+(``z[:, 1::4]`` — every 4th lane to dense positions), and every
+expressible formulation hits a missing Mosaic lowering:
+
+1. strided lane slice ``z[:, 1::K]``      -> lowered to gather:
+   "Shape mismatch in input, indices and output" (gather on (8, 8200)
+   lanes unsupported)
+2. minor-dim reshape ``z[:, 1:4t+1].reshape(w, t, 4)[..., 0]`` ->
+   "infer-vector-layout: unsupported shape cast
+   (vector<8x8192xi32> -> vector<8x2048x4xi32>)"
+3. traced-start ``lax.dynamic_slice`` (for the parent window) ->
+   "Unimplemented primitive in Pallas TPU lowering: dynamic_slice"
+   (fixable by a static-slice select — but 1/2 remain)
+
+No compress/gather/shuffle primitive exists in this pltpu surface
+(``pltpu.roll``'s ``stride`` shifts per-row along another axis — not a
+lane permutation), and a sublane-transposed layout merely moves the
+same compress into the from_parent half.  So on this toolchain the
+retile MUST happen in XLA, which is precisely the cost the measured
+roll-fold gate (structured.tree_from_kids, GG_ROLL_FOLD_W) already
+arbitrates.  The XLA lowerings are the complete set; the mid-W lever
+is fully taken by the gate.
+
+This script re-verifies the obstruction (so the claim stays pinned to
+the live toolchain, not to a round-5 observation) and prints one JSON
+line recording each formulation's current error — or, should a future
+toolchain learn to lower one, its measured ms vs the XLA exchange at
+W in {8, 16, 32}, which is the adoption trigger.
 """
 
 from __future__ import annotations
@@ -32,7 +60,7 @@ K = 4
 T = 2048                     # output lanes per grid step
 
 
-def make_pallas_exchange(n: int, w: int, t: int = T):
+def make_pallas_exchange(n: int, w: int, formulation: str, t: int = T):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -63,8 +91,16 @@ def make_pallas_exchange(n: int, w: int, t: int = T):
         kb = kids_buf[:]                    # (w, 4t+8)
         z = kb
         for s in range(1, K):
-            z = z | pltpu.roll(kb, -s, 1)
-        fk = z[:, 1::K][:, :t]              # fk[l] = OR kb[4l+1 .. 4l+4]
+            # pltpu.roll takes non-negative shifts only: left-roll by s
+            # == roll by L - s (wraparound pulls pad lanes)
+            z = z | pltpu.roll(kb, kb.shape[1] - s, 1)
+        # THE obstruction: fk[l] = z[4l+1] — a 4:1 lane compress
+        if formulation == "strided":
+            fk = z[:, 1::K][:, :t]
+        elif formulation == "reshape":
+            fk = z[:, 1:K * t + 1].reshape(w, t, K)[:, :, 0]
+        else:
+            raise ValueError(formulation)
         lane = jax.lax.broadcasted_iota(jnp.int32, (w, t), 1)
         fk = jnp.where(a + lane < n_parents, fk, 0)
 
@@ -72,10 +108,11 @@ def make_pallas_exchange(n: int, w: int, t: int = T):
         rep = pltpu.repeat(pb, K, 1)        # rep[x] = pb[x//4]
         repp = jnp.concatenate(
             [jnp.zeros((w, 1), jnp.uint32), rep], axis=1)
-        # par[l] = payload[(a+l-1)//4] = rep[l + r0] with
-        # r0 = (a-1) - 4*s0; the +1 zero lane absorbs tile 0's r0 = -1
-        r0 = (a - 1) - 4 * s0
-        par = jax.lax.dynamic_slice_in_dim(repp, r0 + 1, t, axis=1)
+        # par[l] = payload[(a+l-1)//4] = rep[l + r0] with r0 =
+        # (a-1) - 4*s0 — which is -1 at tile 0 and 3 elsewhere
+        # (t % 4 == 0), so the traced-start dynamic_slice
+        # (unimplemented in Mosaic) reduces to a static-slice select
+        par = jnp.where(ti == 0, repp[:, :t], repp[:, 4:4 + t])
         out_ref[:] = par | fk
 
     fn = pl.pallas_call(
@@ -110,26 +147,51 @@ def main() -> None:
     from gossip_glomers_tpu.tpu_sim.timing import chained_time
 
     rng = np.random.default_rng(0)
-    out: dict = {"n": N, "k": K, "tile": T}
-    for w in (8, 16, 32):
+    dev = jax.devices()[0]
+    out: dict = {"n": N, "k": K, "tile": T,
+                 "chip": dev.device_kind, "jax": jax.__version__}
+    for form in ("strided", "reshape"):
+        w = 8
         x0 = jnp.asarray(
             rng.integers(0, 1 << 32, (w, N), dtype=np.uint64)
             .astype(np.uint32))
+        try:
+            pex = make_pallas_exchange(N, w, form)
+            got = np.asarray(pex(x0))       # compile + run
+        except Exception as e:              # noqa: BLE001
+            msg = repr(e)
+            out[form] = {"lowerable": False, "error": msg[:300]}
+            continue
+        # a future toolchain lowered it: verify + measure = the
+        # adoption trigger (see module docstring).  Guarded per W so a
+        # partial lowering (or a bit-exactness failure) still lands in
+        # the JSON record instead of crashing the probe.
         ref_fn = jax.jit(functools.partial(tree_exchange, branching=K))
-        ref = np.asarray(ref_fn(x0))
-        pex = make_pallas_exchange(N, w)
-        got = np.asarray(pex(x0))
-        assert (got == ref).all(), f"pallas kernel diverges at W={w}"
-        dt_p = chained_time(pex, x0, lambda o: np.asarray(o[:1, :1]),
-                            repeats=3)
-        dt_x = chained_time(ref_fn, x0, lambda o: np.asarray(o[:1, :1]),
-                            repeats=3)
-        out[f"w{w}"] = {
-            "xla_ms": round(dt_x * 1e3, 3),
-            "pallas_ms": round(dt_p * 1e3, 3),
-            "speedup": round(dt_x / dt_p, 2),
-            "pallas_gbytes_per_s": round(2 * w * N * 4 / dt_p / 1e9, 1),
-        }
+        results = {}
+        for w in (8, 16, 32):
+            try:
+                x = jnp.asarray(
+                    rng.integers(0, 1 << 32, (w, N), dtype=np.uint64)
+                    .astype(np.uint32))
+                pexw = make_pallas_exchange(N, w, form)
+                gotw = np.asarray(pexw(x))
+                refw = np.asarray(ref_fn(x))
+                assert (gotw == refw).all(), \
+                    f"kernel diverges at W={w}"
+                dt_p = chained_time(pexw, x,
+                                    lambda o: np.asarray(o[:1, :1]),
+                                    repeats=3)
+                dt_x = chained_time(ref_fn, x,
+                                    lambda o: np.asarray(o[:1, :1]),
+                                    repeats=3)
+                results[f"w{w}"] = {
+                    "xla_ms": round(dt_x * 1e3, 3),
+                    "pallas_ms": round(dt_p * 1e3, 3),
+                    "speedup": round(dt_x / dt_p, 2),
+                }
+            except Exception as e:          # noqa: BLE001
+                results[f"w{w}"] = {"error": repr(e)[:300]}
+        out[form] = {"lowerable": True, **results}
     print(json.dumps(out))
 
 

@@ -1,20 +1,29 @@
 #!/usr/bin/env python
-"""All five BASELINE.json benchmark configs, one JSON line each.
+"""All BASELINE.json benchmark configs, one JSON line each.
 
-  1. broadcast: 25-node tree, no faults        (virtual harness, parity)
+  1. broadcast: 25-node tree, no faults        (virtual harness, parity;
+     carries BOTH msgs/op accountings — broadcast-only and Maelstrom)
   2. broadcast: 25-node grid, 100 ms + parts   (virtual harness, faults)
+  1p/2p. msgs/op HEAD-TO-HEAD vs the live Go binary, identical mixed
+     workload through one router, Maelstrom accounting (process_mix.py)
   3. counter:   1k-node g-counter, partitioned (tpu_sim, all-reduce)
+  3b. counter:  1M-node partitioned            (tpu_sim, all-reduce)
+  3c. counter:  16.8M-node cas mode            (tpu_sim, wide winner)
   4. broadcast: 1M-node expander epidemic      (tpu_sim, structured)
   4b. broadcast: 1M-node uniform random-regular (tpu_sim, gather control)
   4c. broadcast: 1M-node epidemic + partition window (tpu_sim, masked
       structured — the nemesis on the scale path)
-  4d. broadcast: 1M-node epidemic, mixed per-edge delays (tpu_sim,
-      gather + node-sharded history ring)
+  4d. broadcast: 1M-node epidemic, RANDOM per-edge delays (tpu_sim:
+      gather control + per-direction classes + edge-delay-class masks)
   5. kafka:     10k-key log, collective offsets(tpu_sim, rank-per-round)
+  5b. kafka:    node sweep 8 -> 1k nodes, 10k keys (bit-packed
+      presence, MXU matmul replication)
   6. broadcast: 1M nodes x 4,096 values (W=128 words axis), tree +
      circulant — the many-values regime (tpu_sim, structured)
   7. broadcast: node-axis scale sweep 256k -> 16M, W=1/W=128, tree +
      circulant — the single-chip ceiling table (tpu_sim, structured)
+  8. mesh takeover past the recorded single-chip OOM boundary
+     (subprocess: 8-device virtual mesh, halo path)
 
 Usage: python benchmarks/run_all.py [--out BENCH_ALL.json]
 The headline driver metric stays in bench.py (config 4's tree variant).
@@ -24,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import pathlib
 import sys
 import time
@@ -163,6 +173,45 @@ def config3b_counter_1m():
     window masking half the nodes off the KV — the 1k-node config 3
     grown 1024x (same methodology, `_counter_bench`)."""
     return _counter_bench(1 << 20, "counter-1M-partitioned")
+
+
+def config3c_counter_16m_cas():
+    """The parity-flavored cas mode at the broadcast path's
+    demonstrated 16.8M-node scale: exercises the wide (two-pmin)
+    winner layout that lifted the old 2^24-node cap
+    (tpu_sim/counter.py).  cas drains exactly one contender per round
+    (the reference's one-CAS-linearization-per-retry-wave,
+    add.go:78-88), so correctness here is the ledger invariant: after
+    R rounds, kv == the R distinct winners' drained deltas."""
+    import jax
+    import jax.numpy as jnp
+
+    from gossip_glomers_tpu.tpu_sim.counter import CounterSim
+    from gossip_glomers_tpu.tpu_sim.timing import chained_time
+
+    n, rounds = 1 << 24, 16
+    rng = np.random.default_rng(0)
+    deltas = rng.integers(1, 10, n).astype(np.int32)
+    sim = CounterSim(n, mode="cas", poll_every=4)
+    assert sim._wide, "16.8M nodes must select the wide winner layout"
+    st0 = sim.add(sim.init_state(), deltas)
+    dt = chained_time(lambda st: sim.run(st, rounds), st0,
+                      lambda st: np.asarray(st.kv))
+    st = sim.run(st0, rounds)
+    jax.block_until_ready(st.kv)
+    # device-side reductions (a 67 MB pending readback would flip the
+    # tunnel session — see timing.py); fetch scalars only
+    drained = int(jnp.sum(st0.pending - st.pending))
+    n_drained = int(jnp.sum((st.pending == 0).astype(jnp.int32)))
+    return {
+        "config": "counter-16.8M-cas-wide-winner",
+        "ok": bool(int(st.kv) == drained and n_drained == rounds),
+        "n_nodes": n,
+        "rounds": rounds,
+        "wall_s": round(dt, 4),
+        "ms_per_round": round(dt / rounds * 1e3, 3),
+        "kv_msgs": int(st.msgs),
+    }
 
 
 def config4_epidemic_1m():
@@ -540,6 +589,79 @@ def config5_kafka_10k():
     }
 
 
+def config5b_kafka_node_sweep():
+    """The kafka NODE axis at scale: presence is a bit-packed
+    (N, K, C/32) uint32 set and replication delivery is a byte-split
+    uint8 MXU matmul (disjoint bits make the masked OR a sum — see
+    tpu_sim/kafka.py), so the full-mesh fire-and-forget scales to
+    1k nodes x 10k keys where the old dense bool layout was ~1.3 GB
+    of presence and an (N,N)x(N,K,C) int8 einsum.  Reports memory per
+    node and sends/s at each size; ledger/round semantics pinned
+    bit-exact by the existing kafka tests."""
+    import jax
+
+    from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim
+    from gossip_glomers_tpu.tpu_sim.timing import chained_time
+
+    n_keys, cap, rounds = 10_000, 128, 8
+    entries = {}
+    ok_all = True
+    for n in (8, 64, 256, 1024):
+        s = 64 if n <= 64 else 16       # sends per node per round
+        sim = KafkaSim(n, n_keys, capacity=cap, max_sends=s)
+        rng = np.random.default_rng(n)
+        sks = rng.integers(0, n_keys, (rounds, n, s)).astype(np.int32)
+        svs = rng.integers(0, 1 << 20, (rounds, n, s)).astype(np.int32)
+        dt = chained_time(lambda st: sim.run_rounds(st, sks, svs),
+                          sim.init_state(),
+                          lambda st: np.asarray(st.kv_val[:1]))
+        st = sim.run_rounds(sim.init_state(), sks, svs)
+        jax.block_until_ready(st.present)
+        sends = rounds * n * s
+        kv = np.asarray(st.kv_val)
+        allocated = int(np.where(kv > 0, kv - 1, 0).sum())
+        ok = allocated == sends
+        ok_all = ok_all and ok
+        present_mb = n * n_keys * sim.n_pwords * 4 / 1e6
+        entries[f"nodes-{n}"] = {
+            "ok": bool(ok),
+            "sends_per_s": int(sends / dt),
+            "ms_per_round": round(dt / rounds * 1e3, 3),
+            "present_mb_total": round(present_mb, 1),
+            "present_kb_per_node": round(present_mb * 1e3 / n, 1),
+        }
+    return {"config": "kafka-node-sweep-10k-keys", "ok": bool(ok_all),
+            "n_keys": n_keys, "capacity": cap, **entries}
+
+
+def config8_mesh_takeover():
+    """The mesh-path takeover past the recorded single-chip OOM
+    boundary (benchmarks/mesh_takeover.py) — run as a SUBPROCESS so
+    its 8-device virtual CPU mesh coexists with this process's TPU
+    backend (platforms cannot switch after backend init)."""
+    import subprocess
+    import sys as _sys
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
+                        "XLA_FLAGS")}
+    try:
+        out = subprocess.run(
+            [_sys.executable, str(pathlib.Path(__file__).parent
+                                  / "mesh_takeover.py")],
+            capture_output=True, text=True, env=env, timeout=3600)
+    except subprocess.TimeoutExpired:
+        return {"config": "mesh-takeover-past-single-chip-oom",
+                "ok": False, "error": "timeout after 3600s (one host "
+                "core executes all 8 virtual shards; see "
+                "GG_TAKEOVER_NEXP/GG_TAKEOVER_W to shrink)"}
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            return json.loads(line)
+    return {"config": "mesh-takeover-past-single-chip-oom",
+            "ok": False, "error": (out.stderr or out.stdout)[-400:]}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
@@ -551,13 +673,15 @@ def main() -> None:
         "1p": config1p_process_head_to_head,
         "2p": config2p_process_head_to_head_grid,
         "3": config3_counter_1k, "3b": config3b_counter_1m,
+        "3c": config3c_counter_16m_cas,
         "4": config4_epidemic_1m,
         "4b": config4b_random_regular_1m,
         "4c": config4c_epidemic_1m_partitioned,
         "4d": config4d_epidemic_1m_delayed,
-        "5": config5_kafka_10k,
+        "5": config5_kafka_10k, "5b": config5b_kafka_node_sweep,
         "6": config6_words_axis_w128,
         "7": config7_scale_sweep,
+        "8": config8_mesh_takeover,
     }
     pick = (args.only.split(",") if args.only else list(configs))
     results = []

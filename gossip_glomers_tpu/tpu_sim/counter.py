@@ -123,13 +123,13 @@ class CounterSim:
         # collide with the last row id, and int32(n) itself overflows
         if mode == "cas" and n_nodes >= 2**31:
             raise ValueError("cas winner keys support n_nodes < 2^31")
-        if winner_key == "packed" and self._row_bits > 24:
+        if winner_key == "packed" and self._row_bits >= 24:
             raise ValueError(
-                "packed cas winner keys support n_nodes < 2^24 (the "
-                "int31 key leaves too few priority bits beyond that); "
-                "use winner_key='wide' or 'auto'")
+                "packed cas winner keys need n_nodes <= 2^23 (24+ row "
+                "bits leave too few priority bits for a randomized "
+                "winner); use winner_key='wide' or 'auto'")
         self._wide = (winner_key == "wide"
-                      or (winner_key == "auto" and self._row_bits > 24))
+                      or (winner_key == "auto" and self._row_bits >= 24))
         self.kv_sched = (kv_sched if kv_sched is not None
                          else KVReach.none(n_nodes))
         self._node_spec = P("nodes") if mesh is not None else None

@@ -29,10 +29,17 @@ one round are linearized in (node, slot) order, each getting
 ``current + rank`` where ``current`` is the shared cell's value — the
 sort/scan equivalent of the reference's one-winner-per-CAS-retry loop,
 and the "offset gen as a collective" called for by BASELINE.json
-config 5.  Replication is one masked einsum per round:
-delivery[dest] = OR over origins of (link alive AND origin's new
-appends) — the full-mesh fire-and-forget as a batched matmul, with link
-loss as a (N, N) boolean mask.
+config 5.  Replication — delivery[dest] = OR over origins of (link
+alive AND origin's new appends) — exploits that offsets are globally
+unique per key, so every presence BIT has exactly one origin: across
+origins the bit-packed new-append words are DISJOINT, OR equals SUM,
+and the masked OR is literally a matmul.  Split the uint32 words into
+bytes and it is a uint8 x uint8 -> int32 matmul the MXU executes
+natively (byte sums of disjoint bits stay <= 255, so int32
+accumulation is exact); the delivered high-water mark then falls out
+of a count-leading-zeros over the delivered words instead of an
+(N, N, K) max intermediate.  Link loss stays a (N, N) boolean mask —
+it is the matmul's lhs.
 
 Within a round, sends complete before commits (the round-aligned
 equivalent of a harness scenario that issues sends and commits in
@@ -46,7 +53,11 @@ State (node axis shardable over the mesh):
 - ``log_vals (K, C) int32``  — content by (key, slot); offset = slot+1
   (defaultOffset=1, logmap.go:16).  Replicated: offsets are unique, so
   all replicas agree on content — only *presence* differs per node.
-- ``present (N, K, C) bool`` — does node n hold (key, slot)?
+- ``present (N, K, ceil(C/32)) uint32`` — bit c%32 of word c//32 set
+  iff node n holds (key, slot c).  Bit-packed (32x over the bool
+  layout) so the node axis scales: 1k nodes x 10k keys x C=128 is
+  160 MB instead of 1.3 GB, and replication delivery becomes an MXU
+  matmul (below) instead of an (N,N)x(N,K,C) einsum.
 - ``kv_val (K,) int32``      — THE shared lin-kv cell per key
   (0 = missing; live values are always >= 1).
 - ``local_committed (N, K) int32`` — ``kd.commitOffset``: set
@@ -68,10 +79,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .counter import KVReach, _reach
+
 
 class KafkaState(NamedTuple):
     log_vals: jnp.ndarray         # (K, C) int32
-    present: jnp.ndarray          # (N, K, C) bool
+    present: jnp.ndarray          # (N, K, ceil(C/32)) uint32 bitset
     kv_val: jnp.ndarray           # (K,) int32 — shared lin-kv cell
     local_committed: jnp.ndarray  # (N, K) int32 — kd.commitOffset
     t: jnp.ndarray                # () int32
@@ -107,15 +120,35 @@ class KafkaSim:
 
     def __init__(self, n_nodes: int, n_keys: int, capacity: int, *,
                  max_sends: int = 4, mesh: Mesh | None = None,
-                 kv_retries: int = 10) -> None:
+                 kv_retries: int = 10,
+                 kv_sched: KVReach | None = None) -> None:
+        """``kv_sched``: lin-kv reachability windows (counter.KVReach —
+        the same nemesis shape the counter's flush is gated by).  A
+        node partitioned from lin-kv at round t:
+
+        - **send**: the allocation read times out and the node replies
+          an error after ONE attempt (models/kafka.py alloc_offset —
+          only CAS-mismatch retries, a timeout aborts): no offset, no
+          append, no replication; ledger charges the 1 dropped read
+          request (sends count at send time, like Maelstrom's ledger).
+        - **commit** (active dance only): set_kv_offset re-runs on
+          timeout up to kv_retries attempts (logmap.go:177-181; each
+          attempt = 1 dropped read request), then gives up — no learn,
+          kv_retries msgs.  Locally-skipped commits never touch the KV
+          and are unaffected.
+        - **poll / list_committed**: local-only (log.go:79-110), never
+          gated."""
         self.n_nodes = n_nodes
         self.n_keys = n_keys
         self.capacity = capacity
+        self.n_pwords = (capacity + 31) // 32   # presence words per key
         self.max_sends = max_sends
         self.mesh = mesh
         # allocation-attempt cap for the contention-aware ledger
         # (defaultKVRetries, logmap.go:19)
         self.kv_retries = kv_retries
+        self.kv_sched = (kv_sched if kv_sched is not None
+                         else KVReach.none(n_nodes))
         self._run_rounds = None
         self._step = self._build_step()
         self._poll_batch_fn = None
@@ -125,7 +158,7 @@ class KafkaSim:
         n, k, c = self.n_nodes, self.n_keys, self.capacity
         state = KafkaState(
             log_vals=jnp.full((k, c), -1, jnp.int32),
-            present=jnp.zeros((n, k, c), bool),
+            present=jnp.zeros((n, k, self.n_pwords), jnp.uint32),
             kv_val=jnp.zeros((k,), jnp.int32),
             local_committed=jnp.zeros((n, k), jnp.int32),
             t=jnp.int32(0), msgs=jnp.uint32(0))
@@ -142,19 +175,26 @@ class KafkaSim:
     # -- round -------------------------------------------------------------
 
     def _round(self, state: KafkaState, send_key, send_val, commit_req,
-               repl_ok, *, row_ids, widen, reduce_sum, reduce_max,
-               reduce_min) -> KafkaState:
+               repl_ok, sched: KVReach, *, row_ids, widen, reduce_sum,
+               reduce_max, reduce_min,
+               local_cols=lambda m: m) -> KafkaState:
         """One round: allocate + append + replicate, then commit.
 
         send_key/send_val: (rows, S) int32, key = -1 for no-op.
         commit_req: (rows, K) int32, -1 for no commit of that key.
         repl_ok: (N, N) bool — repl_ok[o, d]: o's replicate_msg reaches d.
+        sched: lin-kv reachability windows (see __init__) — blocked
+        nodes' sends fail allocation and their active commit dances
+        time out.
         widen/reduce_*: identity single-device; all_gather along
         'nodes' / psum / pmax / pmin under shard_map.
         """
         n, k_dim, cap = self.n_nodes, self.n_keys, self.capacity
         s_dim = send_key.shape[1]
         big = jnp.int32(n + 1)
+        # who can reach lin-kv this round — computed over the GLOBAL
+        # node axis (send linearization is global), tiny arrays
+        reach = _reach(state.t, jnp.arange(n, dtype=jnp.int32), sched)
 
         # -- offset allocation (global, linearized in (node, slot) order:
         #    the reference's lin-kv CAS loop, logmap.go:255-285).  The
@@ -163,7 +203,10 @@ class KafkaSim:
         current = jnp.where(state.kv_val > 0, state.kv_val, 1)  # (K,)
         all_key = widen(send_key).reshape(-1)            # (N*S,)
         all_val = widen(send_val).reshape(-1)
-        valid = all_key >= 0
+        tried = all_key >= 0
+        # a KV-blocked send never allocates: the read times out and the
+        # node aborts after one attempt (models/kafka.py alloc_offset)
+        valid = tried & jnp.repeat(reach, s_dim)
         keys_c = jnp.clip(all_key, 0, k_dim - 1)
         rank = _rank_within_key(keys_c, valid)
         offset = current[keys_c] + rank                  # (N*S,)
@@ -181,32 +224,58 @@ class KafkaSim:
             ok.astype(jnp.int32))
         kv_sent = jnp.where(counts > 0, current + counts, state.kv_val)
 
-        # new appends per origin node: (N, K, C) one-hot
+        # new appends per origin node, bit-packed: (N, K, Wc) uint32.
+        # Offsets are globally unique per key, so every (key, slot) bit
+        # has exactly ONE origin — scatter-ADD of the bits is
+        # scatter-OR, and the words are DISJOINT across origins.
+        wc = self.n_pwords
         origin = jnp.repeat(jnp.arange(n, dtype=jnp.int32), s_dim)
-        new_mask = jnp.zeros((n, k_dim, cap), bool).at[
-            origin, scat_k, scat_c].max(ok, mode="drop")
+        slot_ok = jnp.where(ok, slot, 0)
+        bit = jnp.where(ok, jnp.uint32(1)
+                        << (slot_ok % 32).astype(jnp.uint32),
+                        jnp.uint32(0))
+        new_words = jnp.zeros((n, k_dim, wc), jnp.uint32).at[
+            origin, scat_k, slot_ok // 32].add(bit, mode="drop")
 
-        # -- replication: masked OR over origins as one matmul
-        #    (fire-and-forget full mesh, log.go:159-175) ----------------
-        deliver = jnp.einsum(
-            "od,okc->dkc", repl_ok.astype(jnp.int8),
-            new_mask.astype(jnp.int8)) > 0                # (N, K, C)
-        present = state.present | deliver[row_ids] | new_mask[row_ids]
+        # -- replication: the masked OR over origins IS a matmul
+        #    (fire-and-forget full mesh, log.go:159-175): disjoint bits
+        #    make OR == SUM, so split the words into bytes and ride the
+        #    MXU — uint8 x uint8 -> int32, exact (disjoint-bit byte
+        #    sums stay <= 255).
+        nb = jnp.stack(
+            [(new_words >> jnp.uint32(8 * j)).astype(jnp.uint8)
+             for j in range(4)], axis=-1)                # (N, K, Wc, 4)
+        # contract only this shard's destination columns of repl_ok
+        # (identity single-device): each shard does rows/N of the
+        # matmul and lands its (rows, ...) delivery block directly
+        repl_local = local_cols(repl_ok)                 # (N, rows)
+        rows = repl_local.shape[1]
+        deliver_b = lax.dot_general(
+            repl_local.astype(jnp.uint8),
+            nb.reshape(n, k_dim * wc * 4),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)            # (rows, K*Wc*4)
+        db = deliver_b.astype(jnp.uint32).reshape(rows, k_dim, wc, 4)
+        deliver = (db[..., 0] | (db[..., 1] << 8)
+                   | (db[..., 2] << 16) | (db[..., 3] << 24))
+        present = state.present | deliver | new_words[row_ids]
 
         # -- local HWM after sends: own append sets kd.commitOffset
         #    unconditionally (logmap.go:298; == max here, offsets grow),
         #    replicate delivery max-bumps it (logmap.go:309-311).
         own_off = jnp.zeros((n, k_dim), jnp.int32).at[
             origin, scat_k].max(jnp.where(ok, offset, 0), mode="drop")
-        # max delivered offset = max over reachable origins of their max
-        # new offset (a tiny (N,N)x(N,K) max-matmul — avoids re-reducing
-        # the (N,K,C) delivery tensor)
-        deliv_off = jnp.max(
-            jnp.where(repl_ok[:, :, None], own_off[:, None, :], 0),
-            axis=0)                                       # (N, K)
+        # max delivered offset per (dest, key) = highest delivered bit
+        # + 1, straight off the delivered words via count-leading-zeros
+        # (no (N, N, K) max intermediate)
+        word_base = (jnp.arange(wc, dtype=jnp.int32) * 32)[None, None, :]
+        top = jnp.where(deliver > 0,
+                        word_base + 32 - lax.clz(deliver).astype(
+                            jnp.int32),
+                        0)
+        deliv_off = jnp.max(top, axis=2)                  # (rows, K)
         hwm = jnp.maximum(state.local_committed,
-                          jnp.maximum(own_off[row_ids],
-                                      deliv_off[row_ids]))
+                          jnp.maximum(own_off[row_ids], deliv_off))
 
         # -- commits (after this round's sends).  Local skip when the
         #    HWM covers the request (logmap.go:247-251); otherwise the
@@ -234,7 +303,12 @@ class KafkaSim:
         # is treated as a no-op rather than allowed to desync the cell
         want = req >= 1
         skip = want & (hwm > 0) & (hwm >= req)
-        active = want & ~skip
+        dance = want & ~skip
+        # KV-blocked active dances time out and re-run kv_retries times
+        # (logmap.go:177-181), then give up: no contention, no learn
+        reach_rows = reach[row_ids]
+        active = dance & reach_rows[:, None]
+        blocked_commit = dance & ~reach_rows[:, None]
         exists = (kv_sent > 0)[None, :]
         readv = kv_sent[None, :]
         read_only = active & exists & (req <= readv)
@@ -275,25 +349,38 @@ class KafkaSim:
         kv_send_msgs = jnp.sum(
             jnp.where(valid, 4 * attempts, 0).astype(jnp.uint32),
             dtype=jnp.uint32)
-        n_sends = reduce_sum(jnp.sum(
-            (send_key >= 0).astype(jnp.uint32)))
+        # KV-blocked sends: 1 dropped read request each (the model
+        # aborts allocation after one timed-out attempt); blocked
+        # active commits: kv_retries dropped read requests each.
+        # Requests count at send time, like every other ledger here.
+        blocked_send_msgs = jnp.sum(
+            (tried & ~valid).astype(jnp.uint32), dtype=jnp.uint32)
+        # replication fires only for ALLOCATED sends (no offset -> no
+        # append -> no replicate_msg, log.go:66-77) — `ok`, not
+        # `valid`: a capacity-overflow send pays its KV attempts but
+        # never appends.  `ok` is global like `rank`, so its sum is
+        # NOT psum-reduced.
+        n_sends = jnp.sum(ok.astype(jnp.uint32), dtype=jnp.uint32)
         n_active = reduce_sum(jnp.sum(active.astype(jnp.uint32)))
+        n_blocked_c = reduce_sum(jnp.sum(
+            blocked_commit.astype(jnp.uint32)))
         n_write_leg = reduce_sum(jnp.sum(
             (need_cas | writers).astype(jnp.uint32)))
-        msgs = (state.msgs + kv_send_msgs
+        msgs = (state.msgs + kv_send_msgs + blocked_send_msgs
                 + n_sends * jnp.uint32(n - 1)
-                + n_active * jnp.uint32(2) + n_write_leg * jnp.uint32(2))
+                + n_active * jnp.uint32(2) + n_write_leg * jnp.uint32(2)
+                + n_blocked_c * jnp.uint32(self.kv_retries))
         return KafkaState(log_vals, present, kv_val,
                           local_committed, state.t + 1, msgs)
 
     def _round_1dev(self, state, send_key, send_val, commit_req,
-                    repl_ok):
+                    repl_ok, sched):
         """Single-device round wiring (identity collectives) — shared by
         the stepwise and the scanned (run_rounds) drivers."""
         row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
         ident = lambda x: x
         return self._round(state, send_key, send_val, commit_req,
-                           repl_ok, row_ids=row_ids, widen=ident,
+                           repl_ok, sched, row_ids=row_ids, widen=ident,
                            reduce_sum=ident, reduce_max=ident,
                            reduce_min=ident)
 
@@ -310,7 +397,11 @@ class KafkaSim:
                                            tiled=True),
             reduce_sum=lambda x: lax.psum(x, "nodes"),
             reduce_max=lambda x: lax.pmax(x, "nodes"),
-            reduce_min=lambda x: lax.pmin(x, "nodes"))
+            reduce_min=lambda x: lax.pmin(x, "nodes"),
+            # this shard's destination columns (the replication
+            # matmul's rhs side): each shard computes only its block
+            local_cols=lambda m: lax.dynamic_slice_in_dim(
+                m, lax.axis_index("nodes") * block, block, axis=1))
 
     def _build_step(self):
         if self.mesh is None:
@@ -319,6 +410,7 @@ class KafkaSim:
         mesh = self.mesh
         node2 = P("nodes", None)
         state_spec = self._state_spec()
+        sched_spec = KVReach(P(), P(), P(None, None))
 
         # check_vma=False: log_vals/kv_val are computed identically on
         # every shard from all_gather-ed send batches — genuinely
@@ -327,11 +419,12 @@ class KafkaSim:
         @jax.jit
         @functools.partial(
             jax.shard_map, mesh=mesh,
-            in_specs=(state_spec, node2, node2, node2, P(None, None)),
+            in_specs=(state_spec, node2, node2, node2, P(None, None),
+                      sched_spec),
             out_specs=state_spec, check_vma=False)
-        def step(state, send_key, send_val, commit_req, repl_ok):
+        def step(state, send_key, send_val, commit_req, repl_ok, sched):
             return self._round(
-                state, send_key, send_val, commit_req, repl_ok,
+                state, send_key, send_val, commit_req, repl_ok, sched,
                 **self._shard_collectives(send_key.shape[0]))
 
         return step
@@ -355,28 +448,30 @@ class KafkaSim:
         if self._run_rounds is None:
             if self.mesh is None:
                 @jax.jit
-                def run(state, sks, svs, crs, repl):
+                def run(state, sks, svs, crs, repl, sched):
                     def body(s, xs):
                         sk, sv, cr = xs
-                        return self._round_1dev(s, sk, sv, cr, repl), None
+                        return self._round_1dev(s, sk, sv, cr, repl,
+                                                sched), None
                     out, _ = lax.scan(body, state, (sks, svs, crs))
                     return out
             else:
                 node3 = P(None, "nodes", None)
                 state_spec = self._state_spec()
+                sched_spec = KVReach(P(), P(), P(None, None))
 
                 @jax.jit
                 @functools.partial(
                     jax.shard_map, mesh=self.mesh,
                     in_specs=(state_spec, node3, node3, node3,
-                              P(None, None)),
+                              P(None, None), sched_spec),
                     out_specs=state_spec, check_vma=False)
-                def run(state, sks, svs, crs, repl):
+                def run(state, sks, svs, crs, repl, sched):
                     coll = self._shard_collectives(sks.shape[1])
 
                     def body(s, xs):
                         sk, sv, cr = xs
-                        return self._round(s, sk, sv, cr, repl,
+                        return self._round(s, sk, sv, cr, repl, sched,
                                            **coll), None
                     out, _ = lax.scan(body, state, (sks, svs, crs))
                     return out
@@ -387,7 +482,8 @@ class KafkaSim:
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(None, "nodes", None))
             args = [jax.device_put(a, sh) for a in args]
-        return self._run_rounds(state, *args, jnp.asarray(repl_ok))
+        return self._run_rounds(state, *args, jnp.asarray(repl_ok),
+                                self.kv_sched)
 
     def step(self, state: KafkaState,
              send_key: np.ndarray | None = None,
@@ -409,7 +505,7 @@ class KafkaSim:
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P("nodes", None))
             args[:3] = [jax.device_put(a, sh) for a in args[:3]]
-        return self._step(state, *args)
+        return self._step(state, *args, self.kv_sched)
 
     # -- host-side reads (reference read semantics) ------------------------
 
@@ -424,9 +520,10 @@ class KafkaSim:
             k_dim = self.n_keys
 
             @jax.jit
-            def alloc(kv_val, send_key):
+            def alloc(kv_val, send_key, reach):
                 flat = send_key.reshape(-1)
-                valid = flat >= 0
+                valid = (flat >= 0) & jnp.repeat(reach,
+                                                 send_key.shape[1])
                 keys_c = jnp.clip(flat, 0, k_dim - 1)
                 rank = _rank_within_key(keys_c, valid)
                 base = jnp.where(kv_val > 0, kv_val, 1)
@@ -435,8 +532,17 @@ class KafkaSim:
                 return jnp.where(ok, off, -1).reshape(send_key.shape)
 
             self._alloc_fn = alloc
+        # KV-blocked nodes' sends ack as errors (-1): mirror the
+        # round's reach gate at this state's round number
+        sched = self.kv_sched
+        t = int(state_before.t)
+        reach = np.ones(self.n_nodes, bool)
+        for w in range(int(np.asarray(sched.starts).shape[0])):
+            if int(sched.starts[w]) <= t < int(sched.ends[w]):
+                reach &= ~np.asarray(sched.blocked[w])
         return np.asarray(self._alloc_fn(
-            state_before.kv_val, jnp.asarray(send_key, jnp.int32)))
+            state_before.kv_val, jnp.asarray(send_key, jnp.int32),
+            jnp.asarray(reach)))
 
     def poll_batch_program(self):
         """The jitted batched-poll device program: ``(present,
@@ -450,8 +556,12 @@ class KafkaSim:
 
             @jax.jit
             def pb(present, log_vals, nodes, keys, from_off):
-                pres = present[nodes, keys]             # (Q, C)
+                words = present[nodes, keys]            # (Q, Wc)
                 offs = jnp.arange(1, cap + 1, dtype=jnp.int32)
+                slots = offs - 1
+                pres = ((words[:, slots // 32]
+                         >> (slots % 32).astype(jnp.uint32))
+                        & jnp.uint32(1)) > 0            # (Q, C)
                 sel = pres & (offs[None, :] >= from_off[:, None])
                 vals = log_vals[keys]                   # (Q, C)
                 return (jnp.where(sel, offs[None, :], -1),
@@ -488,6 +598,14 @@ class KafkaSim:
         sel = offs[0] >= 0
         return [[int(o), int(v)]
                 for o, v in zip(offs[0][sel], vals[0][sel])]
+
+    def present_bool(self, state: KafkaState) -> np.ndarray:
+        """(N, K, C) bool — the presence bitset unpacked, host-side
+        (tests/inspection at small scale; the device layout stays
+        bit-packed)."""
+        words = np.asarray(state.present)
+        c = np.arange(self.capacity)
+        return ((words[..., c // 32] >> (c % 32)) & 1).astype(bool)
 
     def list_committed(self, state: KafkaState, node: int) -> dict[int, int]:
         """Per-key committed offsets from the node's LOCAL cache only

@@ -223,3 +223,103 @@ def test_kafka_ledger_matches_harness():
         net.run_for(0.0)
         want = {f"k{k}": v for k, v in sim.list_committed(st, i).items()}
         assert listed == want, f"n{i}: {listed} != {want}"
+
+
+def test_kafka_kv_unreachability_ledger_matches_harness():
+    """A node partitioned from lin-kv (the reference's timeout-retry
+    regime, logmap.go:55-73,177-181), phase-by-phase parity between
+    the harness ledger and KafkaSim's KVReach-gated analytic ledger:
+
+    - blocked **send**: the allocation read drops, the timeout fires,
+      and the node aborts after ONE attempt (models/kafka.py
+      alloc_offset retries only on CAS-mismatch) — 1 server msg, no
+      append, no replication;
+    - blocked **active commit**: set_kv_offset re-runs on timeout up
+      to kv_retries attempts — kv_retries dropped reads, no learn;
+    - blocked **skipped commit**: local HWM covers it — 0 msgs;
+    - after the window heals, traffic is byte-identical to normal."""
+    from gossip_glomers_tpu.tpu_sim import KVReach
+    from gossip_glomers_tpu.utils.config import KafkaConfig
+    import jax.numpy as jnp
+
+    n, kv_retries, cas_to = 2, 3, 0.2
+    net = VirtualNetwork(NetConfig(seed=0))
+    cfg = KafkaConfig(cas_timeout=cas_to, kv_retries=kv_retries)
+    for i in range(n):
+        net.spawn(f"n{i}", KafkaProgram(cfg))
+    net.add_service(KVService(net, "lin-kv"))
+    net.init_cluster()
+    client = net.client("c1")
+    blocked = {"on": True}
+    net.drop_fn = (lambda src, dest, now: blocked["on"]
+                   and "lin-kv" in (src, dest) and "n1" in (src, dest))
+
+    # sim twin: n1 cut from lin-kv for rounds [0, 2)
+    sched = KVReach(jnp.array([0], jnp.int32), jnp.array([2], jnp.int32),
+                    jnp.asarray(np.array([[False, True]])))
+    sim = KafkaSim(n, 1, capacity=64, max_sends=1,
+                   kv_retries=kv_retries, kv_sched=sched)
+    st = sim.init_state()
+
+    def phase_delta():
+        before = net.ledger.server_to_server
+        return lambda: net.ledger.server_to_server - before
+
+    # -- A: both nodes send to k0; n1's allocation read drops ----------
+    delta = phase_delta()
+    acks = {}
+    for i in range(n):
+        client.rpc(f"n{i}", {"type": "send", "key": "k0",
+                             "msg": 10 + i},
+                   lambda rep, i=i: acks.__setitem__(
+                       i, rep.body.get("offset", -1)))
+    net.run_for(cas_to * 1.5)          # let n1's timeout fire
+    harness_a = delta()
+
+    sk = np.array([[0], [0]], np.int32)
+    sv = np.array([[10], [11]], np.int32)
+    offs = sim.alloc_offsets(st, sk)
+    before = int(st.msgs)
+    st = sim.step(st, sk, sv)
+    sim_a = int(st.msgs) - before
+    # n0: read+read_ok+cas+cas_ok (4) + 1 replicate_msg; n1: 1 dropped
+    # read
+    assert harness_a == sim_a == 4 + (n - 1) + 1 == 6
+    assert acks == {0: 1, 1: -1}
+    assert [int(o) for o in offs[:, 0]] == [1, -1]
+    # n1 still HOLDS offset 1 via n0's replicate_msg (node-to-node
+    # traffic is not gated by KV reachability)
+    assert sim.poll(st, 1, 0, 0) == [[1, 10]]
+
+    # -- B: n1's active commit dance times out kv_retries times, its
+    #    skipped commit is free ----------------------------------------
+    delta = phase_delta()
+    client.rpc("n1", {"type": "commit_offsets", "offsets": {"k0": 2}})
+    net.run_for(cas_to * (kv_retries + 1.5))
+    harness_b = delta()
+    cr = np.array([[-1], [2]], np.int32)
+    before = int(st.msgs)
+    st = sim.step(st, commit_req=cr)
+    assert harness_b == int(st.msgs) - before == kv_retries
+    assert sim.list_committed(st, 1).get(0, 1) == 1  # no learn past HWM
+
+    delta = phase_delta()
+    client.rpc("n1", {"type": "commit_offsets", "offsets": {"k0": 1}})
+    net.run_for(0.0)                   # local skip: HWM 1 >= 1
+    assert delta() == 0
+
+    # -- C: the window heals; n1's send is byte-identical to normal ----
+    blocked["on"] = False
+    delta = phase_delta()
+    client.rpc("n1", {"type": "send", "key": "k0", "msg": 12},
+               lambda rep: acks.__setitem__("healed",
+                                            rep.body["offset"]))
+    net.run_for(0.0)
+    harness_c = delta()
+    sk2 = np.array([[-1], [0]], np.int32)
+    sv2 = np.array([[0], [12]], np.int32)
+    before = int(st.msgs)
+    st = sim.step(st, sk2, sv2)        # sim round 2: window over
+    assert harness_c == int(st.msgs) - before == 4 + (n - 1) == 5
+    assert acks["healed"] == 2
+    assert sim.poll(st, 0, 0, 0) == [[1, 10], [2, 12]]

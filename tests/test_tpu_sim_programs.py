@@ -391,7 +391,7 @@ def test_kafka_poll_batch_and_alloc_match_host_reference():
     pk = rng.integers(0, n_keys, q).astype(np.int32)
     pf = rng.integers(1, cap + 1, q).astype(np.int32)
     offs, vals = sim.poll_batch(st, pn, pk, pf)
-    present = np.asarray(st.present)
+    present = sim.present_bool(st)
     log_vals = np.asarray(st.log_vals)
     for i in range(q):
         expect = []
@@ -454,3 +454,33 @@ def test_counter_cas_node_cap_lifted():
     assert not CounterSim(1 << 10, mode="cas")._wide
     with pytest.raises(ValueError, match="2\\^31"):
         CounterSim(1 << 31, mode="cas")
+
+
+def test_kafka_kv_reach_sharded_matches_single_device():
+    # the KVReach-gated round (blocked sends/commits, see kafka.py)
+    # must stay bit-exact between backends, like every other sim
+    from gossip_glomers_tpu.tpu_sim import KVReach
+
+    n, k = 8, 3
+    blocked = np.zeros((1, n), bool)
+    blocked[0, : n // 2] = True
+    sched = KVReach(jnp.array([0], jnp.int32),
+                    jnp.array([2], jnp.int32), jnp.asarray(blocked))
+    rng = np.random.default_rng(4)
+    sks = rng.integers(0, k, (3, n, 2)).astype(np.int32)
+    svs = rng.integers(0, 100, (3, n, 2)).astype(np.int32)
+    crs = np.where(rng.random((3, n, k)) < 0.3,
+                   rng.integers(1, 5, (3, n, k)), -1).astype(np.int32)
+    ref = KafkaSim(n, k, capacity=16, max_sends=2, kv_retries=3,
+                   kv_sched=sched)
+    s1 = ref.run_rounds(ref.init_state(), sks, svs, crs)
+    shd = KafkaSim(n, k, capacity=16, max_sends=2, kv_retries=3,
+                   kv_sched=sched, mesh=mesh_1d())
+    s2 = shd.run_rounds(shd.init_state(), sks, svs, crs)
+    for a, b in zip(s1, s2):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    # the window actually bit: blocked nodes' round-0/1 sends are gone
+    unblocked = KafkaSim(n, k, capacity=16, max_sends=2, kv_retries=3)
+    s3 = unblocked.run_rounds(unblocked.init_state(), sks, svs, crs)
+    assert int(np.asarray(s1.kv_val).sum()) < int(
+        np.asarray(s3.kv_val).sum())
