@@ -6,6 +6,8 @@
   2. broadcast: 25-node grid, 100 ms + parts   (virtual harness, faults)
   1p/2p. msgs/op HEAD-TO-HEAD vs the live Go binary, identical mixed
      workload through one router, Maelstrom accounting (process_mix.py)
+  3p. partition-repair head-to-head: ours heals the hole via
+     anti-entropy; the checked-in Go artifact never does
   3. counter:   1k-node g-counter, partitioned (tpu_sim, all-reduce)
   3b. counter:  1M-node partitioned            (tpu_sim, all-reduce)
   3c. counter:  16.8M-node cas mode            (tpu_sim, wide winner)
@@ -125,6 +127,15 @@ def config2p_process_head_to_head_grid():
 
     return {**head_to_head("grid"),
             "config": "process-head-to-head-grid-25"}
+
+
+def config3p_partition_repair():
+    """Robustness head-to-head: after a healed partition, our node's
+    anti-entropy repairs the hole; the checked-in Go artifact (which
+    predates its source's SyncBroadcast) never does."""
+    from benchmarks.process_mix import fault_repair_head_to_head
+
+    return fault_repair_head_to_head()
 
 
 def _counter_bench(n: int, name: str) -> dict:
@@ -672,6 +683,7 @@ def main() -> None:
         "1": config1_tree25, "2": config2_grid25_faults,
         "1p": config1p_process_head_to_head,
         "2p": config2p_process_head_to_head_grid,
+        "3p": config3p_partition_repair,
         "3": config3_counter_1k, "3b": config3b_counter_1m,
         "3c": config3c_counter_16m_cas,
         "4": config4_epidemic_1m,
