@@ -484,3 +484,21 @@ def test_kafka_kv_reach_sharded_matches_single_device():
     s3 = unblocked.run_rounds(unblocked.init_state(), sks, svs, crs)
     assert int(np.asarray(s1.kv_val).sum()) < int(
         np.asarray(s3.kv_val).sum())
+
+
+def test_kafka_run_rounds_commit_free_path_bit_exact():
+    # the commit-free run_rounds variant builds the all--1 commit_req
+    # inside the traced program (no host transfer; XLA folds the
+    # commit pipeline away) — it must be bit-exact with the explicit
+    # all--1 array, single-device and sharded
+    n, k = 8, 3
+    rng = np.random.default_rng(9)
+    sks = rng.integers(-1, k, (4, n, 2)).astype(np.int32)
+    svs = rng.integers(0, 100, (4, n, 2)).astype(np.int32)
+    crs = np.full((4, n, k), -1, np.int32)
+    for mesh in (None, mesh_1d()):
+        sim = KafkaSim(n, k, capacity=16, max_sends=2, mesh=mesh)
+        s_auto = sim.run_rounds(sim.init_state(), sks, svs)
+        s_expl = sim.run_rounds(sim.init_state(), sks, svs, crs)
+        for a, b in zip(s_auto, s_expl):
+            assert (np.asarray(a) == np.asarray(b)).all()
