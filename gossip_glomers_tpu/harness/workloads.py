@@ -38,14 +38,22 @@ def _check_kv_linearizable(trace, service_id: str,
     enter the history as indeterminate, per the Jepsen convention."""
     by_key: dict[str, dict] = {}
     ok = True
+    unknown = 0
     for k, hist in sorted(histories_from_kv_trace(trace,
                                                   service_id).items()):
         k_ok, d = check_linearizable(hist)
         by_key[k] = {"ok": k_ok, "n_ops": d["n_ops"],
                      "verdict": d["verdict"]}
         ok = ok and k_ok
+        unknown += d["verdict"] == "unknown"
     details["linearizable"] = ok
     details["lin_by_key"] = by_key
+    # Budget-exceeded searches return ok=True with a per-key "unknown"
+    # verdict (Jepsen's convention: can't certify a violation), so the
+    # aggregate alone cannot distinguish a fully DECIDED pass from one
+    # that gave up on some keys.  Surface the count at the top level —
+    # a certification with lin_unknown_keys > 0 hit the state budget.
+    details["lin_unknown_keys"] = unknown
     return ok
 
 

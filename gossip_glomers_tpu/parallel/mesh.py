@@ -7,8 +7,34 @@ on real TPU slices), optionally capped by the simulated node count.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 from jax.sharding import Mesh
+
+
+def force_virtual_devices(n: int = 8) -> None:
+    """Point this process at an ``n``-device virtual CPU mesh (XLA's
+    host-platform device splitting — same SPMD partitioner and
+    collectives as ``n`` real chips, one host core executing all
+    shards).  MUST run before the JAX backend initializes (the flags
+    are read lazily at first device query, so pre-backend-init is
+    enough even if jax is already imported); shared by the mesh
+    benchmarks (mesh_takeover.py, bench_pr1.py) and mirrored by
+    tests/conftest.py."""
+    import sys
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "jax" in sys.modules:
+        # a sitecustomize on TPU images registers the TPU plugin and
+        # forces the platform at interpreter start; config.update
+        # after import wins over it (see tests/conftest.py)
+        sys.modules["jax"].config.update("jax_platforms", "cpu")
 
 
 def pick_mesh(max_axis: int | None = None,

@@ -25,6 +25,11 @@ Modules:
 - :mod:`.unique_ids` — challenge 2: coordination-free (t, node, seq)
   id mint.
 - :mod:`.echo` — challenge 1: batched identity, the smoke test.
+- :mod:`.engine` — the shared donation-first execution engine every
+  stateful sim runs on: the ``shard_map`` entry-point compat, buffer-
+  donating ``jit_program``, mesh collectives, round-fused drivers, and
+  the halo primitives (see ARCHITECTURE.md "The shared execution
+  engine").
 """
 
 from .broadcast import (BroadcastSim, BroadcastState, Partitions,

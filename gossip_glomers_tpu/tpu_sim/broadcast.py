@@ -43,6 +43,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .engine import (donate_argnums_for, fori_rounds, shard_map,
+                     stepwise_converge, while_converge)
+
 WORD = 32
 
 
@@ -669,10 +672,11 @@ class BroadcastSim:
         # replicated (L, N, W) ring — see _gather_or_delayed)
         self._delay_set = (() if delays is None else tuple(
             int(x) for x in np.unique(np.asarray(delays))))
-        self._fused = None
-        self._fused_max_rounds = None
-        self._fixed = None
-        self._fixed_rounds = None
+        # fused/fixed runner caches, keyed by (trip parameter, donate):
+        # each value is the engine-built program (fused) or a
+        # (runner, flood parts | None) pair (fixed) — see _build_fixed
+        self._fused = {}
+        self._fixed = {}
 
         nbr_mask = nbrs >= 0
         deg = nbr_mask.sum(axis=1).astype(np.uint32)
@@ -752,6 +756,12 @@ class BroadcastSim:
         if self.mesh is not None:
             received = jax.device_put(
                 received, NamedSharding(self.mesh, self._state_spec))
+        # frontier starts equal to received but must be a DISTINCT
+        # buffer: the donation-first drivers (engine.py) donate the
+        # whole state pytree, and XLA rejects donating one buffer
+        # twice.  Device-side copy (not a second host upload), after
+        # placement so the copy lands with the right sharding.
+        frontier = jnp.copy(received)
         history = None
         if self._delayed is not None or self._edge is not None:
             # words-major ring of past LOCAL payload blocks (L, W, N),
@@ -776,7 +786,7 @@ class BroadcastSim:
                     history,
                     NamedSharding(self.mesh,
                                   P(None, *self._state_spec)))
-        return BroadcastState(received=received, frontier=received,
+        return BroadcastState(received=received, frontier=frontier,
                               t=jnp.int32(0), msgs=jnp.uint32(0),
                               history=history,
                               srv_msgs=(jnp.uint32(0) if self._srv_on
@@ -1023,7 +1033,7 @@ class BroadcastSim:
 
             @jax.jit
             @functools.partial(
-                jax.shard_map, mesh=self.mesh,
+                shard_map, mesh=self.mesh,
                 in_specs=(state_spec, P("nodes")) + extra_specs,
                 out_specs=state_spec,
                 check_vma=False,
@@ -1038,7 +1048,7 @@ class BroadcastSim:
         if self.delays is not None:
             @jax.jit
             @functools.partial(
-                jax.shard_map, mesh=self.mesh,
+                shard_map, mesh=self.mesh,
                 in_specs=(state_spec, node_spec, node_spec, part_spec,
                           node_spec),
                 out_specs=state_spec, check_vma=False,
@@ -1053,7 +1063,7 @@ class BroadcastSim:
 
         @jax.jit
         @functools.partial(
-            jax.shard_map, mesh=self.mesh,
+            shard_map, mesh=self.mesh,
             in_specs=(state_spec, node_spec, node_spec, part_spec),
             out_specs=state_spec,
         )
@@ -1067,15 +1077,21 @@ class BroadcastSim:
     def step(self, state: BroadcastState) -> BroadcastState:
         return self._step(state, self.nbrs, self.nbr_mask)
 
-    def _build_fused(self, max_rounds: int):
-        """Whole-convergence runner as ONE device program: a
-        ``lax.while_loop`` of rounds with the convergence check on
-        device.  Avoids a host↔device round-trip per step — the per-call
-        dispatch latency is what dominates small rounds, especially over
-        a remote-TPU tunnel."""
+    def _build_fused(self, max_rounds: int, donate: bool):
+        """Whole-convergence runner as ONE device program: the engine's
+        ``while_converge`` — rounds under a ``lax.while_loop`` with the
+        convergence check on device.  Avoids a host↔device round-trip
+        per step — the per-call dispatch latency is what dominates small
+        rounds, especially over a remote-TPU tunnel.
+
+        ``donate``: donate the state pytree into the program (the
+        :meth:`run_fused` path, which stages the state internally), so
+        the loop holds ONE live state copy instead of input + output —
+        the engine's donation-first contract (engine.py)."""
         parts, sync_every = self.parts, self.sync_every
         limit = jnp.int32(max_rounds)
         wm = self.words_major
+        dn = donate_argnums_for(donate, 0)
 
         def eq_target(s: BroadcastState, target) -> jnp.ndarray:
             # target is (W,); received is (W, n) words-major, (n, W) else
@@ -1085,12 +1101,9 @@ class BroadcastSim:
         if self.mesh is None:
             extra = self._wm_extra_args()
 
-            @jax.jit
+            @functools.partial(jax.jit, donate_argnums=dn)
             def run(state: BroadcastState, nbrs, nbr_mask, target, deg,
                     *masks):
-                def cond(s):
-                    return (s.t < limit) & ~eq_target(s, target)
-
                 def body(s):
                     if wm:
                         return self._wm_round_single(s, deg,
@@ -1100,7 +1113,8 @@ class BroadcastSim:
                                       delays=self.delays,
                                   delay_set=self._delay_set)
 
-                return lax.while_loop(cond, body, state)
+                return while_converge(
+                    body, lambda s: eq_target(s, target), state, limit)
 
             return lambda state, nbrs, nbr_mask, target: run(
                 state, nbrs, nbr_mask, target, self.deg, *extra)
@@ -1111,38 +1125,28 @@ class BroadcastSim:
         axes = tuple(mesh.axis_names)
         n_shards = int(np.prod(mesh.devices.shape))
 
-        def while_converge(state, target, one_round):
+        def converge(state, target, one_round):
             def all_converged(s: BroadcastState) -> jnp.ndarray:
                 ok_local = eq_target(s, target)
                 return (lax.psum(ok_local.astype(jnp.int32), axes)
                         == n_shards)
 
-            def cond(carry):
-                s, done = carry
-                return (~done) & (s.t < limit)
-
-            def body(carry):
-                s, _ = carry
-                s2 = one_round(s)
-                return (s2, all_converged(s2))
-
-            final, _ = lax.while_loop(cond, body,
-                                      (state, all_converged(state)))
-            return final
+            return while_converge(one_round, all_converged, state,
+                                  limit)
 
         if wm:
             extra_specs, extra_args = self._wm_mesh_extra()
 
-            @jax.jit
+            @functools.partial(jax.jit, donate_argnums=dn)
             @functools.partial(
-                jax.shard_map, mesh=mesh,
+                shard_map, mesh=mesh,
                 in_specs=(state_spec, P("nodes"), target_spec)
                 + extra_specs,
                 out_specs=state_spec, check_vma=False,
             )
             def run_wm(state: BroadcastState, deg, target,
                        *masks) -> BroadcastState:
-                return while_converge(
+                return converge(
                     state, target,
                     lambda s: self._sharded_round_wm(s, deg,
                                                      masks or None))
@@ -1151,16 +1155,16 @@ class BroadcastSim:
                 state, self.deg, target, *extra_args)
 
         if self.delays is not None:
-            @jax.jit
+            @functools.partial(jax.jit, donate_argnums=dn)
             @functools.partial(
-                jax.shard_map, mesh=mesh,
+                shard_map, mesh=mesh,
                 in_specs=(state_spec, node_spec, node_spec, target_spec,
                           part_spec, node_spec),
                 out_specs=state_spec, check_vma=False,
             )
             def run_d(state: BroadcastState, nbrs, nbr_mask, target,
                       parts: Partitions, delays) -> BroadcastState:
-                return while_converge(
+                return converge(
                     state, target,
                     lambda s: self._sharded_round(s, nbrs, nbr_mask,
                                                   parts, delays))
@@ -1168,23 +1172,23 @@ class BroadcastSim:
             return lambda state, nbrs, nbr_mask, target: run_d(
                 state, nbrs, nbr_mask, target, self.parts, self.delays)
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=dn)
         @functools.partial(
-            jax.shard_map, mesh=mesh,
+            shard_map, mesh=mesh,
             in_specs=(state_spec, node_spec, node_spec, target_spec,
                       part_spec),
             out_specs=state_spec,
         )
         def run(state: BroadcastState, nbrs, nbr_mask, target,
                 parts: Partitions) -> BroadcastState:
-            return while_converge(
+            return converge(
                 state, target,
                 lambda s: self._sharded_round(s, nbrs, nbr_mask, parts))
 
         return lambda state, nbrs, nbr_mask, target: run(
             state, nbrs, nbr_mask, target, self.parts)
 
-    def _build_fixed(self, rounds: int):
+    def _build_fixed(self, rounds: int, donate: bool):
         """Fixed-trip-count runner: ``lax.fori_loop`` of exactly
         ``rounds`` rounds, counter-only control flow.  Bit-identical to
         the while-loop runner stopped at its convergence round, but
@@ -1194,15 +1198,19 @@ class BroadcastSim:
         ~100 ms + ~1 ms/round on the remote-TPU tunnel), which is
         transport artifact, not simulation compute.  The caller must
         know ``rounds`` (e.g. from a prior :meth:`run_fused`) and
-        should re-verify convergence on the result."""
+        should re-verify convergence on the result.
+
+        Returns ``(runner, flood_parts | None)``.  ``donate``: donate
+        the state (flood specialization: the (received, frontier) loop
+        carry) into the program — the caller must treat the passed
+        state as consumed (benchmarks re-stage per chain)."""
         parts, sync_every = self.parts, self.sync_every
         wm = self.words_major
+        dn = donate_argnums_for(donate, 0)
+        dn2 = donate_argnums_for(donate, 0, 1)
 
         def iterate(state, one_round):
-            return lax.fori_loop(0, rounds, lambda i, s: one_round(s),
-                                 state)
-
-        self._fixed_parts = None   # set by the flood specialization
+            return fori_rounds(one_round, state, rounds)
 
         # Pure-flood specialization: when no sync wave fires within the
         # trip count (rounds <= sync_every) and no ledgers/faults need
@@ -1225,7 +1233,8 @@ class BroadcastSim:
             # would flip the tunnel session (see timing.py)
             degs, mask_arrays = _degree_masks(self._host_deg)
             masks = [jax.device_put(m) for m in mask_arrays]
-            loop_fn = jax.jit(_flood_loop(self.exchange, rounds))
+            loop_fn = jax.jit(_flood_loop(self.exchange, rounds),
+                              donate_argnums=dn2)
 
             @jax.jit
             def ledger_fn(state: BroadcastState, rec, fr, *ms):
@@ -1236,7 +1245,7 @@ class BroadcastSim:
         if self.mesh is None:
             extra = self._wm_extra_args()
 
-            @jax.jit
+            @functools.partial(jax.jit, donate_argnums=dn)
             def run(state: BroadcastState, nbrs, nbr_mask, deg, *masks):
                 def one(s):
                     if wm:
@@ -1250,8 +1259,8 @@ class BroadcastSim:
 
                 return iterate(state, one)
 
-            return lambda state, nbrs, nbr_mask: run(
-                state, nbrs, nbr_mask, self.deg, *extra)
+            return (lambda state, nbrs, nbr_mask: run(
+                state, nbrs, nbr_mask, self.deg, *extra)), None
 
         mesh = self.mesh
         state_spec, node_spec, part_spec = self._specs()
@@ -1270,14 +1279,15 @@ class BroadcastSim:
                      for m in mask_arrays]
 
             loop_fn = jax.jit(functools.partial(
-                jax.shard_map, mesh=mesh,
+                shard_map, mesh=mesh,
                 in_specs=(st_spec, st_spec),
                 out_specs=(st_spec, st_spec), check_vma=False,
-            )(_flood_loop(self.sharded_exchange, rounds)))
+            )(_flood_loop(self.sharded_exchange, rounds)),
+                donate_argnums=dn2)
 
             @jax.jit
             @functools.partial(
-                jax.shard_map, mesh=mesh,
+                shard_map, mesh=mesh,
                 in_specs=(state_spec, st_spec, st_spec)
                 + tuple(mask_spec for _ in masks),
                 out_specs=state_spec, check_vma=False,
@@ -1291,9 +1301,9 @@ class BroadcastSim:
         if wm:
             extra_specs, extra_args = self._wm_mesh_extra()
 
-            @jax.jit
+            @functools.partial(jax.jit, donate_argnums=dn)
             @functools.partial(
-                jax.shard_map, mesh=mesh,
+                shard_map, mesh=mesh,
                 in_specs=(state_spec, P("nodes")) + extra_specs,
                 out_specs=state_spec, check_vma=False,
             )
@@ -1303,13 +1313,13 @@ class BroadcastSim:
                     state, lambda s: self._sharded_round_wm(
                         s, deg, masks or None))
 
-            return lambda state, nbrs, nbr_mask: run_wm(
-                state, self.deg, *extra_args)
+            return (lambda state, nbrs, nbr_mask: run_wm(
+                state, self.deg, *extra_args)), None
 
         if self.delays is not None:
-            @jax.jit
+            @functools.partial(jax.jit, donate_argnums=dn)
             @functools.partial(
-                jax.shard_map, mesh=mesh,
+                shard_map, mesh=mesh,
                 in_specs=(state_spec, node_spec, node_spec, part_spec,
                           node_spec),
                 out_specs=state_spec, check_vma=False,
@@ -1320,12 +1330,12 @@ class BroadcastSim:
                     state, lambda s: self._sharded_round(
                         s, nbrs, nbr_mask, parts, delays))
 
-            return lambda state, nbrs, nbr_mask: run_d(
-                state, nbrs, nbr_mask, self.parts, self.delays)
+            return (lambda state, nbrs, nbr_mask: run_d(
+                state, nbrs, nbr_mask, self.parts, self.delays)), None
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=dn)
         @functools.partial(
-            jax.shard_map, mesh=mesh,
+            shard_map, mesh=mesh,
             in_specs=(state_spec, node_spec, node_spec, part_spec),
             out_specs=state_spec,
         )
@@ -1335,8 +1345,8 @@ class BroadcastSim:
                 state,
                 lambda s: self._sharded_round(s, nbrs, nbr_mask, parts))
 
-        return lambda state, nbrs, nbr_mask: run_g(
-            state, nbrs, nbr_mask, self.parts)
+        return (lambda state, nbrs, nbr_mask: run_g(
+            state, nbrs, nbr_mask, self.parts)), None
 
     # -- drivers -----------------------------------------------------------
 
@@ -1350,19 +1360,14 @@ class BroadcastSim:
         """Step until every node holds every injected value (or
         ``max_rounds``).  Returns (final state, rounds run).
 
-        One host↔device sync per ``check_every`` rounds; use
-        :meth:`run_fused` for a single-dispatch whole-run program.
+        One host↔device sync per ``check_every`` rounds (the engine's
+        host-driven convergence loop); use :meth:`run_fused` for a
+        single-dispatch whole-run program.
         """
         target = self.target_bits(inject)
-        state = self.init_state(inject)
-        rounds = 0
-        while rounds < max_rounds:
-            for _ in range(check_every):
-                state = self.step(state)
-                rounds += 1
-            if self.converged(state, target):
-                break
-        return state, rounds
+        return stepwise_converge(
+            self.step, lambda s: self.converged(s, target),
+            self.init_state(inject), max_rounds, check_every)
 
     def stage(self, inject: np.ndarray
               ) -> tuple[BroadcastState, jnp.ndarray]:
@@ -1377,58 +1382,77 @@ class BroadcastSim:
         return self.init_state(inject), target
 
     def run_staged(self, state: BroadcastState, target: jnp.ndarray, *,
-                   max_rounds: int = 1 << 16) -> BroadcastState:
+                   max_rounds: int = 1 << 16,
+                   donate: bool = False) -> BroadcastState:
         """The whole-convergence device program on a pre-staged
-        (state, target) pair from :meth:`stage` — one dispatch."""
-        if self._fused is None or self._fused_max_rounds != max_rounds:
-            self._fused = self._build_fused(max_rounds)
-            self._fused_max_rounds = max_rounds
-        return self._fused(state, self.nbrs, self.nbr_mask, target)
+        (state, target) pair from :meth:`stage` — one dispatch.  With
+        ``donate`` the state's buffers are consumed (updated in place);
+        the default keeps caller-owned staged states reusable."""
+        key = (max_rounds, donate)
+        if key not in self._fused:
+            self._fused[key] = self._build_fused(max_rounds, donate)
+        return self._fused[key](state, self.nbrs, self.nbr_mask, target)
 
     def run_fused(self, inject: np.ndarray, *, max_rounds: int = 1 << 16,
                   ) -> tuple[BroadcastState, int]:
         """Like :meth:`run` but the whole convergence loop executes as a
-        single device program.  Returns (final state, rounds run)."""
+        single device program.  Returns (final state, rounds run).
+
+        Donation-first: the state is staged internally and donated into
+        the program, so the run holds ONE live state copy — this is the
+        driver that brings the recorded ~3x live-buffer factor of the
+        undonated fused programs (BENCH_ALL_r05.json OOM rows) toward
+        1x."""
         state, target = self.stage(inject)
-        final = self.run_staged(state, target, max_rounds=max_rounds)
+        final = self.run_staged(state, target, max_rounds=max_rounds,
+                                donate=True)
         return final, int(final.t)
 
     def _wire_flood_parts(self, loop_fn, ledger_fn, masks):
         """Phase-split handles for benchmarks: the loop program is the
         only thing a timed sample should execute — the ledger program's
         reduces disturb the tunnel session (timing.py runs every sample
-        before any finish)."""
-        def finish(state0, loop_out):
-            return ledger_fn(state0, *loop_out, *masks)
+        before any finish).
 
-        self._fixed_parts = (loop_fn, finish)
+        Donation note: with a donated ``loop_fn`` the input state's
+        received/frontier buffers are consumed by the loop, so
+        ``finish`` (and the composed runner) swap the loop OUTPUT back
+        into the state pytree before the ledger program flattens it —
+        passing the originals would read deleted buffers."""
+        def finish(state0, loop_out):
+            state0 = state0._replace(received=loop_out[0],
+                                     frontier=loop_out[1])
+            return ledger_fn(state0, *loop_out, *masks)
 
         def composed(state, nbrs, nbr_mask):
             return finish(state, loop_fn(state.received,
                                          state.frontier))
 
-        return composed
+        return composed, (loop_fn, finish)
 
-    def build_fixed(self, rounds: int):
+    def build_fixed(self, rounds: int, *, donate: bool = False):
         """Build (and cache) the fixed-trip runner for ``rounds``.
         Returns the phase-split handles ``(loop_fn, finish)`` when the
         pure-flood specialization applies (loop_fn: (received,
         frontier) -> (received, frontier); finish: (state0, loop_out)
-        -> final state), else None (generic body, no split)."""
-        if self._fixed is None or self._fixed_rounds != rounds:
-            self._fixed = self._build_fixed(rounds)
-            self._fixed_rounds = rounds
-        return self._fixed_parts
+        -> final state), else None (generic body, no split).  With
+        ``donate`` the loop program consumes its inputs (engine.py) —
+        chained callers must re-stage per chain."""
+        key = (rounds, donate)
+        if key not in self._fixed:
+            self._fixed[key] = self._build_fixed(rounds, donate)
+        return self._fixed[key][1]
 
-    def run_staged_fixed(self, state: BroadcastState,
-                         rounds: int) -> BroadcastState:
+    def run_staged_fixed(self, state: BroadcastState, rounds: int, *,
+                         donate: bool = False) -> BroadcastState:
         """Exactly ``rounds`` rounds as one counter-only fori_loop
         program (see :meth:`_build_fixed`); the benchmark timed path.
         Bit-identical to :meth:`run_staged` when ``rounds`` is that
         run's convergence round count — callers re-verify with
-        :meth:`converged`."""
-        self.build_fixed(rounds)
-        return self._fixed(state, self.nbrs, self.nbr_mask)
+        :meth:`converged`.  With ``donate`` the state is consumed."""
+        self.build_fixed(rounds, donate=donate)
+        return self._fixed[(rounds, donate)][0](state, self.nbrs,
+                                                self.nbr_mask)
 
     def received_node_major(self, state: BroadcastState) -> np.ndarray:
         """(N, W) received bitset regardless of the internal layout."""

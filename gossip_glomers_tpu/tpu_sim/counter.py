@@ -34,7 +34,6 @@ shard_map exactly like the broadcast sim.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
@@ -42,6 +41,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .engine import (Collectives, collectives, donate_argnums_for,
+                     fori_rounds, jit_program)
 
 
 class KVReach(NamedTuple):
@@ -134,14 +136,23 @@ class CounterSim:
                          else KVReach.none(n_nodes))
         self._node_spec = P("nodes") if mesh is not None else None
         self._step = self._build_step()
-        self._run_n = self._build_run_n()
+        self._run_n = self._build_run_n(donate=False)
+        # the donated twin: same traced rounds, state buffers consumed
+        # and reused in place (engine.py module docstring)
+        self._run_n_donated = self._build_run_n(donate=True)
 
     def init_state(self) -> CounterState:
-        z = jnp.zeros((self.n_nodes,), jnp.int32)
-        if self.mesh is not None:
-            z = jax.device_put(
-                z, NamedSharding(self.mesh, self._node_spec))
-        return CounterState(pending=z, cached=z, kv=jnp.int32(0),
+        # pending and cached start equal but must be DISTINCT buffers:
+        # the donated run_fused driver donates the whole pytree, and
+        # XLA rejects donating one buffer twice
+        def z():
+            arr = jnp.zeros((self.n_nodes,), jnp.int32)
+            if self.mesh is not None:
+                arr = jax.device_put(
+                    arr, NamedSharding(self.mesh, self._node_spec))
+            return arr
+
+        return CounterState(pending=z(), cached=z(), kv=jnp.int32(0),
                             t=jnp.int32(0), msgs=jnp.uint32(0))
 
     # -- op injection ------------------------------------------------------
@@ -158,15 +169,17 @@ class CounterSim:
 
     # -- round -------------------------------------------------------------
 
-    def _round(self, state: CounterState, row_ids: jnp.ndarray,
-               sched: KVReach, *, psum=None) -> CounterState:
+    def _round(self, state: CounterState, coll: Collectives,
+               sched: KVReach) -> CounterState:
         """One round: flush attempts + the periodic cache poll.
 
-        ``psum`` is the cross-shard reduction (identity single-device).
+        ``coll`` is the engine's collective surface (identity
+        single-device; psum/pmin over 'nodes' under shard_map).
         """
+        row_ids = coll.row_ids
+
         def allsum(x):
-            s = jnp.sum(x)
-            return psum(s) if psum is not None else s
+            return coll.reduce_sum(jnp.sum(x))
 
         reach = _reach(state.t, row_ids, self.kv_sched)
         want = (state.pending > 0) & reach
@@ -202,14 +215,11 @@ class CounterSim:
                 prix = jnp.minimum(x, jnp.uint32(0xFFFFFFFE))
                 cand_pri = jnp.where(fresh, prix,
                                      jnp.uint32(0xFFFFFFFF))
-                lp = jnp.min(cand_pri)
-                best_pri = lp if psum is None else lax.pmin(lp, "nodes")
+                best_pri = coll.reduce_min(jnp.min(cand_pri))
                 has_winner = best_pri < jnp.uint32(0xFFFFFFFF)
                 cand_row = jnp.where(fresh & (prix == best_pri),
                                      row_ids, jnp.int32(2**31 - 1))
-                lr = jnp.min(cand_row)
-                best_row = (lr if psum is None
-                            else lax.pmin(lr, "nodes"))
+                best_row = coll.reduce_min(jnp.min(cand_row))
                 winner = jnp.where(has_winner, best_row,
                                    jnp.int32(self.n_nodes))
             else:
@@ -221,9 +231,7 @@ class CounterSim:
                     jnp.int32(2**pri_bits - 2))
                 key = (pri << self._row_bits) | row_ids
                 candidates = jnp.where(fresh, key, jnp.int32(2**31 - 1))
-                local_min = jnp.min(candidates)
-                best = (local_min if psum is None
-                        else lax.pmin(local_min, "nodes"))
+                best = coll.reduce_min(jnp.min(candidates))
                 has_winner = best < jnp.int32(2**31 - 1)
                 winner = jnp.where(
                     has_winner,
@@ -255,79 +263,79 @@ class CounterSim:
         return CounterState(pending=pending, cached=cached, kv=kv,
                             t=state.t + 1, msgs=state.msgs + attempts)
 
-    def _build_step(self):
-        sched = self.kv_sched
-
-        if self.mesh is None:
-            row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
-
-            @jax.jit
-            def step(state: CounterState) -> CounterState:
-                return self._round(state, row_ids, sched)
-            return step
-
-        mesh = self.mesh
+    def _state_spec(self):
         node_spec = self._node_spec
-        state_spec = CounterState(node_spec, node_spec, P(), P(), P())
+        return CounterState(node_spec, node_spec, P(), P(), P())
+
+    def _build_step(self):
+        mesh = self.mesh
+
+        if mesh is None:
+            def step(state: CounterState) -> CounterState:
+                return self._round(
+                    state, collectives(self.n_nodes), self.kv_sched)
+            return jit_program(step)
+
         sched_spec = KVReach(P(), P(), P(None, None))
 
-        @jax.jit
-        @functools.partial(
-            jax.shard_map, mesh=mesh,
-            in_specs=(state_spec, sched_spec), out_specs=state_spec)
         def step(state: CounterState, sched: KVReach) -> CounterState:
-            block = state.pending.shape[0]
-            row_ids = (lax.axis_index("nodes") * block
-                       + jnp.arange(block, dtype=jnp.int32))
-            return self._round(state, row_ids, sched,
-                               psum=lambda s: lax.psum(s, "nodes"))
+            coll = collectives(state.pending.shape[0], mesh)
+            return self._round(state, coll, sched)
 
-        return lambda state: step(state, self.kv_sched)
+        prog = jit_program(step, mesh=mesh,
+                           in_specs=(self._state_spec(), sched_spec),
+                           out_specs=self._state_spec())
+        return lambda state: prog(state, self.kv_sched)
 
-    def _build_run_n(self):
+    def _build_run_n(self, donate: bool):
         """Multi-round runner as ONE device program (dynamic fori_loop
         bound) — one dispatch per run() call instead of per round.  Also
         sidesteps a CPU-backend hazard: piling up many un-synced
         multi-device dispatches can interleave their collectives across
-        programs and deadlock the in-process rendezvous."""
-        sched = self.kv_sched
+        programs and deadlock the in-process rendezvous.
 
-        if self.mesh is None:
-            row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
+        ``donate``: consume the input state's buffers (the
+        :meth:`run_fused` driver) so the fused loop holds ONE live state
+        copy instead of input + output."""
+        mesh = self.mesh
+        dn = donate_argnums_for(donate, 0)
 
-            @jax.jit
+        if mesh is None:
             def run_n(state: CounterState, n) -> CounterState:
-                return lax.fori_loop(
-                    0, n, lambda i, s: self._round(s, row_ids, sched),
-                    state)
-            return run_n
+                coll = collectives(self.n_nodes)
+                return fori_rounds(
+                    lambda s: self._round(s, coll, self.kv_sched),
+                    state, n)
+            return jit_program(run_n, donate_argnums=dn)
 
-        node_spec = self._node_spec
-        state_spec = CounterState(node_spec, node_spec, P(), P(), P())
         sched_spec = KVReach(P(), P(), P(None, None))
 
-        @jax.jit
-        @functools.partial(
-            jax.shard_map, mesh=self.mesh,
-            in_specs=(state_spec, sched_spec, P()), out_specs=state_spec)
-        def run_n(state: CounterState, sched: KVReach, n) -> CounterState:
-            block = state.pending.shape[0]
-            row_ids = (lax.axis_index("nodes") * block
-                       + jnp.arange(block, dtype=jnp.int32))
-            return lax.fori_loop(
-                0, n,
-                lambda i, s: self._round(
-                    s, row_ids, sched,
-                    psum=lambda x: lax.psum(x, "nodes")),
-                state)
+        def run_n(state: CounterState, sched: KVReach,
+                  n) -> CounterState:
+            coll = collectives(state.pending.shape[0], mesh)
+            return fori_rounds(lambda s: self._round(s, coll, sched),
+                               state, n)
 
-        return lambda state, n: run_n(state, self.kv_sched, n)
+        prog = jit_program(
+            run_n, mesh=mesh,
+            in_specs=(self._state_spec(), sched_spec, P()),
+            out_specs=self._state_spec(), donate_argnums=dn)
+        return lambda state, n: prog(state, self.kv_sched, n)
 
     def step(self, state: CounterState) -> CounterState:
         return self._step(state)
 
     def run(self, state: CounterState, n_rounds: int) -> CounterState:
         return self._run_n(state, jnp.int32(n_rounds))
+
+    def run_fused(self, state: CounterState,
+                  n_rounds: int) -> CounterState:
+        """Single-dispatch donation-first driver: bit-identical to
+        :meth:`run` (and to ``n_rounds`` chained :meth:`step` calls) but
+        the input state's buffers are DONATED — updated in place, so the
+        whole fused loop keeps one live state copy.  The passed-in state
+        must not be used again afterwards."""
+        return self._run_n_donated(state, jnp.int32(n_rounds))
 
     # -- reads -------------------------------------------------------------
 

@@ -37,15 +37,16 @@ class EchoSim:
                             msgs=state.msgs + n_ops * jnp.uint32(2))
             return new, replies
 
+        from .engine import jit_program
+
         if mesh is None:
-            self._step = jax.jit(echo)
+            self._step = jit_program(echo)
         else:
-            import functools
             spec = P("nodes", None)
-            self._step = jax.jit(functools.partial(
-                jax.shard_map, mesh=mesh,
+            self._step = jit_program(
+                echo, mesh=mesh,
                 in_specs=(EchoState(P(), P()), spec, spec),
-                out_specs=(EchoState(P(), P()), spec))(echo))
+                out_specs=(EchoState(P(), P()), spec))
 
     def init_state(self) -> EchoState:
         return EchoState(t=jnp.int32(0), msgs=jnp.uint32(0))

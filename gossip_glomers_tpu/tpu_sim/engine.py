@@ -1,0 +1,315 @@
+"""Donation-first fused execution engine shared by the stateful sims.
+
+Every stateful tpu_sim workload (broadcast, counter, kafka) runs the
+same three-layer program shape:
+
+1. a **round** — pure state -> state function with identity collectives
+   single-device and mesh collectives (all_gather / psum / pmin / pmax
+   over the ``nodes`` axis) under shard_map;
+2. a **driver** — the round fused into one device program (``fori_loop``
+   for fixed trip counts, ``scan`` for pre-staged per-round inputs,
+   ``while_loop`` with an on-device convergence check for
+   run-to-convergence), so a whole run costs ONE dispatch instead of one
+   per round;
+3. a **program wrapper** — ``jit`` (plus ``shard_map`` on a mesh) with
+   **buffer donation** on the state pytree, so the fused loop updates
+   the state in place instead of holding input AND output copies live.
+
+Before this module each sim hand-rolled all three; the recorded node-axis
+sweep (BENCH_ALL_r05.json) shows the cost: the undonated fused programs
+hold a ~3x live-buffer factor (state in, state out, loop temp), which is
+exactly the "~3 x 8.6 GB" that OOMed the 16M-node W=128 runs on a single
+chip.  With ``donate_argnums`` on the state the factor drops toward 1x:
+XLA aliases the donated input buffers into the outputs and the loop
+carries one live copy plus transient exchange temps.
+
+The halo primitives (:func:`sharded_roll`, :func:`sharded_shift`) live
+here too: they are the engine's distributed delivery layer — O(block)
+slice ppermutes over ICI per round, the same neighbor-exchange pattern
+ring-attention systems use on the sequence axis — consumed by the
+structured broadcast exchanges (structured.py) and by any workload that
+moves per-node payload blocks across the ``nodes`` axis.
+
+``shard_map`` entry-point compat: ``jax.shard_map`` (with ``check_vma``)
+only exists in newer JAX; on older releases the implementation lives at
+``jax.experimental.shard_map.shard_map`` with the ``check_rep``
+spelling.  :func:`shard_map` here is the ONE entry point the repo uses —
+everything else imports it from this module.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# -- shard_map entry-point compat ---------------------------------------
+
+if hasattr(jax, "shard_map"):                    # JAX >= 0.6 spelling
+
+    def shard_map(f: Callable, *, mesh, in_specs, out_specs,
+                  check_vma: bool = True) -> Callable:
+        """The repo's single shard_map entry point (module docstring)."""
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+
+else:                                            # jax.experimental era
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    def shard_map(f: Callable, *, mesh, in_specs, out_specs,
+                  check_vma: bool = True) -> Callable:
+        """The repo's single shard_map entry point (module docstring).
+        The older checker (``check_rep``) predates the varying-manual-
+        axes rework and has no rules for control-flow primitives the
+        fused drivers are built from (``while``/``scan`` bodies raise
+        NotImplementedError), so on this path the check is always off —
+        numerics are identical either way; only the static replication
+        LINT is skipped."""
+        del check_vma
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_rep=False)
+
+
+def jit_program(f: Callable, *, mesh=None, in_specs=None, out_specs=None,
+                check_vma: bool = True, donate_argnums=(),
+                static_argnums=()) -> Callable:
+    """Build one device program: ``jit(shard_map(f))`` on a mesh, plain
+    ``jit(f)`` off it, with ``donate_argnums`` threading through — the
+    engine's single way to wrap a round or driver.  Donate the state
+    pytree argument of every fused loop (see module docstring); never
+    donate arguments the caller reuses across calls (adjacency, masks,
+    staged benchmark inputs)."""
+    if mesh is not None:
+        f = shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_vma)
+    return jax.jit(f, donate_argnums=donate_argnums,
+                   static_argnums=static_argnums)
+
+
+# -- halo delivery primitives -------------------------------------------
+
+
+def sharded_roll(x_local: jnp.ndarray, s: int, n: int, n_shards: int,
+                 axis_name: str = "nodes") -> jnp.ndarray:
+    """Distributed ``jnp.roll(x, s, axis=1)`` for a words-major (W, N)
+    array block-sharded over ``axis_name`` — the halo-exchange
+    primitive.
+
+    A global rotation by ``s`` touches at most two source shards per
+    destination shard, so it decomposes into one or two ``ppermute``s of
+    one block each plus a local stitch: O(block) bytes per shard per
+    stride over ICI, versus the O(N) all_gather the generic sharded path
+    pays.  This is the framework's ring collective — the same
+    neighbor-exchange pattern ring-attention-style systems use on the
+    sequence axis, applied to the node axis.
+
+    Must run inside shard_map over a mesh with ``axis_name``; ``s`` and
+    the shapes are static.
+    """
+    block = x_local.shape[1]
+    assert block * n_shards == n, "node axis must shard evenly"
+    s = s % n
+    q, r = divmod(s, block)
+    # out_local[:, c] = global[:, (p*B + c - s) mod N]:
+    #   c in [r, B) -> cols [0, B-r) of block (p - q);
+    #   c in [0, r) -> cols [B-r, B) of block (p - q - 1).
+    # Each contribution is sliced BEFORE the ppermute, so total ICI
+    # traffic is exactly B columns per shard for any stride (r columns
+    # when the rotation stays within one block, q == 0).
+
+    def send(sl: jnp.ndarray, off: int) -> jnp.ndarray:
+        if off % n_shards == 0:
+            return sl
+        perm = [((p - off) % n_shards, p) for p in range(n_shards)]
+        return jax.lax.ppermute(sl, axis_name, perm)
+
+    if r == 0:
+        return send(x_local, q)
+    head = send(x_local[:, : block - r], q)        # dest cols [r, B)
+    tail = send(x_local[:, block - r:], q + 1)     # dest cols [0, r)
+    return jnp.concatenate([tail, head], axis=1)
+
+
+def sharded_shift(x_local: jnp.ndarray, s: int, n_shards: int,
+                  axis_name: str = "nodes") -> jnp.ndarray:
+    """Distributed zero-fill shift for a words-major (W, N) array
+    block-sharded over ``axis_name``: out[:, g] = x[:, g + s] for
+    0 <= g + s < N, else 0 (s > 0 shifts left, s < 0 shifts right;
+    g is the global column).
+
+    Unlike :func:`sharded_roll` nothing wraps, so the boundary shards
+    take ppermute's missing-source zeros as the fill — exactly the
+    zero-padding the single-device shift exchanges use.  Communicates
+    only the |s|-column halo per shard.  Requires |s| < block.
+    """
+    block = x_local.shape[1]
+    a = abs(s)
+    assert a < block, "halo shift needs |s| < block; use sharded_roll"
+    if a == 0:
+        return x_local
+    if s > 0:
+        halo = jax.lax.ppermute(
+            x_local[:, :a], axis_name,
+            [(p + 1, p) for p in range(n_shards - 1)])
+        return jnp.concatenate([x_local[:, a:], halo], axis=1)
+    halo = jax.lax.ppermute(
+        x_local[:, block - a:], axis_name,
+        [(p, p + 1) for p in range(n_shards - 1)])
+    return jnp.concatenate([halo, x_local[:, : block - a]], axis=1)
+
+
+# -- collectives --------------------------------------------------------
+
+
+class Collectives(NamedTuple):
+    """The per-round cross-shard surface every sim round consumes, built
+    identity single-device and from the mesh axis under shard_map —
+    previously re-derived ad hoc inside each sim's sharded round.
+
+    - ``row_ids``: (block,) int32 GLOBAL node indices of the local rows.
+    - ``widen(x)``: local payload block -> full node axis (identity /
+      ``all_gather`` along the node axis).
+    - ``reduce_sum/max/min``: globalize a reduction (identity / psum,
+      pmax, pmin).  ``reduce_sum`` reduces over ALL mesh axes (ledger
+      scalars psum linearly across word shards too); min/max reduce over
+      the node axis.
+    - ``local_cols(m)``: this shard's column block of a full (N, N)
+      matrix (the replication matmul's destination side).
+    - ``axis_name``: the node axis name, or None off-mesh.
+    """
+
+    row_ids: jnp.ndarray
+    widen: Callable[[jnp.ndarray], jnp.ndarray]
+    reduce_sum: Callable[[jnp.ndarray], jnp.ndarray]
+    reduce_max: Callable[[jnp.ndarray], jnp.ndarray]
+    reduce_min: Callable[[jnp.ndarray], jnp.ndarray]
+    local_cols: Callable[[jnp.ndarray], jnp.ndarray]
+    axis_name: str | None
+
+
+def collectives(block: int, mesh=None, *, axis: str = "nodes",
+                gather_axis: int = 0) -> Collectives:
+    """Build the :class:`Collectives` for a round over ``block`` local
+    rows.  With a mesh this MUST be called from inside the shard_map'd
+    function (it reads ``lax.axis_index``); off-mesh it is pure."""
+    if mesh is None:
+        ident = lambda x: x                              # noqa: E731
+        return Collectives(
+            row_ids=jnp.arange(block, dtype=jnp.int32),
+            widen=ident, reduce_sum=ident, reduce_max=ident,
+            reduce_min=ident, local_cols=ident, axis_name=None)
+    axes = tuple(mesh.axis_names)
+    row_ids = (lax.axis_index(axis) * block
+               + jnp.arange(block, dtype=jnp.int32))
+    return Collectives(
+        row_ids=row_ids,
+        widen=lambda x: lax.all_gather(x, axis, axis=gather_axis,
+                                       tiled=True),
+        reduce_sum=lambda x: lax.psum(x, axes),
+        reduce_max=lambda x: lax.pmax(x, axis),
+        reduce_min=lambda x: lax.pmin(x, axis),
+        local_cols=lambda m: lax.dynamic_slice_in_dim(
+            m, lax.axis_index(axis) * block, block, axis=1),
+        axis_name=axis)
+
+
+# -- round-fused drivers (traced-side combinators) ----------------------
+
+
+def fori_rounds(round_fn: Callable, state, rounds, unroll: int = 1):
+    """Exactly ``rounds`` rounds as one counter-only ``fori_loop`` —
+    the fixed-trip driver (``rounds`` may be traced: dynamic bound;
+    ``unroll`` needs a static bound)."""
+    kw = {} if unroll == 1 else {"unroll": unroll}
+    return lax.fori_loop(0, rounds, lambda i, s: round_fn(s), state,
+                         **kw)
+
+
+def scan_rounds(round_fn: Callable, state, xs):
+    """R pre-staged rounds as one ``lax.scan``: ``round_fn(state, x) ->
+    state`` over the leading axis of the ``xs`` pytree."""
+    out, _ = lax.scan(lambda s, x: (round_fn(s, x), None), state, xs)
+    return out
+
+
+def while_converge(round_fn: Callable, converged: Callable, state,
+                   limit):
+    """Run-to-convergence as one ``while_loop`` with the check ON
+    DEVICE every round: no host↔device round-trip per step.
+    ``converged(state) -> () bool`` must already be globalized on a
+    mesh (psum the per-shard verdict inside the callback)."""
+    def cond(carry):
+        s, done = carry
+        return (~done) & (s.t < limit)
+
+    def body(carry):
+        s, _ = carry
+        s2 = round_fn(s)
+        return (s2, converged(s2))
+
+    final, _ = lax.while_loop(cond, body, (state, converged(state)))
+    return final
+
+
+def stepwise_converge(step: Callable, converged: Callable, state,
+                      max_rounds: int, check_every: int = 1):
+    """The host-driven convergence loop (one dispatch per round, one
+    D2H convergence read per ``check_every`` rounds) — the debuggable
+    twin of :func:`while_converge`, shared by the sims' ``run``
+    drivers.  Returns (final state, rounds run)."""
+    rounds = 0
+    while rounds < max_rounds:
+        for _ in range(check_every):
+            state = step(state)
+            rounds += 1
+        if converged(state):
+            break
+    return state, rounds
+
+
+# -- program accounting -------------------------------------------------
+
+
+def _footprint_of(compiled) -> dict | None:
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return None
+    arg = int(ma.argument_size_in_bytes)
+    out = int(ma.output_size_in_bytes)
+    tmp = int(ma.temp_size_in_bytes)
+    alias = int(ma.alias_size_in_bytes)
+    return {"argument_bytes": arg, "output_bytes": out,
+            "temp_bytes": tmp, "alias_bytes": alias,
+            "peak_live_bytes": arg + out + tmp - alias}
+
+
+def aot_compile(jitted: Callable, *args, **kw):
+    """Ahead-of-time compile: returns ``(executable, footprint | None)``
+    where footprint is :func:`memory_footprint`'s dict off the same
+    compilation.  Callers that want BOTH the analysis and a run must
+    execute the returned executable — jit's call cache does not reuse
+    AOT compilations, so analyzing via ``lower().compile()`` and then
+    calling the jitted function would compile the program twice."""
+    compiled = jitted.lower(*args, **kw).compile()
+    return compiled, _footprint_of(compiled)
+
+
+def memory_footprint(jitted: Callable, *args, **kw) -> dict | None:
+    """Analytic peak-live-bytes estimate of one compiled program from
+    XLA's buffer assignment (``memory_analysis``): arguments + outputs +
+    temps − donated aliases.  This is the number the donation mechanism
+    moves — the recorded single-chip OOMs (BENCH_ALL_r05.json) were
+    argument+output copies of the same state pytree held live at once.
+    None when the backend exposes no analysis.  Compiles the program
+    (and only compiles — use :func:`aot_compile` when the same program
+    will also be executed)."""
+    return aot_compile(jitted, *args, **kw)[1]
+
+
+def donate_argnums_for(donate: bool, *argnums: int) -> tuple:
+    """The ``donate_argnums`` tuple for a driver build: ``argnums`` when
+    donation is on, empty otherwise — keeps the two variants of every
+    cached program one-line apart."""
+    return tuple(argnums) if donate else ()

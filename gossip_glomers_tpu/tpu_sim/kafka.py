@@ -70,7 +70,6 @@ State (node axis shardable over the mesh):
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
@@ -80,6 +79,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .counter import KVReach, _reach
+from .engine import (collectives, donate_argnums_for, jit_program,
+                     scan_rounds)
 
 
 class KafkaState(NamedTuple):
@@ -121,7 +122,8 @@ class KafkaSim:
     def __init__(self, n_nodes: int, n_keys: int, capacity: int, *,
                  max_sends: int = 4, mesh: Mesh | None = None,
                  kv_retries: int = 10,
-                 kv_sched: KVReach | None = None) -> None:
+                 kv_sched: KVReach | None = None,
+                 repl_fast: bool | None = None) -> None:
         """``kv_sched``: lin-kv reachability windows (counter.KVReach —
         the same nemesis shape the counter's flush is gated by).  A
         node partitioned from lin-kv at round t:
@@ -137,7 +139,14 @@ class KafkaSim:
           kv_retries msgs.  Locally-skipped commits never touch the KV
           and are unaffected.
         - **poll / list_committed**: local-only (log.go:79-110), never
-          gated."""
+          gated.
+
+        ``repl_fast``: replication-path pick.  None (default) selects
+        the origin-union fast path whenever ``repl_ok`` is omitted or
+        all-True (see :meth:`_round`'s replication block) and the
+        link-mask matmul otherwise; False pins the matmul
+        unconditionally (the parity tests use it to pin the two paths
+        bit-identical)."""
         self.n_nodes = n_nodes
         self.n_keys = n_keys
         self.capacity = capacity
@@ -149,8 +158,9 @@ class KafkaSim:
         self.kv_retries = kv_retries
         self.kv_sched = (kv_sched if kv_sched is not None
                          else KVReach.none(n_nodes))
+        self.repl_fast = repl_fast
         self._run_rounds = {}
-        self._step = self._build_step()
+        self._step_progs = {}
         self._poll_batch_fn = None
         self._alloc_fn = None
 
@@ -175,20 +185,26 @@ class KafkaSim:
     # -- round -------------------------------------------------------------
 
     def _round(self, state: KafkaState, send_key, send_val, commit_req,
-               repl_ok, sched: KVReach, *, row_ids, widen, reduce_sum,
-               reduce_max, reduce_min,
-               local_cols=lambda m: m) -> KafkaState:
+               repl_ok, sched: KVReach, coll, *,
+               repl_full: bool = False) -> KafkaState:
         """One round: allocate + append + replicate, then commit.
 
         send_key/send_val: (rows, S) int32, key = -1 for no-op.
         commit_req: (rows, K) int32, -1 for no commit of that key.
-        repl_ok: (N, N) bool — repl_ok[o, d]: o's replicate_msg reaches d.
+        repl_ok: (N, N) bool — repl_ok[o, d]: o's replicate_msg reaches
+        d; None (with ``repl_full=True``) for the lossless full mesh.
         sched: lin-kv reachability windows (see __init__) — blocked
         nodes' sends fail allocation and their active commit dances
         time out.
-        widen/reduce_*: identity single-device; all_gather along
-        'nodes' / psum / pmax / pmin under shard_map.
+        coll: the engine collective surface (identity single-device;
+        all_gather / psum / pmax / pmin over 'nodes' under shard_map).
+        repl_full (static): every link delivers — replication collapses
+        to the origin-union fast path (see the replication block).
         """
+        row_ids = coll.row_ids
+        widen, reduce_sum = coll.widen, coll.reduce_sum
+        reduce_max, reduce_min = coll.reduce_max, coll.reduce_min
+        local_cols = coll.local_cols
         n, k_dim, cap = self.n_nodes, self.n_keys, self.capacity
         s_dim = send_key.shape[1]
         big = jnp.int32(n + 1)
@@ -224,47 +240,60 @@ class KafkaSim:
             ok.astype(jnp.int32))
         kv_sent = jnp.where(counts > 0, current + counts, state.kv_val)
 
-        # new appends per origin node, bit-packed: (N, K, Wc) uint32.
-        # Offsets are globally unique per key, so every (key, slot) bit
-        # has exactly ONE origin — scatter-ADD of the bits is
-        # scatter-OR, and the words are DISJOINT across origins.
+        # -- replication.  Offsets are globally unique per key, so every
+        #    (key, slot) bit has exactly ONE origin: scatter-ADD of the
+        #    bits is scatter-OR and the words are DISJOINT across
+        #    origins.
         wc = self.n_pwords
         origin = jnp.repeat(jnp.arange(n, dtype=jnp.int32), s_dim)
         slot_ok = jnp.where(ok, slot, 0)
         bit = jnp.where(ok, jnp.uint32(1)
                         << (slot_ok % 32).astype(jnp.uint32),
                         jnp.uint32(0))
-        new_words = jnp.zeros((n, k_dim, wc), jnp.uint32).at[
-            origin, scat_k, slot_ok // 32].add(bit, mode="drop")
-
-        # -- replication: the masked OR over origins IS a matmul
-        #    (fire-and-forget full mesh, log.go:159-175): disjoint bits
-        #    make OR == SUM, so split the words into bytes and ride the
-        #    MXU — uint8 x uint8 -> int32, exact (disjoint-bit byte
-        #    sums stay <= 255).
-        nb = jnp.stack(
-            [(new_words >> jnp.uint32(8 * j)).astype(jnp.uint8)
-             for j in range(4)], axis=-1)                # (N, K, Wc, 4)
-        # contract only this shard's destination columns of repl_ok
-        # (identity single-device): each shard does rows/N of the
-        # matmul and lands its (rows, ...) delivery block directly
-        repl_local = local_cols(repl_ok)                 # (N, rows)
-        rows = repl_local.shape[1]
-        deliver_b = lax.dot_general(
-            repl_local.astype(jnp.uint8),
-            nb.reshape(n, k_dim * wc * 4),
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)            # (rows, K*Wc*4)
-        db = deliver_b.astype(jnp.uint32).reshape(rows, k_dim, wc, 4)
-        deliver = (db[..., 0] | (db[..., 1] << 8)
-                   | (db[..., 2] << 16) | (db[..., 3] << 24))
-        present = state.present | deliver | new_words[row_ids]
+        if repl_full:
+            # Full-mesh fast path (repl_ok all-True, the fire-and-
+            # forget default): every node receives every replicate_msg,
+            # so delivery is ONE origin-union of the new-append bits —
+            # an O(K*Wc) scatter instead of the O(N^2*K*Wc) link-mask
+            # matmul below, with the per-origin (N, K, Wc) new_words
+            # buffer never materialized.  The union is computed
+            # identically on every shard from the widened send batch
+            # (zero ICI), and it contains each node's OWN appends too
+            # (the full mesh includes the self link), so it is
+            # bit-identical to the all-ones matmul delivery.
+            deliver = jnp.zeros((k_dim, wc), jnp.uint32).at[
+                scat_k, slot_ok // 32].add(bit, mode="drop")[None]
+            present = state.present | deliver
+        else:
+            # new appends per origin node, bit-packed: (N, K, Wc).
+            new_words = jnp.zeros((n, k_dim, wc), jnp.uint32).at[
+                origin, scat_k, slot_ok // 32].add(bit, mode="drop")
+            # the masked OR over origins IS a matmul (fire-and-forget
+            # with link loss, log.go:159-175): disjoint bits make
+            # OR == SUM, so split the words into bytes and ride the
+            # MXU — uint8 x uint8 -> int32, exact (disjoint-bit byte
+            # sums stay <= 255).
+            nb = jnp.stack(
+                [(new_words >> jnp.uint32(8 * j)).astype(jnp.uint8)
+                 for j in range(4)], axis=-1)            # (N, K, Wc, 4)
+            # contract only this shard's destination columns of repl_ok
+            # (identity single-device): each shard does rows/N of the
+            # matmul and lands its (rows, ...) delivery block directly
+            repl_local = local_cols(repl_ok)             # (N, rows)
+            rows = repl_local.shape[1]
+            deliver_b = lax.dot_general(
+                repl_local.astype(jnp.uint8),
+                nb.reshape(n, k_dim * wc * 4),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)        # (rows, K*Wc*4)
+            db = deliver_b.astype(jnp.uint32).reshape(rows, k_dim, wc, 4)
+            deliver = (db[..., 0] | (db[..., 1] << 8)
+                       | (db[..., 2] << 16) | (db[..., 3] << 24))
+            present = state.present | deliver | new_words[row_ids]
 
         # -- local HWM after sends: own append sets kd.commitOffset
         #    unconditionally (logmap.go:298; == max here, offsets grow),
         #    replicate delivery max-bumps it (logmap.go:309-311).
-        own_off = jnp.zeros((n, k_dim), jnp.int32).at[
-            origin, scat_k].max(jnp.where(ok, offset, 0), mode="drop")
         # max delivered offset per (dest, key) = highest delivered bit
         # + 1, straight off the delivered words via count-leading-zeros
         # (no (N, N, K) max intermediate)
@@ -273,9 +302,17 @@ class KafkaSim:
                         word_base + 32 - lax.clz(deliver).astype(
                             jnp.int32),
                         0)
-        deliv_off = jnp.max(top, axis=2)                  # (rows, K)
-        hwm = jnp.maximum(state.local_committed,
-                          jnp.maximum(own_off[row_ids], deliv_off))
+        deliv_off = jnp.max(top, axis=2)         # (rows, K) / (1, K)
+        if repl_full:
+            # the union delivery contains every own append, so its top
+            # bit already covers the unconditional own-append bump
+            hwm = jnp.maximum(state.local_committed, deliv_off)
+        else:
+            own_off = jnp.zeros((n, k_dim), jnp.int32).at[
+                origin, scat_k].max(jnp.where(ok, offset, 0),
+                                    mode="drop")
+            hwm = jnp.maximum(state.local_committed,
+                              jnp.maximum(own_off[row_ids], deliv_off))
 
         # -- commits (after this round's sends).  Local skip when the
         #    HWM covers the request (logmap.go:247-251); otherwise the
@@ -373,72 +410,66 @@ class KafkaSim:
         return KafkaState(log_vals, present, kv_val,
                           local_committed, state.t + 1, msgs)
 
-    def _round_1dev(self, state, send_key, send_val, commit_req,
-                    repl_ok, sched):
-        """Single-device round wiring (identity collectives) — shared by
-        the stepwise and the scanned (run_rounds) drivers."""
-        row_ids = jnp.arange(self.n_nodes, dtype=jnp.int32)
-        ident = lambda x: x
-        return self._round(state, send_key, send_val, commit_req,
-                           repl_ok, sched, row_ids=row_ids, widen=ident,
-                           reduce_sum=ident, reduce_max=ident,
-                           reduce_min=ident)
-
     def _state_spec(self):
         return KafkaState(P(None, None), P("nodes", None, None),
                           P(), P("nodes", None), P(), P())
 
-    def _shard_collectives(self, block: int):
-        row_ids = (lax.axis_index("nodes") * block
-                   + jnp.arange(block, dtype=jnp.int32))
-        return dict(
-            row_ids=row_ids,
-            widen=lambda x: lax.all_gather(x, "nodes", axis=0,
-                                           tiled=True),
-            reduce_sum=lambda x: lax.psum(x, "nodes"),
-            reduce_max=lambda x: lax.pmax(x, "nodes"),
-            reduce_min=lambda x: lax.pmin(x, "nodes"),
-            # this shard's destination columns (the replication
-            # matmul's rhs side): each shard computes only its block
-            local_cols=lambda m: lax.dynamic_slice_in_dim(
-                m, lax.axis_index("nodes") * block, block, axis=1))
+    def _repl_full(self, repl_ok) -> bool:
+        """Host-side path pick: the origin-union fast path applies when
+        every link delivers (``repl_ok`` omitted or all-True) unless the
+        constructor pinned ``repl_fast=False``."""
+        if self.repl_fast is False:
+            return False
+        return repl_ok is None or bool(np.all(repl_ok))
 
-    def _build_step(self):
-        if self.mesh is None:
-            return jax.jit(self._round_1dev)
+    def _step_prog(self, repl_full: bool):
+        """The one-round program, keyed by the (static) replication
+        path.  check_vma=False on a mesh: log_vals/kv_val are computed
+        identically on every shard from all_gather-ed send batches —
+        genuinely replicated, but derived from gathered
+        (varying-marked) values, which the static replication checker
+        cannot prove."""
+        if repl_full not in self._step_progs:
+            mesh = self.mesh
 
-        mesh = self.mesh
-        node2 = P("nodes", None)
-        state_spec = self._state_spec()
-        sched_spec = KVReach(P(), P(), P(None, None))
+            def step(state, send_key, send_val, commit_req, *rest):
+                repl = None if repl_full else rest[0]
+                sched = rest[-1]
+                coll = collectives(send_key.shape[0], mesh)
+                return self._round(state, send_key, send_val,
+                                   commit_req, repl, sched, coll,
+                                   repl_full=repl_full)
 
-        # check_vma=False: log_vals/kv_val are computed identically on
-        # every shard from all_gather-ed send batches — genuinely
-        # replicated, but derived from gathered (varying-marked) values,
-        # which the static replication checker cannot prove.
-        @jax.jit
-        @functools.partial(
-            jax.shard_map, mesh=mesh,
-            in_specs=(state_spec, node2, node2, node2, P(None, None),
-                      sched_spec),
-            out_specs=state_spec, check_vma=False)
-        def step(state, send_key, send_val, commit_req, repl_ok, sched):
-            return self._round(
-                state, send_key, send_val, commit_req, repl_ok, sched,
-                **self._shard_collectives(send_key.shape[0]))
-
-        return step
+            if mesh is None:
+                prog = jit_program(step)
+            else:
+                node2 = P("nodes", None)
+                state_spec = self._state_spec()
+                in_specs = ((state_spec, node2, node2, node2)
+                            + (() if repl_full else (P(None, None),))
+                            + (KVReach(P(), P(), P(None, None)),))
+                prog = jit_program(step, mesh=mesh, in_specs=in_specs,
+                                   out_specs=state_spec,
+                                   check_vma=False)
+            self._step_progs[repl_full] = prog
+        return self._step_progs[repl_full]
 
     def run_rounds(self, state: KafkaState, send_key: np.ndarray,
                    send_val: np.ndarray,
                    commit_req: np.ndarray | None = None,
-                   repl_ok: np.ndarray | None = None) -> KafkaState:
+                   repl_ok: np.ndarray | None = None, *,
+                   donate: bool = False) -> KafkaState:
         """R pre-staged rounds as ONE device program (``lax.scan``):
         send_key/send_val are (R, N, S), commit_req (R, N, K).  One
         dispatch instead of R — per-round dispatch latency dominates the
         stepwise driver on small rounds.  On a mesh the scan body is the
         same sharded round as step() (scan under shard_map), so
-        benchmark config 5 runs multi-device with identical results."""
+        benchmark config 5 runs multi-device with identical results.
+
+        ``donate``: consume the input state's buffers (the
+        :meth:`run_fused` driver) — the scan then updates the ~O(N*K)
+        presence/HWM state in place instead of holding input + output
+        copies live."""
         # commit-free runs (the benchmark's send-heavy regime) build
         # the all--1 commit_req INSIDE the traced program: an (R, N, K)
         # host array would be ~330 MB at the sweep's 1k-node shape,
@@ -447,57 +478,44 @@ class KafkaSim:
         # broadcast constant, `want = req >= 1` folds to False and XLA
         # dead-codes the whole commit pipeline.
         has_commits = commit_req is not None
-        if repl_ok is None:
+        repl_full = self._repl_full(repl_ok)
+        if not repl_full and repl_ok is None:
             repl_ok = np.ones((self.n_nodes, self.n_nodes), bool)
-        if has_commits not in self._run_rounds:
+        key = (has_commits, repl_full, donate)
+        if key not in self._run_rounds:
             k_dim = self.n_keys
+            mesh = self.mesh
+            dn = donate_argnums_for(donate, 0)
 
-            def cr_of(xs, sk):
-                if has_commits:
-                    return xs[2]
-                return jnp.full((sk.shape[0], k_dim), -1, jnp.int32)
+            def run(state, sks, svs, *rest):
+                repl = None if repl_full else rest[-2]
+                sched = rest[-1]
+                coll = collectives(sks.shape[1], mesh)
 
-            if self.mesh is None:
-                @jax.jit
-                def run(state, sks, svs, *rest):
-                    crs = rest[0] if has_commits else None
-                    repl, sched = rest[-2], rest[-1]
+                def body(s, xs):
+                    sk, sv = xs[0], xs[1]
+                    cr = (xs[2] if has_commits else jnp.full(
+                        (sk.shape[0], k_dim), -1, jnp.int32))
+                    return self._round(s, sk, sv, cr, repl, sched,
+                                       coll, repl_full=repl_full)
 
-                    def body(s, xs):
-                        sk, sv = xs[0], xs[1]
-                        return self._round_1dev(
-                            s, sk, sv, cr_of(xs, sk), repl,
-                            sched), None
-                    xs = (sks, svs, crs) if has_commits else (sks, svs)
-                    out, _ = lax.scan(body, state, xs)
-                    return out
+                xs = ((sks, svs) + ((rest[0],) if has_commits
+                                    else ()))
+                return scan_rounds(body, state, xs)
+
+            if mesh is None:
+                prog = jit_program(run, donate_argnums=dn)
             else:
                 node3 = P(None, "nodes", None)
                 state_spec = self._state_spec()
-                sched_spec = KVReach(P(), P(), P(None, None))
                 in_specs = ((state_spec, node3, node3)
                             + ((node3,) if has_commits else ())
-                            + (P(None, None), sched_spec))
-
-                @jax.jit
-                @functools.partial(
-                    jax.shard_map, mesh=self.mesh,
-                    in_specs=in_specs,
-                    out_specs=state_spec, check_vma=False)
-                def run(state, sks, svs, *rest):
-                    crs = rest[0] if has_commits else None
-                    repl, sched = rest[-2], rest[-1]
-                    coll = self._shard_collectives(sks.shape[1])
-
-                    def body(s, xs):
-                        sk, sv = xs[0], xs[1]
-                        return self._round(s, sk, sv, cr_of(xs, sk),
-                                           repl, sched, **coll), None
-                    xs = ((sks, svs, crs) if has_commits
-                          else (sks, svs))
-                    out, _ = lax.scan(body, state, xs)
-                    return out
-            self._run_rounds[has_commits] = run
+                            + (() if repl_full else (P(None, None),))
+                            + (KVReach(P(), P(), P(None, None)),))
+                prog = jit_program(run, mesh=mesh, in_specs=in_specs,
+                                   out_specs=state_spec,
+                                   check_vma=False, donate_argnums=dn)
+            self._run_rounds[key] = prog
         args = [jnp.asarray(send_key, jnp.int32),
                 jnp.asarray(send_val, jnp.int32)]
         if has_commits:
@@ -505,8 +523,19 @@ class KafkaSim:
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(None, "nodes", None))
             args = [jax.device_put(a, sh) for a in args]
-        return self._run_rounds[has_commits](
-            state, *args, jnp.asarray(repl_ok), self.kv_sched)
+        if not repl_full:
+            args.append(jnp.asarray(repl_ok))
+        return self._run_rounds[key](state, *args, self.kv_sched)
+
+    def run_fused(self, state: KafkaState, send_key: np.ndarray,
+                  send_val: np.ndarray,
+                  commit_req: np.ndarray | None = None,
+                  repl_ok: np.ndarray | None = None) -> KafkaState:
+        """Donation-first :meth:`run_rounds`: bit-identical results, the
+        input state's buffers are consumed and reused in place.  The
+        passed-in state must not be used again afterwards."""
+        return self.run_rounds(state, send_key, send_val, commit_req,
+                               repl_ok, donate=True)
 
     def step(self, state: KafkaState,
              send_key: np.ndarray | None = None,
@@ -519,16 +548,18 @@ class KafkaSim:
             send_val = np.zeros((n, s), np.int32)
         if commit_req is None:
             commit_req = np.full((n, k), -1, np.int32)
-        if repl_ok is None:
+        repl_full = self._repl_full(repl_ok)
+        if not repl_full and repl_ok is None:
             repl_ok = np.ones((n, n), bool)
         args = [jnp.asarray(send_key, jnp.int32),
                 jnp.asarray(send_val, jnp.int32),
-                jnp.asarray(commit_req, jnp.int32),
-                jnp.asarray(repl_ok)]
+                jnp.asarray(commit_req, jnp.int32)]
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P("nodes", None))
-            args[:3] = [jax.device_put(a, sh) for a in args[:3]]
-        return self._step(state, *args, self.kv_sched)
+            args = [jax.device_put(a, sh) for a in args]
+        if not repl_full:
+            args.append(jnp.asarray(repl_ok))
+        return self._step_prog(repl_full)(state, *args, self.kv_sched)
 
     # -- host-side reads (reference read semantics) ------------------------
 

@@ -40,17 +40,14 @@ import numpy as np
 from jax import lax
 
 from ..parallel.topology import grid_cols
+from .engine import sharded_roll, sharded_shift  # noqa: F401 — the
+#   halo primitives are engine-owned now (engine.py module docstring);
+#   re-exported here because every structured exchange builds on them
+#   and external callers import them from this module.
 
 
-def _roll_fold_window() -> tuple[int, int]:
-    """[lo, hi] W-window where tree_from_kids picks the lane-roll fold
-    over the reshape-fold.  The default was measured on this image's
-    tunneled TPU chip (benchmarks/midw_probe.py; one chip generation,
-    single session) — other generations may cross over elsewhere, so
-    the window is overridable via ``GG_ROLL_FOLD_W=lo,hi`` (e.g. "0,0"
-    disables the roll fold entirely).  Both lowerings are pinned
-    bit-identical, so the knob is performance-only."""
-    raw = os.environ.get("GG_ROLL_FOLD_W", "8,16")
+def _parse_roll_fold_w(raw: str) -> tuple[int, int]:
+    """Parse a ``GG_ROLL_FOLD_W``-style ``"lo,hi"`` window string."""
     parts = raw.split(",")
     try:
         lo, hi = (int(parts[0]), int(parts[1])) if len(parts) == 2 \
@@ -62,6 +59,26 @@ def _roll_fold_window() -> tuple[int, int]:
             f"GG_ROLL_FOLD_W must be 'lo,hi' (two comma-separated "
             f"ints), got {raw!r}")
     return lo, hi
+
+
+# [lo, hi] W-window where tree_from_kids picks the lane-roll fold over
+# the reshape-fold.  The default was measured on this image's tunneled
+# TPU chip (benchmarks/midw_probe.py; one chip generation, single
+# session) — other generations may cross over elsewhere, so the window
+# is overridable via ``GG_ROLL_FOLD_W=lo,hi`` (e.g. "0,0" disables the
+# roll fold entirely).  Both lowerings are pinned bit-identical, so the
+# knob is performance-only.  Read ONCE at import: a trace-time env read
+# would be silently ignored by the jit cache for any already-traced
+# shape (the cache key does not include the env), so mid-process
+# changes could no-op without warning — set the env before importing
+# this module, or assign this constant before the first trace.
+ROLL_FOLD_W = _parse_roll_fold_w(os.environ.get("GG_ROLL_FOLD_W",
+                                                "8,16"))
+
+
+def _roll_fold_window() -> tuple[int, int]:
+    """The import-time roll-fold window (see :data:`ROLL_FOLD_W`)."""
+    return ROLL_FOLD_W
 
 
 def _zeros(payload: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -174,75 +191,6 @@ def circulant_exchange(payload: jnp.ndarray,
 def line_exchange(payload: jnp.ndarray) -> jnp.ndarray:
     """inbox for parallel/topology.py::line."""
     return line_terms(payload, payload)
-
-
-def sharded_roll(x_local: jnp.ndarray, s: int, n: int, n_shards: int,
-                 axis_name: str = "nodes") -> jnp.ndarray:
-    """Distributed ``jnp.roll(x, s, axis=1)`` for a words-major (W, N)
-    array block-sharded over ``axis_name`` — the halo-exchange
-    primitive.
-
-    A global rotation by ``s`` touches at most two source shards per
-    destination shard, so it decomposes into one or two ``ppermute``s of
-    one block each plus a local stitch: O(block) bytes per shard per
-    stride over ICI, versus the O(N) all_gather the generic sharded path
-    pays.  This is the framework's ring collective — the same
-    neighbor-exchange pattern ring-attention-style systems use on the
-    sequence axis, applied to the node axis.
-
-    Must run inside shard_map over a mesh with ``axis_name``; ``s`` and
-    the shapes are static.
-    """
-    block = x_local.shape[1]
-    assert block * n_shards == n, "node axis must shard evenly"
-    s = s % n
-    q, r = divmod(s, block)
-    # out_local[:, c] = global[:, (p*B + c - s) mod N]:
-    #   c in [r, B) -> cols [0, B-r) of block (p - q);
-    #   c in [0, r) -> cols [B-r, B) of block (p - q - 1).
-    # Each contribution is sliced BEFORE the ppermute, so total ICI
-    # traffic is exactly B columns per shard for any stride (r columns
-    # when the rotation stays within one block, q == 0).
-
-    def send(sl: jnp.ndarray, off: int) -> jnp.ndarray:
-        if off % n_shards == 0:
-            return sl
-        perm = [((p - off) % n_shards, p) for p in range(n_shards)]
-        return jax.lax.ppermute(sl, axis_name, perm)
-
-    if r == 0:
-        return send(x_local, q)
-    head = send(x_local[:, : block - r], q)        # dest cols [r, B)
-    tail = send(x_local[:, block - r:], q + 1)     # dest cols [0, r)
-    return jnp.concatenate([tail, head], axis=1)
-
-
-def sharded_shift(x_local: jnp.ndarray, s: int, n_shards: int,
-                  axis_name: str = "nodes") -> jnp.ndarray:
-    """Distributed zero-fill shift for a words-major (W, N) array
-    block-sharded over ``axis_name``: out[:, g] = x[:, g + s] for
-    0 <= g + s < N, else 0 (s > 0 shifts left, s < 0 shifts right;
-    g is the global column).
-
-    Unlike :func:`sharded_roll` nothing wraps, so the boundary shards
-    take ppermute's missing-source zeros as the fill — exactly the
-    zero-padding the single-device shift exchanges use.  Communicates
-    only the |s|-column halo per shard.  Requires |s| < block.
-    """
-    block = x_local.shape[1]
-    a = abs(s)
-    assert a < block, "halo shift needs |s| < block; use sharded_roll"
-    if a == 0:
-        return x_local
-    if s > 0:
-        halo = jax.lax.ppermute(
-            x_local[:, :a], axis_name,
-            [(p + 1, p) for p in range(n_shards - 1)])
-        return jnp.concatenate([x_local[:, a:], halo], axis=1)
-    halo = jax.lax.ppermute(
-        x_local[:, block - a:], axis_name,
-        [(p, p + 1) for p in range(n_shards - 1)])
-    return jnp.concatenate([halo, x_local[:, : block - a]], axis=1)
 
 
 def tree_parent_payload(p_local: jnp.ndarray, n: int, n_shards: int,

@@ -341,16 +341,23 @@ def _chain_diff(chain, k1: int, k2: int, attempts: int = 3) -> float:
 
 
 def _chained_samples(step, out0, fence, repeats: int = 3,
-                     target_s: float = 0.6) -> list:
+                     target_s: float = 0.6, reset=None) -> list:
     """``repeats`` amortized per-call samples of ``step`` (out -> out,
     data-dependent), with ``fence(out)`` forcing completion via a tiny
     D2H read.  Per-blocking-point overhead cancels in the chain-length
     difference (module docstring); one untimed warm call first so the
-    k-calibration estimate never includes compile time."""
-    fence(step(out0))                        # warm / compile, untimed
+    k-calibration estimate never includes compile time.
+
+    ``reset``: zero-arg factory returning a fresh staged chain start —
+    REQUIRED when ``step`` is a donated program (engine.py): donation
+    consumes each chain's input, so restarting a chain from a shared
+    ``out0`` would read deleted buffers.  The factory runs off the
+    clock (staging cost excluded, like ``out0``'s upload)."""
+    src = (lambda: out0) if reset is None else reset
+    fence(step(src()))                       # warm / compile, untimed
 
     def chain(k: int) -> float:
-        out = out0
+        out = src()
         t0 = time.perf_counter()
         for _ in range(k):
             out = step(out)
@@ -364,11 +371,13 @@ def _chained_samples(step, out0, fence, repeats: int = 3,
 
 
 def chained_time(step, out0, fence, repeats: int = 3,
-                 target_s: float = 0.6) -> float:
+                 target_s: float = 0.6, reset=None) -> float:
     """Median amortized per-call seconds of ``step`` — the chained
     methodology (module docstring) for non-broadcast sims (counter,
-    kafka); :meth:`TimedRun.sample` uses the same sampler."""
-    samples = _chained_samples(step, out0, fence, repeats, target_s)
+    kafka); :meth:`TimedRun.sample` uses the same sampler.  Pass
+    ``reset`` (fresh-state factory) when ``step`` donates its input."""
+    samples = _chained_samples(step, out0, fence, repeats, target_s,
+                               reset)
     return sorted(samples)[len(samples) // 2]
 
 

@@ -73,25 +73,22 @@ class UniqueIdsSim:
             return jax.jit(
                 lambda state, counts: mint(state, counts, row_ids))
 
-        import functools
-
         from jax import lax
+
+        from .engine import jit_program
 
         node = P("nodes")
         state_spec = UniqueIdsState(P(), node)
 
-        @jax.jit
-        @functools.partial(
-            jax.shard_map, mesh=self.mesh,
-            in_specs=(state_spec, node),
-            out_specs=(state_spec, P("nodes", None, None)))
         def step(state, counts):
             block = counts.shape[0]
             row_ids = (lax.axis_index("nodes") * block
                        + jnp.arange(block, dtype=jnp.int32))
             return mint(state, counts, row_ids)
 
-        return step
+        return jit_program(
+            step, mesh=self.mesh, in_specs=(state_spec, node),
+            out_specs=(state_spec, P("nodes", None, None)))
 
     def step(self, state: UniqueIdsState, counts: np.ndarray
              ) -> tuple[UniqueIdsState, jnp.ndarray]:

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Tier-1 CI gate: the exact verify command from ROADMAP.md, on CPU.
+#
+# Runs the full non-slow test suite over the 8-device virtual CPU mesh
+# (tests/conftest.py forces XLA's host-platform device splitting — same
+# SPMD partitioner and collectives as real chips).  Exits nonzero on
+# any failure; prints DOTS_PASSED for the driver's pass-count check.
+set -o pipefail
+cd "$(dirname "$0")/.."
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+exit $rc
