@@ -18,6 +18,11 @@ virtual-clock event simulator that
 
 Everything is seeded: the same (workload, seed) pair replays the identical
 message timeline.
+
+The tpu_sim-side nemesis campaigns (crash/loss/dup with recovery
+certification) live in :mod:`.nemesis` — imported explicitly
+(``from gossip_glomers_tpu.harness import nemesis``) rather than here,
+so the pure-python harness surface stays importable without JAX.
 """
 
 from .network import Client, SimNodeRuntime, VirtualNetwork

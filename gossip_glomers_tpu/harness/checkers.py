@@ -71,6 +71,53 @@ def check_counter(final_reads: dict[str, int], acked_sum: int,
                        "reads": final_reads, "wrong": wrong}
 
 
+def check_recovery(*, clear_round: int, converged_round: int | None,
+                   max_recovery_rounds: int, lost_writes: list,
+                   msgs_at_clear: int | None = None,
+                   msgs_at_converged: int | None = None,
+                   ) -> tuple[bool, dict]:
+    """Recovery certification under a nemesis plan (the tpu_sim
+    counterpart of Maelstrom's post-heal availability/validity checks):
+    after the last fault window clears at ``clear_round``, the run must
+
+    - converge within ``max_recovery_rounds`` rounds
+      (``converged_round`` is the absolute round convergence was first
+      observed; None = never), and
+    - lose NO acknowledged writes (``lost_writes``: the workload's
+      evidence list — broadcast values absent from every node, counter
+      delta shortfall, kafka allocated slots missing everywhere).
+
+    Reports ``recovery_rounds`` (rounds from clear to convergence) and
+    the ``degraded_throughput`` summary: messages per round spent while
+    faults were active vs during recovery (>= 1 means the fault phase
+    burned more traffic per round than the repair phase — retries,
+    re-floods and duplicates at work).
+    """
+    recovery = (None if converged_round is None
+                else converged_round - clear_round)
+    ok = (converged_round is not None
+          and recovery <= max_recovery_rounds
+          and not lost_writes)
+    details: dict = {
+        "clear_round": clear_round,
+        "converged_round": converged_round,
+        "recovery_rounds": recovery,
+        "max_recovery_rounds": max_recovery_rounds,
+        "n_lost_writes": len(lost_writes),
+        "lost_writes": list(lost_writes)[:10],
+    }
+    if msgs_at_clear is not None and clear_round > 0:
+        faulted = msgs_at_clear / clear_round
+        details["msgs_per_round_faulted"] = faulted
+        if (msgs_at_converged is not None and recovery
+                and recovery > 0):
+            rec_rate = (msgs_at_converged - msgs_at_clear) / recovery
+            details["msgs_per_round_recovery"] = rec_rate
+            if rec_rate > 0:
+                details["degraded_throughput"] = faulted / rec_rate
+    return ok, details
+
+
 def check_kafka(send_acks: list[tuple[str, int, int]],
                 polls: list[dict[str, list[list[int]]]],
                 committed: dict[str, int],

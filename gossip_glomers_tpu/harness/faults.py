@@ -23,6 +23,19 @@ class PartitionWindow:
     end: float
     groups: list[list[str]]  # components; cross-component traffic drops
 
+    def __post_init__(self) -> None:
+        # Disjointness is load-bearing: `blocks` resolves each endpoint
+        # to ONE component, so an id in two groups would silently get
+        # whichever the scan hits last — validate instead of guessing.
+        seen: dict[str, int] = {}
+        for i, g in enumerate(self.groups):
+            for node in g:
+                if node in seen and seen[node] != i:
+                    raise ValueError(
+                        f"partition groups must be disjoint: {node!r} "
+                        f"appears in groups {seen[node]} and {i}")
+                seen[node] = i
+
     def blocks(self, src: str, dest: str) -> bool:
         gsrc = gdst = None
         for i, g in enumerate(self.groups):

@@ -1,0 +1,228 @@
+"""Nemesis campaigns for the vectorized backend: drive each stateful
+sim under a seeded crash/loss/dup :class:`~..tpu_sim.faults.NemesisSpec`
+(optionally composed with a partition schedule) and CERTIFY recovery —
+the tpu_sim analogue of a Maelstrom run with the kill + lossy-network
+nemeses followed by the post-heal validity checks.
+
+Each ``run_*_nemesis`` function:
+
+1. compiles the spec to a device :class:`FaultPlan` and builds the sim
+   with it (donation-first fused drivers carry the plan as a traced
+   operand);
+2. runs the FAULTED phase to ``spec.clear_round`` as one fused device
+   program (state donated, single dispatch);
+3. steps the RECOVERY phase round by round until the workload's
+   convergence predicate holds (broadcast: every node holds every
+   value; counter: pending drained and every cache equals the KV;
+   kafka: every node's presence bitset identical), bounded by
+   ``max_recovery_rounds``;
+4. certifies via :func:`~.checkers.check_recovery`: bounded recovery,
+   zero lost acknowledged writes, and the degraded-throughput summary.
+
+Everything is a pure function of (spec, workload seed): the same seeds
+replay the identical faulted trajectory bit for bit (pinned by
+tests/test_nemesis.py), which is what makes hard assertions under the
+full fault model possible.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..parallel.topology import grid, to_padded_neighbors, tree
+from ..tpu_sim.broadcast import BroadcastSim, Partitions, make_inject
+from ..tpu_sim.counter import CounterSim
+from ..tpu_sim.faults import NemesisSpec
+from ..tpu_sim.kafka import KafkaSim
+from .checkers import check_recovery
+
+_TOPOLOGIES = {"grid": grid, "tree": tree}
+
+
+def _neighbors(topology: str, n: int) -> np.ndarray:
+    try:
+        build = _TOPOLOGIES[topology]
+    except KeyError:
+        raise ValueError(f"unknown topology {topology!r}; "
+                         f"one of {sorted(_TOPOLOGIES)}") from None
+    return to_padded_neighbors(build(n))
+
+
+def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
+                          topology: str = "grid", sync_every: int = 4,
+                          parts: Partitions | None = None,
+                          max_recovery_rounds: int = 96,
+                          mesh=None) -> dict:
+    """Broadcast under the full nemesis (crash/loss/dup from ``spec``,
+    plus an optional partition schedule): values injected round-robin
+    at round 0, convergence = every node holds every value.  A lost
+    acknowledged write is a value absent from EVERY node — an amnesia
+    row that took the sole copy down with it."""
+    n = spec.n_nodes
+    nv = n_values if n_values is not None else 2 * n
+    sim = BroadcastSim(_neighbors(topology, n), n_values=nv,
+                       sync_every=sync_every, parts=parts,
+                       fault_plan=spec.compile(), srv_ledger=False,
+                       mesh=mesh)
+    inject = make_inject(n, nv)
+    target = sim.target_bits(inject)
+    clear = spec.clear_round
+    state, _tgt = sim.stage(inject)
+    if clear > 0:
+        state = sim.run_staged_fixed(state, clear, donate=True)
+    msgs_at_clear = int(state.msgs)
+    converged_round = clear if sim.converged(state, target) else None
+    while converged_round is None \
+            and int(state.t) < clear + max_recovery_rounds:
+        state = sim.step(state)
+        if sim.converged(state, target):
+            converged_round = int(state.t)
+    rec = sim.received_node_major(state)
+    anywhere = np.bitwise_or.reduce(rec, axis=0)
+    lost = [v for v in range(nv)
+            if not (anywhere[v // 32] >> (v % 32)) & 1]
+    ok, details = check_recovery(
+        clear_round=clear, converged_round=converged_round,
+        max_recovery_rounds=max_recovery_rounds, lost_writes=lost,
+        msgs_at_clear=msgs_at_clear, msgs_at_converged=int(state.msgs))
+    details.update(workload="broadcast", n_nodes=n, n_values=nv,
+                   topology=topology, msgs_total=int(state.msgs),
+                   spec=spec.to_meta())
+    return {"ok": ok, **details}
+
+
+def run_counter_nemesis(spec: NemesisSpec, *,
+                        deltas: np.ndarray | None = None,
+                        mode: str = "cas", poll_every: int = 2,
+                        max_recovery_rounds: int = 64,
+                        mesh=None) -> dict:
+    """G-counter under the nemesis: per-node deltas acked at round 0,
+    convergence = pending fully drained AND every node's cached read
+    equals the KV.  Lost acknowledged writes = the final shortfall
+    ``acked_sum - kv`` — exactly the pending deltas that died in
+    amnesia rows before the flush loop drained them (the reference's
+    ack-before-durability risk made measurable)."""
+    n = spec.n_nodes
+    if deltas is None:
+        deltas = np.arange(1, n + 1, dtype=np.int32)
+    acked_sum = int(np.sum(deltas))
+    sim = CounterSim(n, mode=mode, poll_every=poll_every,
+                     fault_plan=spec.compile(), mesh=mesh)
+    state = sim.add(sim.init_state(), deltas)
+    clear = spec.clear_round
+    if clear > 0:
+        state = sim.run_fused(state, clear)
+    msgs_at_clear = int(state.msgs)
+
+    def converged(s) -> bool:
+        return (int(np.sum(np.asarray(s.pending))) == 0
+                and bool(np.all(sim.reads(s) == sim.kv_value(s))))
+
+    converged_round = clear if converged(state) else None
+    while converged_round is None \
+            and int(state.t) < clear + max_recovery_rounds:
+        state = sim.step(state)
+        if converged(state):
+            converged_round = int(state.t)
+    shortfall = acked_sum - sim.kv_value(state) \
+        - int(np.sum(np.asarray(state.pending)))
+    lost = ([{"lost_sum": shortfall}] if shortfall != 0 else [])
+    ok, details = check_recovery(
+        clear_round=clear, converged_round=converged_round,
+        max_recovery_rounds=max_recovery_rounds, lost_writes=lost,
+        msgs_at_clear=msgs_at_clear, msgs_at_converged=int(state.msgs))
+    details.update(workload="counter", n_nodes=n, mode=mode,
+                   acked_sum=acked_sum, kv=sim.kv_value(state),
+                   msgs_total=int(state.msgs), spec=spec.to_meta())
+    return {"ok": ok, **details}
+
+
+def stage_kafka_ops(spec: NemesisSpec, rounds: int, *, n_keys: int,
+                    max_sends: int, send_prob: float = 0.7,
+                    commit_prob: float = 0.2, workload_seed: int = 0,
+                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Seeded (R, N, S) send batches + (R, N, K) commit requests for a
+    nemesis campaign: ops are staged only at nodes that are UP that
+    round (a dead process receives no client RPCs), values are
+    globally unique."""
+    rng = np.random.default_rng(workload_seed)
+    n, s = spec.n_nodes, max_sends
+    sks = np.full((rounds, n, s), -1, np.int32)
+    svs = np.zeros((rounds, n, s), np.int32)
+    crs = np.full((rounds, n, n_keys), -1, np.int32)
+    vid = 0
+    for t in range(rounds):
+        up = spec.host_up(t)
+        for i in range(n):
+            if not up[i]:
+                continue
+            if rng.random() < send_prob:
+                sks[t, i, 0] = rng.integers(0, n_keys)
+                svs[t, i, 0] = vid
+                vid += 1
+            if rng.random() < commit_prob:
+                crs[t, i, rng.integers(0, n_keys)] = rng.integers(1, 6)
+    return sks, svs, crs
+
+
+def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
+                      capacity: int = 64, max_sends: int = 2,
+                      resync_every: int = 4, workload_seed: int = 0,
+                      max_recovery_rounds: int = 48,
+                      rounds: int | None = None,
+                      mesh=None) -> dict:
+    """Replicated log under the nemesis: seeded send/commit traffic at
+    live nodes through the faulted phase, then quiescent recovery.
+    Convergence = every node's presence bitset identical (the periodic
+    resync has re-replicated crashed origins' appends and loss-dropped
+    deliveries).  Lost acknowledged writes = allocated slots (send_ok
+    was replied; the content is in the durable log) present at NO node
+    — plus any committed-offset cache exceeding the shared cell, which
+    would mean the durable commits regressed.
+
+    ``rounds``: length of the driven (op-staging) phase — defaults to
+    ``spec.clear_round``; raise it to keep traffic flowing past a
+    short fault horizon (e.g. the fault-free baseline cell of the
+    sweep, whose clear round is 0)."""
+    n = spec.n_nodes
+    clear = max(spec.clear_round, rounds or 0)
+    sks, svs, crs = stage_kafka_ops(
+        spec, clear, n_keys=n_keys, max_sends=max_sends,
+        workload_seed=workload_seed)
+    sim = KafkaSim(n, n_keys, capacity=capacity, max_sends=max_sends,
+                   fault_plan=spec.compile(), resync_every=resync_every,
+                   mesh=mesh)
+    state = sim.init_state()
+    if clear > 0:
+        state = sim.run_fused(state, sks, svs, crs)
+    msgs_at_clear = int(state.msgs)
+
+    def converged(s) -> bool:
+        pres = np.asarray(s.present)
+        return bool((pres == pres[:1]).all())
+
+    converged_round = clear if converged(state) else None
+    while converged_round is None \
+            and int(state.t) < clear + max_recovery_rounds:
+        state = sim.step(state)
+        if converged(state):
+            converged_round = int(state.t)
+
+    pres = sim.present_bool(state)
+    allocated = np.asarray(state.log_vals) >= 0        # (K, C)
+    anywhere = pres.any(axis=0)
+    lost = [(int(k), int(c) + 1)
+            for k, c in zip(*np.nonzero(allocated & ~anywhere))]
+    kv_val = np.asarray(state.kv_val)
+    lc = np.asarray(state.local_committed)
+    over = lc > np.where(kv_val > 0, kv_val, 0)[None, :]
+    lost += [{"committed_over_cell": (int(i), int(k))}
+             for i, k in zip(*np.nonzero(over))]
+    ok, details = check_recovery(
+        clear_round=clear, converged_round=converged_round,
+        max_recovery_rounds=max_recovery_rounds, lost_writes=lost,
+        msgs_at_clear=msgs_at_clear, msgs_at_converged=int(state.msgs))
+    details.update(workload="kafka", n_nodes=n, n_keys=n_keys,
+                   n_allocated=int(allocated.sum()),
+                   msgs_total=int(state.msgs), spec=spec.to_meta())
+    return {"ok": ok, **details}

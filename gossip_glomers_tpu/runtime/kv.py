@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
-from ..protocol import Message, RPCError
+from ..protocol import Message, RPCError, TIMEOUT
 
 SEQ_KV = "seq-kv"
 LIN_KV = "lin-kv"
@@ -30,25 +30,52 @@ KVCallback = Callable[[Any, RPCError | None], None]
 
 
 class AsyncKV:
-    """Continuation-passing KV client over ``node.rpc``."""
+    """Continuation-passing KV client over ``node.rpc``.
+
+    ``retries`` > 0 makes every op transparently re-issue on the
+    synthetic code-0 TIMEOUT error, spaced by the node's jittered
+    exponential backoff (``node.with_backoff`` — replacing the
+    immediate re-fire the kafka CAS / counter flush loops used to do);
+    the callback then sees either the first definitive reply or the
+    final timeout.  Non-timeout errors (CAS precondition, missing key)
+    are protocol answers, never retried here."""
 
     def __init__(self, node, service: str = SEQ_KV,
-                 timeout: float = 1.0) -> None:
+                 timeout: float = 1.0, retries: int = 0,
+                 backoff_base: float = 0.05,
+                 backoff_cap: float = 1.0) -> None:
         self.node = node
         self.service = service
         self.timeout = timeout
+        self.retries = retries
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
 
     def _call(self, body: dict, cb: KVCallback, result_key: str | None,
               timeout: float | None = None) -> None:
-        def _on_reply(reply: Message) -> None:
-            if reply.type == "error":
-                cb(None, RPCError.from_body(reply.body))
-            else:
-                value = reply.body.get(result_key) if result_key else None
-                cb(value, None)
+        op_timeout = self.timeout if timeout is None else timeout
 
-        self.node.rpc(self.service, body, _on_reply,
-                      timeout=self.timeout if timeout is None else timeout)
+        def attempt(retry) -> None:
+            def _on_reply(reply: Message) -> None:
+                if reply.type == "error":
+                    err = RPCError.from_body(reply.body)
+                    if err.code == TIMEOUT and retry():
+                        return          # re-issued after backoff
+                    cb(None, err)
+                else:
+                    value = (reply.body.get(result_key)
+                             if result_key else None)
+                    cb(value, None)
+
+            self.node.rpc(self.service, dict(body), _on_reply,
+                          timeout=op_timeout)
+
+        if self.retries > 0:
+            self.node.with_backoff(attempt, retries=self.retries,
+                                   base=self.backoff_base,
+                                   cap=self.backoff_cap)
+        else:
+            attempt(lambda: False)
 
     def read(self, key: str, cb: KVCallback,
              timeout: float | None = None) -> None:

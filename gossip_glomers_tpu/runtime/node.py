@@ -87,6 +87,37 @@ class NodeCore:
             out["in_reply_to"] = req.msg_id
         self._transmit(Message(self.node_id, req.src, out))
 
+    def with_backoff(self, attempt: Callable[[Callable[[], bool]], None],
+                     *, retries: int = 5, base: float = 0.05,
+                     factor: float = 2.0, cap: float = 1.0,
+                     jitter: float = 0.5) -> None:
+        """Jittered-exponential-backoff retry driver for event-driven
+        RPC loops (the analogue of the reference's jittered CAS retry
+        sleep, add.go:56-58, generalized).
+
+        Calls ``attempt(retry)`` immediately; inside its continuation,
+        calling ``retry()`` schedules the NEXT attempt after
+        ``min(cap, base * factor**k) * (1 ± jitter)`` seconds of this
+        runtime's clock and returns True — or returns False once
+        ``retries`` re-attempts are exhausted, so the caller can fail
+        over instead of hammering a dead service on the synthetic
+        code-0 timeout (the immediate-retry loops this replaces).
+        Jitter draws from ``self.rng`` — seeded runtimes (GG_RNG_SEED,
+        the virtual-clock harness) replay the exact delays."""
+        tries = [0]
+
+        def retry() -> bool:
+            k = tries[0]
+            if k >= retries:
+                return False
+            tries[0] = k + 1
+            delay = min(cap, base * (factor ** k))
+            delay *= 1.0 + self.rng.uniform(-jitter, jitter)
+            self.schedule(delay, lambda: attempt(retry))
+            return True
+
+        attempt(retry)
+
     def rpc(self, dest: str, body: dict, callback: ReplyCallback,
             timeout: float | None = None) -> int:
         """Async request: assign a msg_id, register ``callback`` for the

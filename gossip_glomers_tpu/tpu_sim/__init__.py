@@ -30,12 +30,18 @@ Modules:
   donating ``jit_program``, mesh collectives, round-fused drivers, and
   the halo primitives (see ARCHITECTURE.md "The shared execution
   engine").
+- :mod:`.faults` — the nemesis beyond partitions: seeded, replayable
+  crash/restart (amnesia rows), probabilistic message loss, and
+  duplicate delivery, compiled to a ``FaultPlan`` operand every
+  stateful sim threads through its fused drivers (see ARCHITECTURE.md
+  "Nemesis").
 """
 
 from .broadcast import (BroadcastSim, BroadcastState, Partitions,
                         make_inject)
 from .counter import CounterSim, CounterState, KVReach
 from .echo import EchoSim, EchoState
+from .faults import FaultPlan, NemesisSpec, random_spec
 from .kafka import KafkaSim, KafkaState
 from .structured import (FaultedDelayed, StructuredDelays,
                          StructuredFaults, make_delayed,
@@ -45,6 +51,7 @@ from .unique_ids import UniqueIdsSim, UniqueIdsState
 __all__ = ["BroadcastSim", "BroadcastState", "Partitions", "make_inject",
            "CounterSim", "CounterState", "KVReach",
            "KafkaSim", "KafkaState",
+           "FaultPlan", "NemesisSpec", "random_spec",
            "StructuredFaults", "make_faulted",
            "StructuredDelays", "make_delayed",
            "FaultedDelayed", "make_delayed_faulted",

@@ -43,6 +43,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import faults
 from .engine import (donate_argnums_for, fori_rounds, shard_map,
                      stepwise_converge, while_converge)
 
@@ -137,6 +138,29 @@ def _edge_live(t: jnp.ndarray, row_ids: jnp.ndarray, nbrs: jnp.ndarray,
     return lax.fori_loop(0, n_windows, body, live)
 
 
+def _live_split(t: jnp.ndarray, row_ids: jnp.ndarray, nbrs: jnp.ndarray,
+                nbr_mask: jnp.ndarray, parts: Partitions,
+                plan: "faults.FaultPlan | None", dup_on: bool):
+    """Per-edge (rows, D) masks at send round ``t`` under the full
+    nemesis: ``live_send`` = topology & partition windows & both
+    endpoints up (sends attempted — the ledger side; loss counts as
+    sent, the message died in flight); ``live_del`` = live_send minus
+    the plan's per-direction loss coins (actual deliveries); ``dup`` =
+    live_del edges that ALSO re-deliver their source's full received
+    set this round (None when the plan has no dup stream)."""
+    live = _edge_live(t, row_ids, nbrs, nbr_mask, parts)
+    if plan is None:
+        return live, live, None
+    src = jnp.clip(nbrs, 0, plan.down.shape[1] - 1)
+    live_send = (live & faults.node_up(plan, t, row_ids)[:, None]
+                 & faults.node_up(plan, t, src))
+    live_del = live_send & ~faults.edge_drop(plan, t, src,
+                                             row_ids[:, None])
+    dup = (live_del & faults.edge_dup(plan, t, src, row_ids[:, None])
+           if dup_on else None)
+    return live_send, live_del, dup
+
+
 def _gather_or(payload: jnp.ndarray, nbrs: jnp.ndarray,
                live: jnp.ndarray) -> jnp.ndarray:
     """inbox[i] = OR over live edges d of payload[nbrs[i, d]].
@@ -164,7 +188,9 @@ def _gather_or_delayed(history: jnp.ndarray, t: jnp.ndarray,
                        delays: jnp.ndarray, nbrs: jnp.ndarray,
                        nbr_mask: jnp.ndarray, parts: Partitions,
                        row_ids: jnp.ndarray, delay_set: tuple,
-                       widen) -> jnp.ndarray:
+                       widen,
+                       plan: "faults.FaultPlan | None" = None,
+                       ) -> jnp.ndarray:
     """Latency-queue delivery: edge (i, d) with delay δ = delays[i, d]
     delivers the payload flooded at round t - (δ-1), with liveness
     evaluated at that send round (drops happen at send time, like
@@ -176,15 +202,22 @@ def _gather_or_delayed(history: jnp.ndarray, t: jnp.ndarray,
     distinct delay values are static, so delivery is one masked
     ``widen`` (all_gather along 'nodes') + gather per value: the full
     past payload an edge class needs is materialized transiently per
-    round, never stored."""
+    round, never stored.
+
+    With a ``plan`` (faults.FaultPlan), each class's liveness at its
+    send round also requires both endpoints up and the delivery coin
+    to survive the loss stream — crash/loss compose with per-edge
+    delays exactly like the partition windows (drops at send time)."""
     ring = history.shape[0]
     out = None
     for d in delay_set:
         src_t = t - (d - 1)
+        _send, live_del, _dup = _live_split(src_t, row_ids, nbrs,
+                                            nbr_mask, parts, plan,
+                                            False)
+        live = live_del & (delays == d) & (src_t >= 0)
         payload = widen(lax.dynamic_index_in_dim(
             history, src_t % ring, axis=0, keepdims=False))
-        live = (_edge_live(src_t, row_ids, nbrs, nbr_mask, parts)
-                & (delays == d) & (src_t >= 0))
         term = _gather_or(payload, nbrs, live)
         out = term if out is None else out | term
     return out
@@ -269,6 +302,8 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
            delay_set: tuple = (),
            sync_base_once: Callable[[jnp.ndarray], jnp.ndarray]
            = lambda x: x,
+           plan: "faults.FaultPlan | None" = None,
+           dup_on: bool = False,
            ) -> BroadcastState:
     """One simulation round == one base network hop — the single source
     of the node-major (adjacency-gather) round semantics, shared by the
@@ -282,17 +317,43 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
     ``reduce_sum`` globalizes the message count (identity / ``psum``).
     With ``delays`` ((N, D) rounds >= 1, static per edge), delivery reads
     the payload-history ring instead of the current payload.
+
+    With ``plan`` (a compiled faults.FaultPlan), the round first wipes
+    the AMNESIA rows — received/frontier die with a crashing process;
+    the node sits empty while down and re-learns only through the
+    flood + anti-entropy after restart (a Maelstrom kill/restart) —
+    then masks every edge by endpoint liveness and the loss coins
+    (:func:`_live_split`).  ``dup_on`` edges additionally re-deliver
+    their source's full received set (at-least-once duplicates, absorbed
+    by the ``& ~received`` dedup, visible in the msgs ledger).
     """
+    if plan is None:
+        rec0, fr0 = state.received, state.frontier
+    else:
+        wipe = faults.amnesia(plan, state.t, row_ids)
+        rec0 = jnp.where(wipe[:, None], jnp.uint32(0),
+                         state.received)
+        fr0 = jnp.where(wipe[:, None], jnp.uint32(0),
+                        state.frontier)
     is_sync = (state.t % jnp.int32(sync_every) == 0) & (state.t > 0)
     # frontier ⊆ received, so the anti-entropy payload is just `received`.
-    payload = jnp.where(is_sync, state.received, state.frontier)
+    payload = jnp.where(is_sync, rec0, fr0)
     payload_full = widen(payload)
-    live_now = _edge_live(state.t, row_ids, nbrs, nbr_mask, parts)
+    live_now, live_del, dup = _live_split(state.t, row_ids, nbrs,
+                                          nbr_mask, parts, plan, dup_on)
     # throughput ledger: one value-message per (value, live edge) —
-    # counted at send time regardless of delivery delay.
-    sent = reduce_sum(jnp.sum(
+    # counted at send time regardless of delivery delay or in-flight
+    # loss (the plan's dropped messages were still sent).
+    sent_local = jnp.sum(
         _popcount(payload).sum(axis=1).astype(jnp.uint32)
-        * live_now.sum(axis=1).astype(jnp.uint32), dtype=jnp.uint32))
+        * live_now.sum(axis=1).astype(jnp.uint32), dtype=jnp.uint32)
+    if dup is not None:
+        received_full = widen(rec0)
+        pc_all = _popcount(received_full).sum(axis=1).astype(jnp.uint32)
+        src_c = jnp.clip(nbrs, 0, received_full.shape[0] - 1)
+        sent_local = sent_local + jnp.sum(
+            jnp.where(dup, pc_all[src_c], 0), dtype=jnp.uint32)
+    sent = reduce_sum(sent_local)
     # reference-accounted server-message ledger (Maelstrom parity):
     # floods charge `broadcast` sends to every TOPOLOGY neighbor minus
     # the sender exclusion (drops still count as sends) plus one
@@ -314,7 +375,7 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
     else:
         deg_topo = nbr_mask.sum(axis=1).astype(jnp.int32)
         live_deg = live_now.sum(axis=1).astype(jnp.int32)
-        pcf = _popcount(state.frontier).sum(axis=1).astype(jnp.uint32)
+        pcf = _popcount(fr0).sum(axis=1).astype(jnp.uint32)
         coef = jnp.where(state.t == 0, deg_topo + live_deg,
                          jnp.maximum(deg_topo + live_deg - 2, 0))
         flood = jnp.sum(pcf * coef.astype(jnp.uint32), dtype=jnp.uint32)
@@ -324,13 +385,15 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
         # computed every round and masked (a lax.cond would need equal
         # sharding types across branches under shard_map); on sync
         # rounds payload_full IS the widened received set
-        diff = _sync_diff_pc(payload_full, state.received, nbrs,
+        diff = _sync_diff_pc(payload_full, rec0, nbrs,
                              live_now)
         srv_inc = flood + jnp.where(is_sync, base + 2 * diff,
                                     jnp.uint32(0))
         srv = state.srv_msgs + reduce_sum(srv_inc)
     if delays is None:
-        inbox = _gather_or(payload_full, nbrs, live_now)
+        inbox = _gather_or(payload_full, nbrs, live_del)
+        if dup is not None:
+            inbox = inbox | _gather_or(received_full, nbrs, dup)
         history = state.history
     else:
         # the ring stores the LOCAL payload block (node-sharded under
@@ -340,9 +403,17 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
             state.history, payload, state.t % ring, axis=0)
         inbox = _gather_or_delayed(history, state.t, delays, nbrs,
                                    nbr_mask, parts, row_ids, delay_set,
-                                   widen)
-    new = inbox & ~state.received
-    return BroadcastState(received=state.received | new,
+                                   widen, plan)
+        if plan is not None:
+            # a message in flight to a node that crashed before the
+            # delivery round dies with the process: _gather_or_delayed
+            # gates liveness at the SEND round, so mask the receiver
+            # side at delivery time too (a down node receives nothing)
+            inbox = jnp.where(
+                faults.node_up(plan, state.t, row_ids)[:, None],
+                inbox, jnp.uint32(0))
+    new = inbox & ~rec0
+    return BroadcastState(received=rec0 | new,
                           frontier=new,
                           t=state.t + 1,
                           msgs=state.msgs + sent,
@@ -354,7 +425,9 @@ def flood_step(state: BroadcastState, *, nbrs: jnp.ndarray,
                nbr_mask: jnp.ndarray, parts: Partitions,
                sync_every: int,
                delays: jnp.ndarray | None = None,
-               delay_set: tuple = ()) -> BroadcastState:
+               delay_set: tuple = (),
+               plan: "faults.FaultPlan | None" = None,
+               dup_on: bool = False) -> BroadcastState:
     """Single-device node-major round (the ``entry()`` compile-check
     target)."""
     row_ids = jnp.arange(nbrs.shape[0], dtype=jnp.int32)
@@ -364,7 +437,7 @@ def flood_step(state: BroadcastState, *, nbrs: jnp.ndarray,
         delay_set = tuple(int(x) for x in np.unique(np.asarray(delays)))
     return _round(state, row_ids=row_ids, nbrs=nbrs, nbr_mask=nbr_mask,
                   parts=parts, sync_every=sync_every, delays=delays,
-                  delay_set=delay_set)
+                  delay_set=delay_set, plan=plan, dup_on=dup_on)
 
 
 def _round_wm(state: BroadcastState, *, deg: jnp.ndarray, sync_every: int,
@@ -488,6 +561,7 @@ class BroadcastSim:
                  faulted=None,
                  delayed=None,
                  edge_delayed=None,
+                 fault_plan: "faults.FaultPlan | None" = None,
                  ) -> None:
         """``srv_ledger``: keep the reference-accounted server-message
         ledger (default).  It costs a second adjacency pass per round
@@ -530,7 +604,17 @@ class BroadcastSim:
         with partition schedules (compose via the gather path for
         now); the srv ledger gates exactly like the plain delayed
         mode (caller-supplied sync_diff closures, current-state
-        approximation)."""
+        approximation).
+
+        ``fault_plan`` (tpu_sim/faults.py, compiled NemesisSpec): the
+        nemesis beyond partitions — crash/restart with amnesia rows,
+        per-direction probabilistic loss, duplicate delivery.  Gather
+        path only (explicitly rejected with the structured exchanges);
+        composes with ``parts`` partition schedules and, dup aside,
+        with per-edge ``delays``.  Forces ``srv_ledger`` off (the
+        Maelstrom-parity accounting has no defined semantics for lost
+        acks); the ``msgs`` ledger counts loss at send time and dup
+        re-deliveries as real traffic."""
         n = nbrs.shape[0]
         self.n_nodes = n
         self.n_values = n_values
@@ -651,6 +735,33 @@ class BroadcastSim:
                       and sharded_sync_diff is not None))
         else:
             self._srv_on = srv_ledger
+        # -- nemesis FaultPlan (crash/loss/dup, tpu_sim/faults.py) ------
+        self.fault_plan = fault_plan
+        self._fp_dup = (fault_plan is not None
+                        and int(fault_plan.dup_num) > 0)
+        if fault_plan is not None:
+            if self.words_major:
+                raise ValueError(
+                    "a FaultPlan (crash/loss/dup nemesis) runs on the "
+                    "gather path only: the structured words-major "
+                    "exchanges do not compose with amnesia rows — drop "
+                    "exchange=/sharded_exchange= or the plan")
+            if fault_plan.down.shape[1] != n:
+                raise ValueError(
+                    f"FaultPlan is for {fault_plan.down.shape[1]} "
+                    f"nodes, sim has {n}")
+            if delays is not None and self._fp_dup:
+                raise ValueError(
+                    "duplicate delivery does not compose with per-edge "
+                    "`delays`: the history ring stores payload blocks, "
+                    "not received sets — run dup_rate=0 under delays, "
+                    "or 1-hop edges with the full plan")
+            # The Maelstrom-comparable server ledger has no defined
+            # accounting for lost acks / duplicate streams; under a
+            # plan the value-message ledger (`msgs`, sends counted at
+            # send time, dup re-deliveries included) is the
+            # throughput signal.
+            self._srv_on = False
         if delays is not None:
             if exchange is not None:
                 raise ValueError("per-edge delays need the gather path")
@@ -802,10 +913,12 @@ class BroadcastSim:
 
     def _sharded_round(self, state: BroadcastState, nbrs, nbr_mask,
                        parts: Partitions,
-                       delays=None) -> BroadcastState:
+                       delays=None, plan=None) -> BroadcastState:
         """The node-major round inside shard_map: global row ids from the
         shard index, payload all_gather-ed along 'nodes' (the gossip
-        collective riding ICI), ledger psum-ed."""
+        collective riding ICI), ledger psum-ed.  ``plan``: the traced
+        FaultPlan operand (replicated; masks evaluated on global ids
+        per shard)."""
         mesh_axes = tuple(self.mesh.axis_names)
         block = nbrs.shape[0]
         start = lax.axis_index("nodes") * block
@@ -823,7 +936,8 @@ class BroadcastSim:
             widen=lambda p: lax.all_gather(p, "nodes", axis=0, tiled=True),
             reduce_sum=lambda s: lax.psum(s, mesh_axes),
             delays=delays, delay_set=self._delay_set,
-            sync_base_once=sync_base_once)
+            sync_base_once=sync_base_once, plan=plan,
+            dup_on=self._fp_dup)
 
     @staticmethod
     def _live_rows(exists, same, starts, ends):
@@ -1004,6 +1118,15 @@ class BroadcastSim:
         e_spec, s_spec = self._f_specs
         return ((e_spec, s_spec, P(), P()), self._wm_extra_args())
 
+    def _fp_mesh_extra(self):
+        """Extra (in_specs, args) the sharded GATHER-path programs
+        thread through shard_map when a FaultPlan is active: the plan
+        rides as one replicated traced operand (never donated — the
+        state pytree alone is)."""
+        if self.fault_plan is None:
+            return (), ()
+        return ((faults.plan_specs(),), (self.fault_plan,))
+
     def _build_step(self):
         parts, sync_every = self.parts, self.sync_every
 
@@ -1018,13 +1141,19 @@ class BroadcastSim:
                 return lambda state, nbrs, nbr_mask: step_wm(
                     state, self.deg, *extra)
 
+            fp_args = self._fp_mesh_extra()[1]
+
             @jax.jit
-            def step(state: BroadcastState, nbrs, nbr_mask) -> BroadcastState:
+            def step(state: BroadcastState, nbrs, nbr_mask,
+                     *fp) -> BroadcastState:
                 return flood_step(state, nbrs=nbrs, nbr_mask=nbr_mask,
                                   parts=parts, sync_every=sync_every,
                                   delays=self.delays,
-                                  delay_set=self._delay_set)
-            return step
+                                  delay_set=self._delay_set,
+                                  plan=fp[0] if fp else None,
+                                  dup_on=self._fp_dup)
+            return lambda state, nbrs, nbr_mask: step(
+                state, nbrs, nbr_mask, *fp_args)
 
         state_spec, node_spec, part_spec = self._specs()
 
@@ -1045,34 +1174,40 @@ class BroadcastSim:
             return lambda state, nbrs, nbr_mask: step_wm(
                 state, self.deg, *extra_args)
 
+        fp_specs, fp_args = self._fp_mesh_extra()
+
         if self.delays is not None:
             @jax.jit
             @functools.partial(
                 shard_map, mesh=self.mesh,
                 in_specs=(state_spec, node_spec, node_spec, part_spec,
-                          node_spec),
+                          node_spec) + fp_specs,
                 out_specs=state_spec, check_vma=False,
             )
             def step_d(state: BroadcastState, nbrs, nbr_mask,
-                       parts: Partitions, delays) -> BroadcastState:
+                       parts: Partitions, delays, *fp) -> BroadcastState:
                 return self._sharded_round(state, nbrs, nbr_mask, parts,
-                                           delays)
+                                           delays,
+                                           fp[0] if fp else None)
 
             return lambda state, nbrs, nbr_mask: step_d(
-                state, nbrs, nbr_mask, self.parts, self.delays)
+                state, nbrs, nbr_mask, self.parts, self.delays,
+                *fp_args)
 
         @jax.jit
         @functools.partial(
             shard_map, mesh=self.mesh,
-            in_specs=(state_spec, node_spec, node_spec, part_spec),
+            in_specs=(state_spec, node_spec, node_spec, part_spec)
+            + fp_specs,
             out_specs=state_spec,
         )
         def step(state: BroadcastState, nbrs, nbr_mask,
-                 parts: Partitions) -> BroadcastState:
-            return self._sharded_round(state, nbrs, nbr_mask, parts)
+                 parts: Partitions, *fp) -> BroadcastState:
+            return self._sharded_round(state, nbrs, nbr_mask, parts,
+                                       None, fp[0] if fp else None)
 
         return lambda state, nbrs, nbr_mask: step(state, nbrs, nbr_mask,
-                                                  self.parts)
+                                                  self.parts, *fp_args)
 
     def step(self, state: BroadcastState) -> BroadcastState:
         return self._step(state, self.nbrs, self.nbr_mask)
@@ -1099,19 +1234,23 @@ class BroadcastSim:
             return jnp.all(s.received == t)
 
         if self.mesh is None:
-            extra = self._wm_extra_args()
+            # wm masks and the gather path's FaultPlan are mutually
+            # exclusive, so `rest` is one or the other
+            extra = self._wm_extra_args() + self._fp_mesh_extra()[1]
 
             @functools.partial(jax.jit, donate_argnums=dn)
             def run(state: BroadcastState, nbrs, nbr_mask, target, deg,
-                    *masks):
+                    *rest):
                 def body(s):
                     if wm:
                         return self._wm_round_single(s, deg,
-                                                     masks or None)
+                                                     rest or None)
                     return flood_step(s, nbrs=nbrs, nbr_mask=nbr_mask,
                                       parts=parts, sync_every=sync_every,
                                       delays=self.delays,
-                                  delay_set=self._delay_set)
+                                      delay_set=self._delay_set,
+                                      plan=rest[0] if rest else None,
+                                      dup_on=self._fp_dup)
 
                 return while_converge(
                     body, lambda s: eq_target(s, target), state, limit)
@@ -1154,39 +1293,45 @@ class BroadcastSim:
             return lambda state, nbrs, nbr_mask, target: run_wm(
                 state, self.deg, target, *extra_args)
 
+        fp_specs, fp_args = self._fp_mesh_extra()
+
         if self.delays is not None:
             @functools.partial(jax.jit, donate_argnums=dn)
             @functools.partial(
                 shard_map, mesh=mesh,
                 in_specs=(state_spec, node_spec, node_spec, target_spec,
-                          part_spec, node_spec),
+                          part_spec, node_spec) + fp_specs,
                 out_specs=state_spec, check_vma=False,
             )
             def run_d(state: BroadcastState, nbrs, nbr_mask, target,
-                      parts: Partitions, delays) -> BroadcastState:
+                      parts: Partitions, delays, *fp) -> BroadcastState:
                 return converge(
                     state, target,
-                    lambda s: self._sharded_round(s, nbrs, nbr_mask,
-                                                  parts, delays))
+                    lambda s: self._sharded_round(
+                        s, nbrs, nbr_mask, parts, delays,
+                        fp[0] if fp else None))
 
             return lambda state, nbrs, nbr_mask, target: run_d(
-                state, nbrs, nbr_mask, target, self.parts, self.delays)
+                state, nbrs, nbr_mask, target, self.parts, self.delays,
+                *fp_args)
 
         @functools.partial(jax.jit, donate_argnums=dn)
         @functools.partial(
             shard_map, mesh=mesh,
             in_specs=(state_spec, node_spec, node_spec, target_spec,
-                      part_spec),
+                      part_spec) + fp_specs,
             out_specs=state_spec,
         )
         def run(state: BroadcastState, nbrs, nbr_mask, target,
-                parts: Partitions) -> BroadcastState:
+                parts: Partitions, *fp) -> BroadcastState:
             return converge(
                 state, target,
-                lambda s: self._sharded_round(s, nbrs, nbr_mask, parts))
+                lambda s: self._sharded_round(s, nbrs, nbr_mask, parts,
+                                              None,
+                                              fp[0] if fp else None))
 
         return lambda state, nbrs, nbr_mask, target: run(
-            state, nbrs, nbr_mask, target, self.parts)
+            state, nbrs, nbr_mask, target, self.parts, *fp_args)
 
     def _build_fixed(self, rounds: int, donate: bool):
         """Fixed-trip-count runner: ``lax.fori_loop`` of exactly
@@ -1225,7 +1370,7 @@ class BroadcastSim:
         # test_fixed_flood_specialization_matches_while_runner.
         flood_ok = (wm and not self._srv_on and self.delays is None
                     and self._faulted is None and self._delayed is None
-                    and self._edge is None
+                    and self._edge is None and self.fault_plan is None
                     and rounds <= sync_every and rounds > 0)
 
         if self.mesh is None and flood_ok:
@@ -1243,19 +1388,22 @@ class BroadcastSim:
             return self._wire_flood_parts(loop_fn, ledger_fn, masks)
 
         if self.mesh is None:
-            extra = self._wm_extra_args()
+            # as in _build_fused: `rest` is the wm masks OR the plan
+            extra = self._wm_extra_args() + self._fp_mesh_extra()[1]
 
             @functools.partial(jax.jit, donate_argnums=dn)
-            def run(state: BroadcastState, nbrs, nbr_mask, deg, *masks):
+            def run(state: BroadcastState, nbrs, nbr_mask, deg, *rest):
                 def one(s):
                     if wm:
                         return self._wm_round_single(s, deg,
-                                                     masks or None)
+                                                     rest or None)
                     return flood_step(s, nbrs=nbrs, nbr_mask=nbr_mask,
                                       parts=parts,
                                       sync_every=sync_every,
                                       delays=self.delays,
-                                  delay_set=self._delay_set)
+                                      delay_set=self._delay_set,
+                                      plan=rest[0] if rest else None,
+                                      dup_on=self._fp_dup)
 
                 return iterate(state, one)
 
@@ -1316,37 +1464,44 @@ class BroadcastSim:
             return (lambda state, nbrs, nbr_mask: run_wm(
                 state, self.deg, *extra_args)), None
 
+        fp_specs, fp_args = self._fp_mesh_extra()
+
         if self.delays is not None:
             @functools.partial(jax.jit, donate_argnums=dn)
             @functools.partial(
                 shard_map, mesh=mesh,
                 in_specs=(state_spec, node_spec, node_spec, part_spec,
-                          node_spec),
+                          node_spec) + fp_specs,
                 out_specs=state_spec, check_vma=False,
             )
             def run_d(state: BroadcastState, nbrs, nbr_mask,
-                      parts: Partitions, delays) -> BroadcastState:
+                      parts: Partitions, delays, *fp) -> BroadcastState:
                 return iterate(
                     state, lambda s: self._sharded_round(
-                        s, nbrs, nbr_mask, parts, delays))
+                        s, nbrs, nbr_mask, parts, delays,
+                        fp[0] if fp else None))
 
             return (lambda state, nbrs, nbr_mask: run_d(
-                state, nbrs, nbr_mask, self.parts, self.delays)), None
+                state, nbrs, nbr_mask, self.parts, self.delays,
+                *fp_args)), None
 
         @functools.partial(jax.jit, donate_argnums=dn)
         @functools.partial(
             shard_map, mesh=mesh,
-            in_specs=(state_spec, node_spec, node_spec, part_spec),
+            in_specs=(state_spec, node_spec, node_spec, part_spec)
+            + fp_specs,
             out_specs=state_spec,
         )
         def run_g(state: BroadcastState, nbrs, nbr_mask,
-                  parts: Partitions) -> BroadcastState:
+                  parts: Partitions, *fp) -> BroadcastState:
             return iterate(
                 state,
-                lambda s: self._sharded_round(s, nbrs, nbr_mask, parts))
+                lambda s: self._sharded_round(s, nbrs, nbr_mask, parts,
+                                              None,
+                                              fp[0] if fp else None))
 
         return (lambda state, nbrs, nbr_mask: run_g(
-            state, nbrs, nbr_mask, self.parts)), None
+            state, nbrs, nbr_mask, self.parts, *fp_args)), None
 
     # -- drivers -----------------------------------------------------------
 

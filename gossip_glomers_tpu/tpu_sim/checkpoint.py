@@ -23,11 +23,24 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def save(path: str, state: Any, meta: dict | None = None) -> None:
-    """Write a NamedTuple-of-arrays state as one compressed npz."""
+def save(path: str, state: Any, meta: dict | None = None, *,
+         fault_spec=None) -> None:
+    """Write a NamedTuple-of-arrays state as one compressed npz.
+
+    ``fault_spec``: the active nemesis spec of a faulted run (a
+    ``faults.NemesisSpec`` or its ``to_meta()`` dict) — stored in the
+    checkpoint meta under ``"fault_spec"`` so a resume can rebuild the
+    IDENTICAL seeded :class:`~.faults.FaultPlan` (crash windows and
+    loss/dup coins are pure functions of (spec, round), so a run
+    checkpointed mid-fault-window and resumed equals the uninterrupted
+    faulted run bit-exactly — tested)."""
     fields = getattr(state, "_fields", None)
     if fields is None:
         raise TypeError("state must be a NamedTuple of arrays")
+    meta = dict(meta or {})
+    if fault_spec is not None:
+        meta["fault_spec"] = (fault_spec if isinstance(fault_spec, dict)
+                              else fault_spec.to_meta())
     present = [f for f in fields if getattr(state, f) is not None]
     payload = {f: np.asarray(getattr(state, f)) for f in present}
     payload["__meta__"] = np.frombuffer(
@@ -35,8 +48,18 @@ def save(path: str, state: Any, meta: dict | None = None) -> None:
                     "none_fields": [f for f in fields
                                     if f not in present],
                     "class": type(state).__name__,
-                    **(meta or {})}).encode(), dtype=np.uint8)
+                    **meta}).encode(), dtype=np.uint8)
     np.savez_compressed(path, **payload)
+
+
+def fault_spec_from_meta(meta: dict):
+    """Rebuild the checkpointed ``NemesisSpec`` from :func:`restore`'s
+    meta dict, or None when the run was fault-free."""
+    raw = meta.get("fault_spec")
+    if raw is None:
+        return None
+    from .faults import NemesisSpec
+    return NemesisSpec.from_meta(raw)
 
 
 def restore(path: str, state_cls: type, *,

@@ -42,6 +42,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import faults
 from .engine import (Collectives, collectives, donate_argnums_for,
                      fori_rounds, jit_program)
 
@@ -97,7 +98,18 @@ class CounterSim:
                  poll_every: int = 4,
                  kv_sched: KVReach | None = None,
                  mesh: Mesh | None = None, seed: int = 0,
-                 winner_key: str = "auto") -> None:
+                 winner_key: str = "auto",
+                 fault_plan: "faults.FaultPlan | None" = None) -> None:
+        """``fault_plan`` (tpu_sim/faults.py): the crash/loss nemesis.
+        A down node cannot flush, poll, or win the CAS; on restart its
+        AMNESIA row loses ``pending`` (acked-but-unflushed deltas die
+        with the process — exactly the reference's ack-before-
+        durability risk) and ``cached`` (recovered from the KV at the
+        next reachable poll/flush: the repair loop).  The plan's loss
+        stream models transient per-round KV unreachability (a dropped
+        exchange retried next round); duplicate delivery has no effect
+        on a read/CAS protocol (the KV correlates by msg id) and is
+        ignored here."""
         if mode not in ("cas", "allreduce"):
             raise ValueError(f"unknown mode {mode!r}")
         if winner_key not in ("auto", "packed", "wide"):
@@ -134,6 +146,12 @@ class CounterSim:
                       or (winner_key == "auto" and self._row_bits >= 24))
         self.kv_sched = (kv_sched if kv_sched is not None
                          else KVReach.none(n_nodes))
+        self.fault_plan = fault_plan
+        if fault_plan is not None \
+                and fault_plan.down.shape[1] != n_nodes:
+            raise ValueError(
+                f"FaultPlan is for {fault_plan.down.shape[1]} nodes, "
+                f"sim has {n_nodes}")
         self._node_spec = P("nodes") if mesh is not None else None
         self._step = self._build_step()
         self._run_n = self._build_run_n(donate=False)
@@ -170,11 +188,17 @@ class CounterSim:
     # -- round -------------------------------------------------------------
 
     def _round(self, state: CounterState, coll: Collectives,
-               sched: KVReach) -> CounterState:
+               sched: KVReach, plan=None) -> CounterState:
         """One round: flush attempts + the periodic cache poll.
 
         ``coll`` is the engine's collective surface (identity
         single-device; psum/pmin over 'nodes' under shard_map).
+
+        ``plan`` (the traced FaultPlan operand): amnesia rows first —
+        a node restarting this round loses ``pending`` and ``cached``
+        — then down/KV-lossy nodes are masked out of reach, so they
+        neither flush nor poll; their committed sums sit safely in the
+        KV until the repair loop re-reads them.
         """
         row_ids = coll.row_ids
 
@@ -182,6 +206,13 @@ class CounterSim:
             return coll.reduce_sum(jnp.sum(x))
 
         reach = _reach(state.t, row_ids, self.kv_sched)
+        if plan is not None:
+            wipe = faults.amnesia(plan, state.t, row_ids)
+            state = state._replace(
+                pending=jnp.where(wipe, 0, state.pending),
+                cached=jnp.where(wipe, 0, state.cached))
+            reach = (reach & faults.node_up(plan, state.t, row_ids)
+                     & ~faults.kv_drop(plan, state.t, row_ids))
         want = (state.pending > 0) & reach
 
         if self.mode == "allreduce":
@@ -267,25 +298,40 @@ class CounterSim:
         node_spec = self._node_spec
         return CounterState(node_spec, node_spec, P(), P(), P())
 
+    def _fp_extra(self):
+        """(in_specs, args) for the FaultPlan operand — replicated,
+        threaded as an explicit traced argument like the KV schedule."""
+        if self.fault_plan is None:
+            return (), ()
+        return ((faults.plan_specs(),), (self.fault_plan,))
+
     def _build_step(self):
         mesh = self.mesh
 
         if mesh is None:
-            def step(state: CounterState) -> CounterState:
+            fp_args0 = self._fp_extra()[1]
+
+            def step(state: CounterState, *fp) -> CounterState:
                 return self._round(
-                    state, collectives(self.n_nodes), self.kv_sched)
-            return jit_program(step)
+                    state, collectives(self.n_nodes), self.kv_sched,
+                    fp[0] if fp else None)
+            prog0 = jit_program(step)
+            return lambda state: prog0(state, *fp_args0)
 
         sched_spec = KVReach(P(), P(), P(None, None))
+        fp_specs, fp_args = self._fp_extra()
 
-        def step(state: CounterState, sched: KVReach) -> CounterState:
+        def step(state: CounterState, sched: KVReach,
+                 *fp) -> CounterState:
             coll = collectives(state.pending.shape[0], mesh)
-            return self._round(state, coll, sched)
+            return self._round(state, coll, sched,
+                               fp[0] if fp else None)
 
         prog = jit_program(step, mesh=mesh,
-                           in_specs=(self._state_spec(), sched_spec),
+                           in_specs=(self._state_spec(), sched_spec)
+                           + fp_specs,
                            out_specs=self._state_spec())
-        return lambda state: prog(state, self.kv_sched)
+        return lambda state: prog(state, self.kv_sched, *fp_args)
 
     def _build_run_n(self, donate: bool):
         """Multi-round runner as ONE device program (dynamic fori_loop
@@ -299,28 +345,42 @@ class CounterSim:
         copy instead of input + output."""
         mesh = self.mesh
         dn = donate_argnums_for(donate, 0)
+        fp_specs, fp_args = self._fp_extra()
 
         if mesh is None:
-            def run_n(state: CounterState, n) -> CounterState:
+            def run_n(state: CounterState, n, *fp) -> CounterState:
                 coll = collectives(self.n_nodes)
+                if fp:
+                    # the engine's per-round fault operand: the plan
+                    # rides as a driver argument — never donated,
+                    # never baked in as a constant
+                    return fori_rounds(
+                        lambda s, p: self._round(s, coll,
+                                                 self.kv_sched, p),
+                        state, n, operand=fp[0])
                 return fori_rounds(
                     lambda s: self._round(s, coll, self.kv_sched),
                     state, n)
-            return jit_program(run_n, donate_argnums=dn)
+            prog0 = jit_program(run_n, donate_argnums=dn)
+            return lambda state, n: prog0(state, n, *fp_args)
 
         sched_spec = KVReach(P(), P(), P(None, None))
 
         def run_n(state: CounterState, sched: KVReach,
-                  n) -> CounterState:
+                  n, *fp) -> CounterState:
             coll = collectives(state.pending.shape[0], mesh)
+            if fp:
+                return fori_rounds(
+                    lambda s, p: self._round(s, coll, sched, p),
+                    state, n, operand=fp[0])
             return fori_rounds(lambda s: self._round(s, coll, sched),
                                state, n)
 
         prog = jit_program(
             run_n, mesh=mesh,
-            in_specs=(self._state_spec(), sched_spec, P()),
+            in_specs=(self._state_spec(), sched_spec, P()) + fp_specs,
             out_specs=self._state_spec(), donate_argnums=dn)
-        return lambda state, n: prog(state, self.kv_sched, n)
+        return lambda state, n: prog(state, self.kv_sched, n, *fp_args)
 
     def step(self, state: CounterState) -> CounterState:
         return self._step(state)

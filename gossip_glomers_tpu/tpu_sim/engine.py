@@ -218,13 +218,23 @@ def collectives(block: int, mesh=None, *, axis: str = "nodes",
 # -- round-fused drivers (traced-side combinators) ----------------------
 
 
-def fori_rounds(round_fn: Callable, state, rounds, unroll: int = 1):
+def fori_rounds(round_fn: Callable, state, rounds, unroll: int = 1,
+                operand=None):
     """Exactly ``rounds`` rounds as one counter-only ``fori_loop`` —
     the fixed-trip driver (``rounds`` may be traced: dynamic bound;
-    ``unroll`` needs a static bound)."""
+    ``unroll`` needs a static bound).
+
+    ``operand``: optional traced pytree handed to every round as
+    ``round_fn(state, operand)`` — the per-round fault operand (e.g. a
+    compiled :class:`~.faults.FaultPlan`): it rides as a DRIVER
+    argument, so donating the state never captures the fault data as a
+    baked-in constant and the same program replays any plan."""
     kw = {} if unroll == 1 else {"unroll": unroll}
-    return lax.fori_loop(0, rounds, lambda i, s: round_fn(s), state,
-                         **kw)
+    if operand is None:
+        body = lambda i, s: round_fn(s)            # noqa: E731
+    else:
+        body = lambda i, s: round_fn(s, operand)   # noqa: E731
+    return lax.fori_loop(0, rounds, body, state, **kw)
 
 
 def scan_rounds(round_fn: Callable, state, xs):
@@ -235,18 +245,20 @@ def scan_rounds(round_fn: Callable, state, xs):
 
 
 def while_converge(round_fn: Callable, converged: Callable, state,
-                   limit):
+                   limit, operand=None):
     """Run-to-convergence as one ``while_loop`` with the check ON
     DEVICE every round: no host↔device round-trip per step.
     ``converged(state) -> () bool`` must already be globalized on a
-    mesh (psum the per-shard verdict inside the callback)."""
+    mesh (psum the per-shard verdict inside the callback).
+    ``operand``: optional per-round fault operand, as in
+    :func:`fori_rounds` (``round_fn(state, operand)``)."""
     def cond(carry):
         s, done = carry
         return (~done) & (s.t < limit)
 
     def body(carry):
         s, _ = carry
-        s2 = round_fn(s)
+        s2 = round_fn(s) if operand is None else round_fn(s, operand)
         return (s2, converged(s2))
 
     final, _ = lax.while_loop(cond, body, (state, converged(state)))

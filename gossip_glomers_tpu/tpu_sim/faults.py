@@ -1,0 +1,362 @@
+"""Nemesis faults for the vectorized backend: crash/restart, message
+loss, duplicate delivery — compiled, seeded, replayable.
+
+The harness side already models Maelstrom's *partition* nemesis as data
+(harness/faults.py windows -> the gather path's :class:`~.broadcast.
+Partitions` masks, the KV-reachability windows of counter/kafka).  This
+module closes the rest of the Maelstrom fault model the same way
+(survey §5 "fault injection = masked adjacency updates"):
+
+- **crash/restart** (Maelstrom's kill/restart nemesis): windows of
+  down nodes, exactly the shape of the partition schedule's
+  ``starts/ends`` arrays.  A down node sends nothing, receives nothing,
+  and cannot reach the KV services; on the round its window ends it
+  restarts with its VOLATILE state re-initialized — an "amnesia row"
+  (broadcast: received/frontier; counter: pending/cached; kafka:
+  presence/local-committed rows) — and recovers only through the
+  workload's own anti-entropy, like a Maelstrom-restarted process.
+- **probabilistic message loss** (the lossy-link nemesis): each
+  directed edge drops a given round's delivery with probability
+  ``loss_rate``.  The coin is a stateless counter-based hash of
+  ``(seed, round, src, dst)`` — zero state, zero memory, identical on
+  every shard, and bit-replayable from the seed alone.
+- **duplicate delivery**: with probability ``dup_rate`` an edge
+  re-delivers every value its source ever flooded (the source's full
+  ``received`` set) — the at-least-once duplicate stream that gossip
+  dedup and CRDT merges must absorb.
+
+Everything compiles to a :class:`FaultPlan` of tiny arrays/scalars that
+rides through the fused drivers as ONE traced operand (never donated,
+never baked in as a constant), so faulted programs stay donation-first
+and a (spec, seed) pair replays bit-exactly — which is what lets the
+recovery certifier (harness/checkers.py ``check_recovery``) assert hard
+outcomes under the full fault model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+# distinct stream salts: loss and dup draw independent coins from the
+# same (seed, t, src, dst) counter
+_SALT_LOSS = 0x9E3779B9
+_SALT_DUP = 0x85EBCA6B
+# the KV services are not a node row; their "edge" hashes use this as
+# the dst so node<->service loss draws its own stream
+KV_DST = 0x7FFFFFFF
+
+
+class FaultPlan(NamedTuple):
+    """The compiled device form of a :class:`NemesisSpec` — the same
+    data-as-faults shape as the partition schedules (windows evaluated
+    at round t on device) plus the loss/dup thresholds and the hash
+    seed.  All leaves are tiny and replicated; thread the plan through
+    a driver as a traced argument (see :func:`plan_specs`), never
+    donate it."""
+
+    starts: jnp.ndarray    # (C,) int32 — crash window start round (incl)
+    ends: jnp.ndarray      # (C,) int32 — crash window end round (excl)
+    down: jnp.ndarray      # (C, N) bool — rows down while window active
+    loss_num: jnp.ndarray  # () uint32 — drop iff hash < loss_num
+    loss_until: jnp.ndarray  # () int32 — loss active for rounds < this
+    dup_num: jnp.ndarray   # () uint32 — dup iff hash < dup_num
+    dup_until: jnp.ndarray   # () int32
+    seed: jnp.ndarray      # () uint32 — the replay key
+
+
+def plan_specs() -> FaultPlan:
+    """shard_map in_specs for a :class:`FaultPlan` operand: every leaf
+    replicated (the masks are evaluated per shard on global ids)."""
+    return FaultPlan(P(), P(), P(None, None), P(), P(), P(), P(), P())
+
+
+def _rate_to_num(rate: float) -> np.uint32:
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"rate must be in [0, 1], got {rate}")
+    return np.uint32(min(2**32 - 1, int(round(rate * 2**32))))
+
+
+@dataclass(frozen=True)
+class NemesisSpec:
+    """Host-side seeded fault spec — JSON-able (checkpoint meta), and
+    ``compile()``-able to the device :class:`FaultPlan`.
+
+    ``crash``: list of ``(start_round, end_round, [node ids])`` windows.
+    ``loss_rate``/``dup_rate`` apply to every directed delivery for
+    rounds ``[0, loss_until)`` / ``[0, dup_until)``; ``until`` values
+    default to the last crash-window end (so a pure-loss spec must set
+    them explicitly).  ``clear_round`` is the first round with no fault
+    active — the recovery certifier's t=0.
+    """
+
+    n_nodes: int
+    seed: int = 0
+    crash: tuple = field(default_factory=tuple)   # ((start, end, (i,..)),)
+    loss_rate: float = 0.0
+    loss_until: int | None = None
+    dup_rate: float = 0.0
+    dup_until: int | None = None
+
+    def _until(self, explicit: int | None, rate: float) -> int:
+        if explicit is not None:
+            return int(explicit)
+        if rate == 0.0:
+            return 0
+        ends = [int(e) for _s, e, _ns in self.crash]
+        if not ends:
+            raise ValueError(
+                "a loss/dup rate with no crash windows needs an "
+                "explicit loss_until/dup_until (rounds)")
+        return max(ends)
+
+    @property
+    def clear_round(self) -> int:
+        """First round at which every fault has cleared."""
+        ends = [int(e) for _s, e, _ns in self.crash]
+        return max([0] + ends + [self._until(self.loss_until,
+                                             self.loss_rate),
+                                 self._until(self.dup_until,
+                                             self.dup_rate)])
+
+    def __post_init__(self) -> None:
+        norm = []
+        for start, end, nodes in self.crash:
+            nodes = tuple(sorted(int(i) for i in nodes))
+            if not 0 <= int(start) < int(end):
+                raise ValueError(
+                    f"bad crash window [{start}, {end})")
+            for i in nodes:
+                if not 0 <= i < self.n_nodes:
+                    raise ValueError(f"crash node {i} out of range")
+            norm.append((int(start), int(end), nodes))
+        object.__setattr__(self, "crash", tuple(norm))
+        _rate_to_num(self.loss_rate)
+        _rate_to_num(self.dup_rate)
+        # validate that every active rate has a derivable horizon
+        self._until(self.loss_until, self.loss_rate)
+        self._until(self.dup_until, self.dup_rate)
+
+    # -- host mirrors ----------------------------------------------------
+
+    def host_up(self, t: int) -> np.ndarray:
+        """(N,) bool — which nodes are up at round ``t`` (the host twin
+        of :func:`node_up`, for staging ops away from dead nodes)."""
+        up = np.ones(self.n_nodes, bool)
+        for start, end, nodes in self.crash:
+            if start <= t < end:
+                up[list(nodes)] = False
+        return up
+
+    # -- compilation -----------------------------------------------------
+
+    def compile(self) -> FaultPlan:
+        c = len(self.crash)
+        starts = np.zeros((c,), np.int32)
+        ends = np.zeros((c,), np.int32)
+        down = np.zeros((c, self.n_nodes), bool)
+        for w, (start, end, nodes) in enumerate(self.crash):
+            starts[w], ends[w] = start, end
+            down[w, list(nodes)] = True
+        return FaultPlan(
+            starts=jnp.asarray(starts), ends=jnp.asarray(ends),
+            down=jnp.asarray(down),
+            loss_num=jnp.uint32(_rate_to_num(self.loss_rate)),
+            loss_until=jnp.int32(self._until(self.loss_until,
+                                             self.loss_rate)),
+            dup_num=jnp.uint32(_rate_to_num(self.dup_rate)),
+            dup_until=jnp.int32(self._until(self.dup_until,
+                                            self.dup_rate)),
+            seed=jnp.uint32(self.seed & 0xFFFFFFFF))
+
+    # -- checkpoint meta -------------------------------------------------
+
+    def to_meta(self) -> dict:
+        """JSON-able form for checkpoint meta (tpu_sim/checkpoint.py):
+        a resumed faulted run rebuilds the identical plan from this."""
+        return {"n_nodes": self.n_nodes, "seed": self.seed,
+                "crash": [[s, e, list(ns)] for s, e, ns in self.crash],
+                "loss_rate": self.loss_rate,
+                "loss_until": self._until(self.loss_until,
+                                          self.loss_rate),
+                "dup_rate": self.dup_rate,
+                "dup_until": self._until(self.dup_until, self.dup_rate)}
+
+    @staticmethod
+    def from_meta(meta: dict) -> "NemesisSpec":
+        return NemesisSpec(
+            n_nodes=int(meta["n_nodes"]), seed=int(meta["seed"]),
+            crash=tuple((int(s), int(e), tuple(ns))
+                        for s, e, ns in meta.get("crash", ())),
+            loss_rate=float(meta.get("loss_rate", 0.0)),
+            loss_until=meta.get("loss_until"),
+            dup_rate=float(meta.get("dup_rate", 0.0)),
+            dup_until=meta.get("dup_until"))
+
+
+def random_spec(n_nodes: int, *, seed: int, horizon: int,
+                n_crash_windows: int = 2, crash_frac: float = 0.25,
+                crash_len: int | None = None,
+                loss_rate: float = 0.0,
+                dup_rate: float = 0.0) -> NemesisSpec:
+    """Randomized nemesis campaign within ``[0, horizon)`` rounds —
+    the shape of Maelstrom's combined kill+lossy nemesis, fully
+    determined by ``seed``.  Each crash window takes a random subset of
+    at most ``crash_frac`` of the nodes (never all of them: a majority
+    always stays up to serve anti-entropy), at a random start, for
+    ``crash_len`` rounds, clipped to end inside the horizon.  Windows
+    are placed in DISJOINT time segments, so at any round at most one
+    window is active and at least ``1 - crash_frac`` of the cluster
+    stays up to serve anti-entropy.  Loss/dup run for the whole
+    horizon."""
+    if horizon < 2:
+        raise ValueError("horizon must be >= 2 rounds")
+    rng = np.random.default_rng(seed)
+    n_down = max(1, min(n_nodes - 1, int(round(crash_frac * n_nodes))))
+    seg = horizon / max(1, n_crash_windows)
+    length = (crash_len if crash_len is not None
+              else max(1, int(seg) // 2))
+    windows = []
+    for w in range(n_crash_windows):
+        lo = max(1, int(w * seg))
+        hi = max(lo + 1, int((w + 1) * seg))
+        start = int(rng.integers(lo, hi))
+        end = int(min(hi, start + max(1, length)))
+        if end <= start:
+            continue
+        nodes = tuple(int(i) for i in rng.choice(
+            n_nodes, size=n_down, replace=False))
+        windows.append((start, end, nodes))
+    return NemesisSpec(
+        n_nodes=n_nodes, seed=seed, crash=tuple(windows),
+        loss_rate=loss_rate, loss_until=horizon if loss_rate else None,
+        dup_rate=dup_rate, dup_until=horizon if dup_rate else None)
+
+
+# -- device-side mask evaluation ----------------------------------------
+
+
+def node_up(plan: FaultPlan, t, ids: jnp.ndarray) -> jnp.ndarray:
+    """bool, shaped like ``ids`` — which of the (GLOBAL) node ids are
+    up at round ``t``.  Same windows-as-data evaluation as the
+    partition masks (broadcast._edge_live, counter._reach)."""
+    n_windows = plan.starts.shape[0]
+    up = jnp.ones(ids.shape, bool)
+    if n_windows == 0:
+        return up
+
+    def body(w, up):
+        active = (plan.starts[w] <= t) & (t < plan.ends[w])
+        return up & ~(active & plan.down[w][ids])
+
+    return lax.fori_loop(0, n_windows, body, up)
+
+
+def amnesia(plan: FaultPlan, t, ids: jnp.ndarray) -> jnp.ndarray:
+    """bool, shaped like ``ids`` — nodes that CRASH at round ``t``
+    (down now, up last round).  These are the amnesia rows: volatile
+    state dies WITH the process, so the sims wipe it at crash entry;
+    the rows stay empty while down (every edge to/from them is masked)
+    and the node restarts empty when its window ends, recovering only
+    via anti-entropy."""
+    return ~node_up(plan, t, ids) & node_up(plan, t - 1, ids)
+
+
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """32-bit finalizer (splitmix-style avalanche) — the same mixing
+    family the counter's seeded CAS-winner hash uses."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _edge_hash(plan: FaultPlan, t, src, dst, salt: int) -> jnp.ndarray:
+    """uint32 counter-based stream: h(seed, t, src, dst, salt) —
+    stateless, so every shard (and every replay) evaluates the same
+    coin for the same directed delivery."""
+    x = (jnp.asarray(src).astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+         ^ jnp.asarray(dst).astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
+         ^ jnp.asarray(t).astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         ^ plan.seed ^ jnp.uint32(salt))
+    return _mix32(x)
+
+
+def edge_drop(plan: FaultPlan, t, src, dst) -> jnp.ndarray:
+    """bool (broadcast of src/dst shapes) — this round's delivery on
+    the directed edge src -> dst is LOST in flight.  Loss is drawn per
+    direction (the two directions of a link drop independently, like
+    Maelstrom's lossy network)."""
+    h = _edge_hash(plan, t, src, dst, _SALT_LOSS)
+    return (t < plan.loss_until) & (h < plan.loss_num)
+
+
+def edge_dup(plan: FaultPlan, t, src, dst) -> jnp.ndarray:
+    """bool — this round the edge ALSO re-delivers everything its
+    source ever sent (the source's full received set): the
+    at-least-once duplicate stream.  Independent of the loss coin."""
+    h = _edge_hash(plan, t, src, dst, _SALT_DUP)
+    return (t < plan.dup_until) & (h < plan.dup_num)
+
+
+def kv_drop(plan: FaultPlan, t, ids) -> jnp.ndarray:
+    """bool, shaped like ``ids`` — node i's KV exchange is lost this
+    round (transient service unreachability: the node retries next
+    round, exactly like a reachability window that lasts one round)."""
+    return edge_drop(plan, t, ids, KV_DST)
+
+
+# -- host mirrors (for op staging and ack accounting) --------------------
+
+
+def _mix32_np(x: np.ndarray) -> np.ndarray:
+    x = x.astype(np.uint32)
+    x ^= x >> np.uint32(16)
+    x *= np.uint32(0x7FEB352D)
+    x ^= x >> np.uint32(15)
+    x *= np.uint32(0x846CA68B)
+    x ^= x >> np.uint32(16)
+    return x
+
+
+def host_node_up(plan: FaultPlan, t: int) -> np.ndarray:
+    """(N,) bool — numpy twin of :func:`node_up` over a COMPILED plan
+    (drivers that only hold the plan, e.g. ``KafkaSim.alloc_offsets``,
+    mirror the round's gate without a device round-trip)."""
+    up = np.ones(np.asarray(plan.down).shape[1], bool)
+    starts, ends = np.asarray(plan.starts), np.asarray(plan.ends)
+    down = np.asarray(plan.down)
+    for w in range(starts.shape[0]):
+        if starts[w] <= t < ends[w]:
+            up &= ~down[w]
+    return up
+
+
+def host_edge_drop(plan: FaultPlan, t: int, src, dst) -> np.ndarray:
+    """numpy twin of :func:`edge_drop` — bit-identical coins."""
+    src = np.asarray(src, np.int64).astype(np.uint32)
+    dst = np.asarray(dst, np.int64).astype(np.uint32)
+    t_term = np.uint32((int(t) * 0x9E3779B9) & 0xFFFFFFFF)
+    x = (src * np.uint32(0xC2B2AE35)
+         ^ dst * np.uint32(0x27D4EB2F)
+         ^ t_term ^ np.uint32(plan.seed) ^ np.uint32(_SALT_LOSS))
+    return ((t < int(plan.loss_until))
+            & (_mix32_np(x) < np.uint32(plan.loss_num)))
+
+
+def host_kv_ok(plan: FaultPlan, t: int) -> np.ndarray:
+    """(N,) bool — up AND this round's KV exchange not lost: the host
+    twin of the sims' ``reach`` gate under a plan."""
+    n = np.asarray(plan.down).shape[1]
+    ids = np.arange(n)
+    return host_node_up(plan, t) & ~host_edge_drop(
+        plan, t, ids, np.full(n, KV_DST))
+
+

@@ -78,6 +78,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import faults
 from .counter import KVReach, _reach
 from .engine import (collectives, donate_argnums_for, jit_program,
                      scan_rounds)
@@ -123,7 +124,9 @@ class KafkaSim:
                  max_sends: int = 4, mesh: Mesh | None = None,
                  kv_retries: int = 10,
                  kv_sched: KVReach | None = None,
-                 repl_fast: bool | None = None) -> None:
+                 repl_fast: bool | None = None,
+                 fault_plan: "faults.FaultPlan | None" = None,
+                 resync_every: int = 4) -> None:
         """``kv_sched``: lin-kv reachability windows (counter.KVReach —
         the same nemesis shape the counter's flush is gated by).  A
         node partitioned from lin-kv at round t:
@@ -146,7 +149,28 @@ class KafkaSim:
         all-True (see :meth:`_round`'s replication block) and the
         link-mask matmul otherwise; False pins the matmul
         unconditionally (the parity tests use it to pin the two paths
-        bit-identical)."""
+        bit-identical).
+
+        ``fault_plan`` (tpu_sim/faults.py): the crash/loss nemesis.  A
+        down node cannot allocate, commit, receive replicate_msgs, or
+        serve anti-entropy; on restart its AMNESIA rows lose the
+        ``present`` bitset and ``local_committed`` cache (the
+        reference keeps both in process memory) — the shared lin-kv
+        cells and the log content survive (the service is durable).
+        The plan's loss stream drops individual replicate deliveries
+        in flight (the reference's acks=0 stance) and per-round KV
+        exchanges.  Crash/loss pin the link-mask matmul replication
+        path (the origin-union shortcut assumes every link delivers);
+        duplicate delivery is inert here — replicate inserts are
+        idempotent on (key, offset) (logmap.go:315-317), bit-OR in
+        this model.
+
+        ``resync_every``: with a plan, every ``resync_every``-th round
+        each LIVE node pulls the union of the live peers' presence
+        (and max-bumps its committed cache from it) — the anti-entropy
+        repair loop that re-replicates what crashed origins appended
+        and what loss dropped, so runs converge after faults clear.
+        Inert without a plan (the fault-free paths are untouched)."""
         self.n_nodes = n_nodes
         self.n_keys = n_keys
         self.capacity = capacity
@@ -159,6 +183,18 @@ class KafkaSim:
         self.kv_sched = (kv_sched if kv_sched is not None
                          else KVReach.none(n_nodes))
         self.repl_fast = repl_fast
+        self.fault_plan = fault_plan
+        self.resync_every = resync_every
+        if fault_plan is not None \
+                and fault_plan.down.shape[1] != n_nodes:
+            raise ValueError(
+                f"FaultPlan is for {fault_plan.down.shape[1]} nodes, "
+                f"sim has {n_nodes}")
+        # crash windows or loss force the matmul path; a dup-only plan
+        # is inert here (idempotent replicate inserts)
+        self._fp_active = fault_plan is not None and (
+            int(fault_plan.starts.shape[0]) > 0
+            or int(fault_plan.loss_num) > 0)
         self._run_rounds = {}
         self._step_progs = {}
         self._poll_batch_fn = None
@@ -186,7 +222,7 @@ class KafkaSim:
 
     def _round(self, state: KafkaState, send_key, send_val, commit_req,
                repl_ok, sched: KVReach, coll, *,
-               repl_full: bool = False) -> KafkaState:
+               repl_full: bool = False, plan=None) -> KafkaState:
         """One round: allocate + append + replicate, then commit.
 
         send_key/send_val: (rows, S) int32, key = -1 for no-op.
@@ -200,6 +236,8 @@ class KafkaSim:
         all_gather / psum / pmax / pmin over 'nodes' under shard_map).
         repl_full (static): every link delivers — replication collapses
         to the origin-union fast path (see the replication block).
+        plan (traced FaultPlan operand): amnesia rows, liveness/loss
+        gating, and the periodic presence resync — see __init__.
         """
         row_ids = coll.row_ids
         widen, reduce_sum = coll.widen, coll.reduce_sum
@@ -211,6 +249,23 @@ class KafkaSim:
         # who can reach lin-kv this round — computed over the GLOBAL
         # node axis (send linearization is global), tiny arrays
         reach = _reach(state.t, jnp.arange(n, dtype=jnp.int32), sched)
+        up = None
+        if plan is not None:
+            ids = jnp.arange(n, dtype=jnp.int32)
+            up = faults.node_up(plan, state.t, ids)          # (N,)
+            wipe_rows = faults.amnesia(plan, state.t, ids)[row_ids]
+            # amnesia: a crashing node's in-memory presence bitset and
+            # committed-offset cache die with the process (survives:
+            # log content and the lin-kv cells — the service is
+            # durable); it restarts empty when the window ends
+            state = state._replace(
+                present=jnp.where(wipe_rows[:, None, None],
+                                  jnp.uint32(0), state.present),
+                local_committed=jnp.where(wipe_rows[:, None], 0,
+                                          state.local_committed))
+            # down nodes cannot reach the KV; loss eats one round's
+            # exchange (retried next round, like a 1-round window)
+            reach = reach & up & ~faults.kv_drop(plan, state.t, ids)
 
         # -- offset allocation (global, linearized in (node, slot) order:
         #    the reference's lin-kv CAS loop, logmap.go:255-285).  The
@@ -220,6 +275,10 @@ class KafkaSim:
         all_key = widen(send_key).reshape(-1)            # (N*S,)
         all_val = widen(send_val).reshape(-1)
         tried = all_key >= 0
+        if up is not None:
+            # a down node submits nothing: its batch rows are dead ops,
+            # not charged-and-timed-out ones
+            tried = tried & jnp.repeat(up, s_dim)
         # a KV-blocked send never allocates: the read times out and the
         # node aborts after one attempt (models/kafka.py alloc_offset)
         valid = tried & jnp.repeat(reach, s_dim)
@@ -265,6 +324,16 @@ class KafkaSim:
                 scat_k, slot_ok // 32].add(bit, mode="drop")[None]
             present = state.present | deliver
         else:
+            if up is not None:
+                # the plan drives the replication matrix per round:
+                # both endpoints up, delivery coin survives the loss
+                # stream (fire-and-forget, log.go:159-175 — nothing
+                # retries a dropped replicate)
+                ids = jnp.arange(n, dtype=jnp.int32)
+                repl_ok = (repl_ok & up[:, None] & up[None, :]
+                           & ~faults.edge_drop(plan, state.t,
+                                               ids[:, None],
+                                               ids[None, :]))
             # new appends per origin node, bit-packed: (N, K, Wc).
             new_words = jnp.zeros((n, k_dim, wc), jnp.uint32).at[
                 origin, scat_k, slot_ok // 32].add(bit, mode="drop")
@@ -314,6 +383,36 @@ class KafkaSim:
             hwm = jnp.maximum(state.local_committed,
                               jnp.maximum(own_off[row_ids], deliv_off))
 
+        # -- presence resync (plan only): every resync_every-th round
+        #    each LIVE node pulls the union of live peers' presence —
+        #    the anti-entropy that re-replicates crashed origins'
+        #    appends and loss-dropped deliveries (observably what the
+        #    reference would get from re-running sendReplicateMsg off
+        #    the durable log after a restart).  Pulled bits max-bump
+        #    the committed cache exactly like replicate deliveries
+        #    (logmap.go:309-311).
+        n_resync = jnp.uint32(0)
+        if plan is not None:
+            is_rs = ((state.t % jnp.int32(self.resync_every) == 0)
+                     & (state.t > 0))
+            pres_full = widen(present)               # (N, K, Wc)
+            union = lax.reduce(
+                jnp.where(up[:, None, None], pres_full, jnp.uint32(0)),
+                jnp.uint32(0), lax.bitwise_or, (0,))  # (K, Wc)
+            take = is_rs & up[row_ids]
+            sync_new = jnp.where(take[:, None, None],
+                                 union & ~present, jnp.uint32(0))
+            present = present | sync_new
+            top_rs = jnp.where(sync_new > 0,
+                               word_base + 32
+                               - lax.clz(sync_new).astype(jnp.int32),
+                               0)
+            hwm = jnp.maximum(hwm, jnp.max(top_rs, axis=2))
+            # ledger: one pull request + one response per live node
+            # per resync round
+            n_resync = reduce_sum(jnp.sum(
+                take.astype(jnp.uint32))) * jnp.uint32(2)
+
         # -- commits (after this round's sends).  Local skip when the
         #    HWM covers the request (logmap.go:247-251); otherwise the
         #    dance reads the SHARED cell:
@@ -339,6 +438,10 @@ class KafkaSim:
         # commit of 0 would write the cell's "missing" sentinel, so it
         # is treated as a no-op rather than allowed to desync the cell
         want = req >= 1
+        if up is not None:
+            # down nodes submit no commits (dead ops, not timed-out
+            # dances)
+            want = want & up[row_ids][:, None]
         skip = want & (hwm > 0) & (hwm >= req)
         dance = want & ~skip
         # KV-blocked active dances time out and re-run kv_retries times
@@ -406,7 +509,8 @@ class KafkaSim:
         msgs = (state.msgs + kv_send_msgs + blocked_send_msgs
                 + n_sends * jnp.uint32(n - 1)
                 + n_active * jnp.uint32(2) + n_write_leg * jnp.uint32(2)
-                + n_blocked_c * jnp.uint32(self.kv_retries))
+                + n_blocked_c * jnp.uint32(self.kv_retries)
+                + n_resync)
         return KafkaState(log_vals, present, kv_val,
                           local_committed, state.t + 1, msgs)
 
@@ -417,8 +521,12 @@ class KafkaSim:
     def _repl_full(self, repl_ok) -> bool:
         """Host-side path pick: the origin-union fast path applies when
         every link delivers (``repl_ok`` omitted or all-True) unless the
-        constructor pinned ``repl_fast=False``."""
+        constructor pinned ``repl_fast=False`` — or a crash/loss
+        FaultPlan is active (the union shortcut assumes every link
+        delivers; the plan's per-round masks need the matmul's lhs)."""
         if self.repl_fast is False:
+            return False
+        if self._fp_active:
             return False
         return repl_ok is None or bool(np.all(repl_ok))
 
@@ -431,14 +539,17 @@ class KafkaSim:
         cannot prove."""
         if repl_full not in self._step_progs:
             mesh = self.mesh
+            fp = self._fp_active
 
             def step(state, send_key, send_val, commit_req, *rest):
-                repl = None if repl_full else rest[0]
-                sched = rest[-1]
+                rest = list(rest)
+                plan = rest.pop() if fp else None
+                sched = rest.pop()
+                repl = None if repl_full else rest.pop()
                 coll = collectives(send_key.shape[0], mesh)
                 return self._round(state, send_key, send_val,
                                    commit_req, repl, sched, coll,
-                                   repl_full=repl_full)
+                                   repl_full=repl_full, plan=plan)
 
             if mesh is None:
                 prog = jit_program(step)
@@ -447,7 +558,8 @@ class KafkaSim:
                 state_spec = self._state_spec()
                 in_specs = ((state_spec, node2, node2, node2)
                             + (() if repl_full else (P(None, None),))
-                            + (KVReach(P(), P(), P(None, None)),))
+                            + (KVReach(P(), P(), P(None, None)),)
+                            + ((faults.plan_specs(),) if fp else ()))
                 prog = jit_program(step, mesh=mesh, in_specs=in_specs,
                                    out_specs=state_spec,
                                    check_vma=False)
@@ -486,10 +598,13 @@ class KafkaSim:
             k_dim = self.n_keys
             mesh = self.mesh
             dn = donate_argnums_for(donate, 0)
+            fp = self._fp_active
 
             def run(state, sks, svs, *rest):
-                repl = None if repl_full else rest[-2]
-                sched = rest[-1]
+                rest = list(rest)
+                plan = rest.pop() if fp else None
+                sched = rest.pop()
+                repl = None if repl_full else rest.pop()
                 coll = collectives(sks.shape[1], mesh)
 
                 def body(s, xs):
@@ -497,7 +612,8 @@ class KafkaSim:
                     cr = (xs[2] if has_commits else jnp.full(
                         (sk.shape[0], k_dim), -1, jnp.int32))
                     return self._round(s, sk, sv, cr, repl, sched,
-                                       coll, repl_full=repl_full)
+                                       coll, repl_full=repl_full,
+                                       plan=plan)
 
                 xs = ((sks, svs) + ((rest[0],) if has_commits
                                     else ()))
@@ -511,7 +627,8 @@ class KafkaSim:
                 in_specs = ((state_spec, node3, node3)
                             + ((node3,) if has_commits else ())
                             + (() if repl_full else (P(None, None),))
-                            + (KVReach(P(), P(), P(None, None)),))
+                            + (KVReach(P(), P(), P(None, None)),)
+                            + ((faults.plan_specs(),) if fp else ()))
                 prog = jit_program(run, mesh=mesh, in_specs=in_specs,
                                    out_specs=state_spec,
                                    check_vma=False, donate_argnums=dn)
@@ -525,7 +642,10 @@ class KafkaSim:
             args = [jax.device_put(a, sh) for a in args]
         if not repl_full:
             args.append(jnp.asarray(repl_ok))
-        return self._run_rounds[key](state, *args, self.kv_sched)
+        args.append(self.kv_sched)
+        if self._fp_active:
+            args.append(self.fault_plan)
+        return self._run_rounds[key](state, *args)
 
     def run_fused(self, state: KafkaState, send_key: np.ndarray,
                   send_val: np.ndarray,
@@ -559,7 +679,10 @@ class KafkaSim:
             args = [jax.device_put(a, sh) for a in args]
         if not repl_full:
             args.append(jnp.asarray(repl_ok))
-        return self._step_prog(repl_full)(state, *args, self.kv_sched)
+        args.append(self.kv_sched)
+        if self._fp_active:
+            args.append(self.fault_plan)
+        return self._step_prog(repl_full)(state, *args)
 
     # -- host-side reads (reference read semantics) ------------------------
 
@@ -594,6 +717,8 @@ class KafkaSim:
         for w in range(int(np.asarray(sched.starts).shape[0])):
             if int(sched.starts[w]) <= t < int(sched.ends[w]):
                 reach &= ~np.asarray(sched.blocked[w])
+        if self.fault_plan is not None:
+            reach &= faults.host_kv_ok(self.fault_plan, t)
         return np.asarray(self._alloc_fn(
             state_before.kv_val, jnp.asarray(send_key, jnp.int32),
             jnp.asarray(reach)))

@@ -1,0 +1,57 @@
+"""CI fault-matrix smoke: one CRASH and one LOSS scenario per stateful
+sim, on CPU, seconds-not-minutes — the budget-safe slice of
+benchmarks/fault_sweep.py the tier-1 gate runs on every push.
+
+Exits nonzero if any scenario fails recovery certification (bounded
+convergence after faults clear, zero lost acknowledged writes).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+try:
+    from gossip_glomers_tpu.harness import nemesis
+except ImportError:  # bare checkout (no pip install -e .)
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from gossip_glomers_tpu.harness import nemesis
+from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec  # noqa: E402
+
+N = 8
+CRASH = NemesisSpec(n_nodes=N, seed=3, crash=((12, 16, (1, 5)),))
+LOSS = NemesisSpec(n_nodes=N, seed=4, loss_rate=0.2, loss_until=10)
+
+SCENARIOS = [
+    ("broadcast/crash", nemesis.run_broadcast_nemesis, CRASH, {}),
+    ("broadcast/loss", nemesis.run_broadcast_nemesis, LOSS, {}),
+    ("counter/crash", nemesis.run_counter_nemesis, CRASH, {}),
+    ("counter/loss", nemesis.run_counter_nemesis, LOSS, {}),
+    ("kafka/crash", nemesis.run_kafka_nemesis, CRASH, {}),
+    ("kafka/loss", nemesis.run_kafka_nemesis, LOSS, {}),
+]
+
+
+def main() -> int:
+    failed = []
+    for name, run, spec, kw in SCENARIOS:
+        res = run(spec, **kw)
+        status = "ok" if res["ok"] else "FAIL"
+        print(f"fault-smoke {name:16s} {status}  "
+              f"recovery={res['recovery_rounds']} "
+              f"lost={res['n_lost_writes']} msgs={res['msgs_total']}")
+        if not res["ok"]:
+            failed.append((name, res))
+    if failed:
+        print(f"fault-smoke: {len(failed)} scenario(s) failed",
+              file=sys.stderr)
+        return 1
+    print("fault-smoke: all scenarios certified")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -126,6 +126,42 @@ def test_donated_program_memory_footprint_shrinks():
     assert mu["peak_live_bytes"] >= 2 * state_bytes
 
 
+def test_faulted_program_keeps_donation_footprint():
+    # the FaultPlan rides as a tiny traced operand (never donated):
+    # the donated faulted fused driver must still alias the full state
+    # pytree — no live-state regression vs the fault-free program
+    from gossip_glomers_tpu.parallel.topology import grid
+    from gossip_glomers_tpu.tpu_sim import broadcast as B
+    from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec
+
+    n, nv = 256, 2048                        # W = 64 words
+    nbrs = to_padded_neighbors(grid(n))
+    spec = NemesisSpec(n_nodes=n, seed=1, crash=((1, 3, (0, 5)),),
+                       loss_rate=0.1, loss_until=4,
+                       dup_rate=0.1, dup_until=4)
+    sim = B.BroadcastSim(nbrs, n_values=nv, srv_ledger=False,
+                         fault_plan=spec.compile())
+    state, _ = sim.stage(make_inject(n, nv))
+    parts = B.Partitions.none(n)
+
+    def fixed(st, nbrs_a, mask_a, plan):
+        return engine.fori_rounds(
+            lambda s: B.flood_step(s, nbrs=nbrs_a, nbr_mask=mask_a,
+                                   parts=parts, sync_every=8,
+                                   plan=plan, dup_on=True), st, 4)
+
+    don = jax.jit(fixed, donate_argnums=(0,))
+    undon = jax.jit(fixed)
+    args = (state, sim.nbrs, sim.nbr_mask, sim.fault_plan)
+    md = engine.memory_footprint(don, *args)
+    mu = engine.memory_footprint(undon, *args)
+    if md is None or mu is None:
+        pytest.skip("backend exposes no memory_analysis")
+    state_bytes = 2 * n * (nv // 32) * 4     # received + frontier
+    assert md["alias_bytes"] >= state_bytes
+    assert md["peak_live_bytes"] <= mu["peak_live_bytes"] - state_bytes
+
+
 # -- counter: engine drivers --------------------------------------------
 
 

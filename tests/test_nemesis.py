@@ -1,0 +1,437 @@
+"""Nemesis subsystem (tpu_sim/faults.py + harness/nemesis.py):
+crash/restart amnesia, probabilistic loss, duplicate delivery — seeded,
+replayable, certified.
+
+Pins the PR-2 contract: a seeded crash+loss+partition scenario on each
+of broadcast/counter/kafka converges after the faults clear with zero
+lost acknowledged writes, replays bit-exactly from the same FaultPlan
+seed, composes with the existing fault modes on the gather path, and is
+explicitly rejected (with an actionable message) on the structured
+fast paths.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+from gossip_glomers_tpu.harness import nemesis
+from gossip_glomers_tpu.harness.checkers import check_recovery
+from gossip_glomers_tpu.harness.faults import PartitionWindow
+from gossip_glomers_tpu.parallel.topology import (grid,
+                                                  to_padded_neighbors)
+from gossip_glomers_tpu.tpu_sim import checkpoint
+from gossip_glomers_tpu.tpu_sim import faults as F
+from gossip_glomers_tpu.tpu_sim.broadcast import (BroadcastSim,
+                                                  Partitions,
+                                                  make_inject)
+from gossip_glomers_tpu.tpu_sim.counter import CounterSim
+from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim
+from gossip_glomers_tpu.tpu_sim.structured import make_exchange
+
+
+def mesh_1d():
+    return Mesh(np.array(jax.devices()).reshape(8), ("nodes",))
+
+
+SPEC = F.NemesisSpec(n_nodes=16, seed=7, crash=((3, 8, (2, 5, 11)),),
+                     loss_rate=0.2, loss_until=10,
+                     dup_rate=0.1, dup_until=10)
+
+
+def _parts(n, cut=4, start=3, end=6):
+    groups = np.zeros((1, n), np.int8)
+    groups[0, :cut] = 1
+    return Partitions(jnp.array([start], jnp.int32),
+                      jnp.array([end], jnp.int32), jnp.asarray(groups))
+
+
+# -- spec / plan construction -------------------------------------------
+
+
+def test_spec_validates_and_round_trips_meta():
+    meta = SPEC.to_meta()
+    assert F.NemesisSpec.from_meta(meta) == SPEC
+    with pytest.raises(ValueError, match="bad crash window"):
+        F.NemesisSpec(n_nodes=4, crash=((5, 5, (0,)),))
+    with pytest.raises(ValueError, match="out of range"):
+        F.NemesisSpec(n_nodes=4, crash=((0, 2, (9,)),))
+    with pytest.raises(ValueError, match="loss_until"):
+        F.NemesisSpec(n_nodes=4, loss_rate=0.5)
+    assert SPEC.clear_round == 10
+
+
+def test_host_mirrors_match_device_masks():
+    plan = SPEC.compile()
+    n = SPEC.n_nodes
+    ids = jnp.arange(n, dtype=jnp.int32)
+    for t in (0, 3, 5, 7, 8, 12):
+        up_dev = np.asarray(F.node_up(plan, jnp.int32(t), ids))
+        assert (up_dev == SPEC.host_up(t)).all(), t
+        assert (up_dev == F.host_node_up(plan, t)).all(), t
+        kv_dev = np.asarray(
+            F.node_up(plan, jnp.int32(t), ids)
+            & ~F.kv_drop(plan, jnp.int32(t), ids))
+        assert (kv_dev == F.host_kv_ok(plan, t)).all(), t
+
+
+def test_loss_rate_is_roughly_calibrated_and_seed_dependent():
+    plan = F.NemesisSpec(n_nodes=64, seed=1, loss_rate=0.25,
+                         loss_until=100).compile()
+    ids = np.arange(64)
+    rates = []
+    for t in range(40):
+        d = F.host_edge_drop(plan, t, ids[:, None], ids[None, :])
+        rates.append(d.mean())
+    assert 0.2 < np.mean(rates) < 0.3
+    plan2 = F.NemesisSpec(n_nodes=64, seed=2, loss_rate=0.25,
+                          loss_until=100).compile()
+    d1 = F.host_edge_drop(plan, 0, ids[:, None], ids[None, :])
+    d2 = F.host_edge_drop(plan2, 0, ids[:, None], ids[None, :])
+    assert (d1 != d2).any()
+    # past the horizon the coin never fires
+    assert not F.host_edge_drop(plan, 100, ids, ids).any()
+
+
+def test_random_spec_never_crashes_everyone():
+    for seed in range(5):
+        spec = F.random_spec(12, seed=seed, horizon=10,
+                             n_crash_windows=3, crash_frac=0.5,
+                             loss_rate=0.1)
+        for t in range(spec.clear_round):
+            assert spec.host_up(t).sum() >= 6
+        assert spec.clear_round <= 10
+
+
+# -- certified scenarios: crash + loss + partition per sim --------------
+
+
+def test_broadcast_nemesis_certifies_and_replays():
+    parts = _parts(16)
+    r1 = nemesis.run_broadcast_nemesis(SPEC, parts=parts)
+    assert r1["ok"], r1
+    assert r1["n_lost_writes"] == 0
+    assert r1["converged_round"] >= SPEC.clear_round
+    # bit-exact replay from the same seed
+    r2 = nemesis.run_broadcast_nemesis(SPEC, parts=_parts(16))
+    assert r2["msgs_total"] == r1["msgs_total"]
+    assert r2["converged_round"] == r1["converged_round"]
+    # a different fault seed takes a different trajectory
+    other = F.NemesisSpec(**{**SPEC.to_meta(), "seed": 8})
+    r3 = nemesis.run_broadcast_nemesis(other, parts=_parts(16))
+    assert r3["msgs_total"] != r1["msgs_total"]
+
+
+def test_counter_nemesis_certifies_zero_lost_after_drain():
+    # crash windows start after the cas loop drained every pending
+    # delta (one winner per round, n=12) — nothing to lose
+    spec = F.NemesisSpec(n_nodes=12, seed=5, crash=((14, 20, (3, 7)),),
+                         loss_rate=0.15, loss_until=22)
+    r = nemesis.run_counter_nemesis(spec)
+    assert r["ok"], r
+    assert r["kv"] == r["acked_sum"]
+
+
+def test_counter_amnesia_loses_unflushed_pending():
+    # the flip side: crash BEFORE the flush drains — acked deltas die
+    # with the process and the certifier reports exactly that
+    spec = F.NemesisSpec(n_nodes=12, seed=5, crash=((1, 4, (0, 1)),))
+    r = nemesis.run_counter_nemesis(spec)
+    assert not r["ok"]
+    assert r["n_lost_writes"] == 1
+    assert r["kv"] < r["acked_sum"]
+
+
+def test_kafka_nemesis_certifies_and_replays():
+    spec = F.NemesisSpec(n_nodes=8, seed=11, crash=((3, 7, (1, 4)),),
+                         loss_rate=0.25, loss_until=10)
+    r1 = nemesis.run_kafka_nemesis(spec)
+    assert r1["ok"], r1
+    assert r1["n_allocated"] > 0 and r1["n_lost_writes"] == 0
+    r2 = nemesis.run_kafka_nemesis(spec)
+    assert (r2["msgs_total"], r2["converged_round"]) \
+        == (r1["msgs_total"], r1["converged_round"])
+
+
+def test_check_recovery_verdicts():
+    ok, d = check_recovery(clear_round=10, converged_round=14,
+                           max_recovery_rounds=8, lost_writes=[],
+                           msgs_at_clear=100, msgs_at_converged=120)
+    assert ok and d["recovery_rounds"] == 4
+    assert d["msgs_per_round_faulted"] == 10.0
+    assert d["msgs_per_round_recovery"] == 5.0
+    assert d["degraded_throughput"] == 2.0
+    ok, d = check_recovery(clear_round=10, converged_round=None,
+                           max_recovery_rounds=8, lost_writes=[])
+    assert not ok
+    ok, _ = check_recovery(clear_round=10, converged_round=12,
+                           max_recovery_rounds=8, lost_writes=[(0, 1)])
+    assert not ok
+    ok, _ = check_recovery(clear_round=10, converged_round=30,
+                           max_recovery_rounds=8, lost_writes=[])
+    assert not ok
+
+
+# -- engine parity under faults (donation preserved) --------------------
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_broadcast_faulted_fused_matches_stepwise(use_mesh):
+    n, nv = 16, 24
+    mesh = mesh_1d() if use_mesh else None
+    nbrs = to_padded_neighbors(grid(n))
+    parts = _parts(n)
+    sim = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                       fault_plan=SPEC.compile(), parts=parts,
+                       mesh=mesh)
+    inject = make_inject(n, nv)
+    ref, rounds_ref = sim.run(inject, max_rounds=200)
+    fused, rounds_f = sim.run_fused(inject, max_rounds=200)
+    assert rounds_f == rounds_ref
+    assert (np.asarray(fused.received) == np.asarray(ref.received)).all()
+    assert int(fused.msgs) == int(ref.msgs)
+    # donated fixed-trip runner agrees and consumed its staged input
+    st, _t = sim.stage(inject)
+    fixed = sim.run_staged_fixed(st, rounds_ref, donate=True)
+    assert (np.asarray(fixed.received) == np.asarray(ref.received)).all()
+    assert int(fixed.msgs) == int(ref.msgs)
+    with pytest.raises(RuntimeError):
+        np.asarray(st.received) + 0
+
+
+def test_counter_faulted_fused_matches_stepwise_and_mesh():
+    n, rounds = 16, 24
+    spec = F.NemesisSpec(n_nodes=n, seed=9, crash=((2, 6, (1, 8)),),
+                         loss_rate=0.2, loss_until=12)
+    deltas = np.arange(1, n + 1, dtype=np.int32)
+    sim = CounterSim(n, mode="cas", poll_every=2,
+                     fault_plan=spec.compile())
+    ref = sim.add(sim.init_state(), deltas)
+    for _ in range(rounds):
+        ref = sim.step(ref)
+    st = sim.add(sim.init_state(), deltas)
+    don = sim.run_fused(st, rounds)
+    for a, b in zip(ref, don):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    with pytest.raises(RuntimeError):
+        np.asarray(st.pending) + 0
+    shd = CounterSim(n, mode="cas", poll_every=2,
+                     fault_plan=spec.compile(), mesh=mesh_1d())
+    s2 = shd.run_fused(shd.add(shd.init_state(), deltas), rounds)
+    for a, b in zip(ref, s2):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_kafka_faulted_scan_matches_stepwise_and_mesh():
+    spec = F.NemesisSpec(n_nodes=8, seed=11, crash=((3, 7, (1, 4)),),
+                         loss_rate=0.25, loss_until=10)
+    n, k, cap, s = 8, 4, 64, 2
+    sks, svs, crs = nemesis.stage_kafka_ops(spec, 12, n_keys=k,
+                                            max_sends=s)
+    sim = KafkaSim(n, k, capacity=cap, max_sends=s,
+                   fault_plan=spec.compile())
+    assert not sim._repl_full(None)          # crash/loss pin the matmul
+    ref = sim.init_state()
+    for t in range(12):
+        ref = sim.step(ref, sks[t], svs[t], crs[t])
+    st = sim.init_state()
+    don = sim.run_fused(st, sks, svs, crs)
+    for a, b, name in zip(ref, don, ref._fields):
+        assert (np.asarray(a) == np.asarray(b)).all(), name
+    with pytest.raises(RuntimeError):
+        np.asarray(st.present) + 0
+    shd = KafkaSim(n, k, capacity=cap, max_sends=s,
+                   fault_plan=spec.compile(), mesh=mesh_1d())
+    sm = shd.run_rounds(shd.init_state(), sks, svs, crs)
+    for a, b, name in zip(ref, sm, ref._fields):
+        assert (np.asarray(a) == np.asarray(b)).all(), name
+
+
+# -- fault composition on the gather path -------------------------------
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_partitions_delays_crash_loss_compose_on_gather_path(use_mesh):
+    # the full matrix: partition windows + per-edge delays + crash
+    # windows + loss on one run, converging after everything clears,
+    # sharded bit-identical to single-device
+    n, nv = 16, 24
+    nbrs = to_padded_neighbors(grid(n))
+    rng = np.random.default_rng(0)
+    delays = np.where(nbrs >= 0, rng.integers(1, 4, nbrs.shape),
+                      1).astype(np.int32)
+    spec = F.NemesisSpec(n_nodes=n, seed=3, crash=((4, 9, (1, 6)),),
+                         loss_rate=0.15, loss_until=12)
+    mesh = mesh_1d() if use_mesh else None
+    sim = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                       fault_plan=spec.compile(), parts=_parts(n),
+                       delays=delays, mesh=mesh)
+    inject = make_inject(n, nv)
+    state, rounds = sim.run(inject, max_rounds=400)
+    assert sim.converged(state, sim.target_bits(inject))
+    assert rounds > spec.clear_round
+    if use_mesh:
+        ref = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                           fault_plan=spec.compile(), parts=_parts(n),
+                           delays=delays)
+        sr, rr = ref.run(inject, max_rounds=400)
+        assert rr == rounds
+        assert (np.asarray(sr.received)
+                == np.asarray(state.received)).all()
+        assert int(sr.msgs) == int(state.msgs)
+
+
+def test_delayed_message_to_crashed_node_dies_in_flight():
+    # a delivery whose receiver crashed between send and arrival dies
+    # with the process: node 1 goes down at round 2, exactly when node
+    # 0's round-0 flood (edge delay 3) would land — after restart the
+    # value must be GONE from node 1 (anti-entropy disabled), not
+    # retained by a dead process
+    nbrs = np.array([[1], [0]], np.int32)
+    delays = np.full((2, 1), 3, np.int32)
+    spec = F.NemesisSpec(n_nodes=2, seed=0, crash=((2, 5, (1,)),))
+    sim = BroadcastSim(nbrs, n_values=1, sync_every=1 << 20,
+                       srv_ledger=False, delays=delays,
+                       fault_plan=spec.compile())
+    inject = np.zeros((2, 1), np.uint32)
+    inject[0, 0] = 1                         # value 0 starts at node 0
+    state = sim.init_state(inject)
+    for _ in range(8):
+        state = sim.step(state)
+    rec = sim.received_node_major(state)
+    assert rec[0, 0] == 1
+    assert rec[1, 0] == 0, "delivery to a dead process must not land"
+
+
+def test_dup_delivery_is_absorbed_but_ledger_visible():
+    # same seed with and without the dup stream: identical final state
+    # (idempotent merge), strictly more messages
+    n, nv = 16, 24
+    nbrs = to_padded_neighbors(grid(n))
+    base = dict(n_nodes=n, seed=7, crash=((3, 8, (2, 5)),),
+                loss_rate=0.0)
+    no_dup = F.NemesisSpec(**base)
+    with_dup = F.NemesisSpec(**base, dup_rate=0.3, dup_until=10)
+    inject = make_inject(n, nv)
+    s1, r1 = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                          fault_plan=no_dup.compile()).run(inject)
+    sim2 = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                        fault_plan=with_dup.compile())
+    s2, r2 = sim2.run(inject)
+    assert sim2.converged(s2, sim2.target_bits(inject))
+    assert int(s2.msgs) > int(s1.msgs)
+
+
+# -- structured-path rejection (explicit, tested messages) --------------
+
+
+def test_fault_plan_rejected_on_structured_path():
+    n, nv = 64, 32
+    nbrs = to_padded_neighbors(grid(n))
+    with pytest.raises(ValueError, match="gather path only"):
+        BroadcastSim(nbrs, n_values=nv,
+                     exchange=make_exchange("grid", n),
+                     fault_plan=SPEC.compile())
+
+
+def test_dup_rejected_under_per_edge_delays():
+    n, nv = 16, 24
+    nbrs = to_padded_neighbors(grid(n))
+    delays = np.ones_like(nbrs, np.int32)
+    with pytest.raises(ValueError, match="duplicate delivery"):
+        BroadcastSim(nbrs, n_values=nv, delays=delays,
+                     fault_plan=SPEC.compile())
+
+
+def test_structured_mutual_exclusion_messages():
+    # the pre-existing delayed/faulted guards, previously untested
+    from gossip_glomers_tpu.tpu_sim.structured import (make_delayed,
+                                                       make_faulted)
+    n, nv = 64, 32
+    nbrs = to_padded_neighbors(grid(n))
+    ex = make_exchange("grid", n)
+    delayed = make_delayed("grid", n, [1, 2, 1, 2])
+    with pytest.raises(ValueError, match="needs a structured exchange"):
+        BroadcastSim(nbrs, n_values=nv, delayed=delayed)
+    with pytest.raises(ValueError,
+                       match="mutually exclusive"):
+        BroadcastSim(nbrs, n_values=nv, exchange=ex, delayed=delayed,
+                     delays=np.ones_like(nbrs, np.int32))
+    groups = np.zeros((1, n), np.int8)
+    groups[0, :8] = 1
+    faulted = make_faulted("grid", n, groups)
+    with pytest.raises(ValueError, match="FaultedDelayed"):
+        BroadcastSim(nbrs, n_values=nv, exchange=ex, delayed=delayed,
+                     faulted=faulted)
+    parts = Partitions(jnp.array([1], jnp.int32),
+                       jnp.array([3], jnp.int32), jnp.asarray(groups))
+    with pytest.raises(ValueError, match="make_faulted"):
+        BroadcastSim(nbrs, n_values=nv, exchange=ex, parts=parts)
+
+
+# -- checkpoint: FaultPlan meta + mid-fault-window resume ---------------
+
+
+def test_checkpoint_mid_fault_window_resumes_bit_exact(tmp_path):
+    n, nv = 16, 24
+    nbrs = to_padded_neighbors(grid(n))
+    inject = make_inject(n, nv)
+
+    def fresh():
+        return BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                            fault_plan=SPEC.compile())
+
+    # uninterrupted faulted run
+    sim = fresh()
+    ref = sim.init_state(inject)
+    for _ in range(14):
+        ref = sim.step(ref)
+
+    # checkpoint at round 5 — INSIDE the crash window [3, 8)
+    sim_a = fresh()
+    st = sim_a.init_state(inject)
+    for _ in range(5):
+        st = sim_a.step(st)
+    path = str(tmp_path / "mid_fault.npz")
+    checkpoint.save(path, st, {"round": 5}, fault_spec=SPEC)
+
+    # resume in a FRESH sim rebuilt from the checkpointed spec
+    from gossip_glomers_tpu.tpu_sim.broadcast import BroadcastState
+    restored, meta = checkpoint.restore(path, BroadcastState)
+    spec_back = checkpoint.fault_spec_from_meta(meta)
+    assert spec_back == SPEC and meta["round"] == 5
+    sim_b = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                         fault_plan=spec_back.compile())
+    for _ in range(14 - 5):
+        restored = sim_b.step(restored)
+    for f in ("received", "frontier", "t", "msgs"):
+        assert (np.asarray(getattr(restored, f))
+                == np.asarray(getattr(ref, f))).all(), f
+
+
+# -- harness partition-window validation --------------------------------
+
+
+def test_partition_window_rejects_overlapping_groups():
+    with pytest.raises(ValueError, match="disjoint"):
+        PartitionWindow(0.0, 1.0, [["n0", "n1"], ["n1", "n2"]])
+    # disjoint groups (and duplicates within one group) stay legal
+    w = PartitionWindow(0.0, 1.0, [["n0", "n0"], ["n1"]])
+    assert w.blocks("n0", "n1") and not w.blocks("n0", "n0")
+
+
+# -- engine: per-round fault operand ------------------------------------
+
+
+def test_fori_rounds_operand_threads_through():
+    from gossip_glomers_tpu.tpu_sim import engine
+
+    def round_fn(s, op):
+        return s + op
+
+    out = jax.jit(lambda s, op: engine.fori_rounds(
+        round_fn, s, 5, operand=op))(jnp.int32(0), jnp.int32(3))
+    assert int(out) == 15
+    out2 = engine.fori_rounds(lambda s: s + 1, jnp.int32(0), 5)
+    assert int(out2) == 5

@@ -146,6 +146,51 @@ def test_reply_with_no_callback_is_ignored():
         proc.wait(timeout=5)
 
 
+def test_kv_retry_backoff_on_timeout():
+    """The jittered exponential-backoff retry helper (NodeCore.
+    with_backoff) driving AsyncKV retries on the stdio runtime: a KV
+    whose service never replies must re-issue the read `retries` times
+    with growing spacing, then surface the final code-0 timeout —
+    instead of the old immediate re-fire."""
+    import io
+    import time
+
+    from gossip_glomers_tpu.protocol import TIMEOUT
+    from gossip_glomers_tpu.runtime.kv import AsyncKV
+    from gossip_glomers_tpu.runtime.node import StdioNode
+
+    out = io.StringIO()
+    node = StdioNode(in_stream=io.StringIO(), out_stream=out,
+                     err_stream=io.StringIO())
+    node.node_id = "n0"
+    import random as _random
+    node.rng = _random.Random(0)             # deterministic jitter
+
+    kv = AsyncKV(node, "seq-kv", timeout=0.01, retries=3,
+                 backoff_base=0.02, backoff_cap=0.2)
+    done = []
+    t0 = time.monotonic()
+    kv.read("k", lambda value, err: done.append((value, err,
+                                                 time.monotonic() - t0)))
+    deadline = time.monotonic() + 5.0
+    while not done and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert done, "callback never fired"
+    value, err, elapsed = done[0]
+    assert value is None and err is not None and err.code == TIMEOUT
+    # 4 read requests hit the wire (1 first try + 3 backed-off retries)
+    sent = [line for line in out.getvalue().splitlines() if line]
+    assert len(sent) == 4, sent
+    # the retries were SPACED: total elapsed covers the three backoff
+    # delays (>= (0.02 + 0.04 + 0.08) * (1 - jitter)) plus 4 timeouts
+    assert elapsed >= 0.04 + 4 * 0.01
+    # and each wire line is the same read op with a fresh msg_id
+    ids = [json.loads(line)["body"]["msg_id"] for line in sent]
+    assert len(set(ids)) == 4
+    assert all(json.loads(line)["body"]["type"] == "read"
+               for line in sent)
+
+
 def test_console_script_entry_points_registered():
     """Packaging (pyproject [project.scripts]): one Maelstrom-style
     executable per challenge, like the reference's checked-in binaries.
