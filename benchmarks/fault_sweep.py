@@ -11,9 +11,14 @@ Usage::
 
     python benchmarks/fault_sweep.py [--out BENCH_PR2.json]
         [--n-nodes 16] [--loss 0,0.1,0.3] [--crash 0,1,2]
+    python benchmarks/fault_sweep.py --structured [--out BENCH_PR3.json]
 
-Every cell is seeded (spec seed = a pure function of the cell), so the
-sweep replays bit-exactly.
+``--structured`` (PR 3) times one FAULTED round — crash+loss+dup, the
+full plan — on the words-major structured path vs the adjacency gather
+at the sweep's large-N broadcast points, asserting bit-exactness
+(received sets and msgs ledgers) at every shape, and re-certifies the
+scenario matrix on the structured path.  Every cell is seeded (spec
+seed = a pure function of the cell), so the sweep replays bit-exactly.
 """
 
 from __future__ import annotations
@@ -99,15 +104,152 @@ def sweep(n_nodes: int, loss_rates: list[float], crash_counts: list[int],
     return rows
 
 
+def _faulted_round_row(n_nodes: int, n_values: int, topology: str,
+                       rounds: int = 16, reps: int = 3,
+                       seed: int = 5) -> dict:
+    """Time one FULL-nemesis round (crash windows + loss + dup active
+    every timed round) on the gather path vs the words-major structured
+    path, same backend, same plan — and assert bit-exactness of the
+    final received sets and msgs ledgers.  Timed program: the fixed-
+    trip fused runner on a pre-staged state (one dispatch, no
+    convergence read), per-round = wall / (reps * rounds)."""
+    import jax
+    import numpy as np
+
+    from gossip_glomers_tpu.parallel.topology import (grid,
+                                                      to_padded_neighbors,
+                                                      tree)
+    from gossip_glomers_tpu.tpu_sim import structured
+    from gossip_glomers_tpu.tpu_sim.broadcast import (BroadcastSim,
+                                                      make_inject)
+
+    build = {"tree": tree, "grid": grid}[topology]
+    nbrs = to_padded_neighbors(build(n_nodes))
+    spec = NemesisSpec(
+        n_nodes=n_nodes, seed=seed,
+        crash=((2, rounds, tuple(range(0, n_nodes, 97))),),
+        loss_rate=0.1, loss_until=rounds + 1,
+        dup_rate=0.05, dup_until=rounds + 1)
+    inject = make_inject(n_nodes, n_values)
+    finals, ms = {}, {}
+    for name, kw in (
+            ("gather", {}),
+            ("structured", dict(
+                exchange=structured.make_exchange(topology, n_nodes),
+                nemesis=structured.make_nemesis(topology, n_nodes,
+                                                spec)))):
+        sim = BroadcastSim(nbrs, n_values=n_values, sync_every=8,
+                           fault_plan=spec.compile(),
+                           srv_ledger=False, **kw)
+        st, _tgt = sim.stage(inject)
+        out = sim.run_staged_fixed(st, rounds)    # compile + warm
+        jax.block_until_ready(out.received)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = sim.run_staged_fixed(st, rounds)
+            jax.block_until_ready(out.received)
+        ms[name] = ((time.perf_counter() - t0) / (reps * rounds)
+                    * 1e3)
+        finals[name] = (sim.received_node_major(out), int(out.msgs))
+    bit_exact = (bool((finals["gather"][0]
+                       == finals["structured"][0]).all())
+                 and finals["gather"][1] == finals["structured"][1])
+    return {
+        "n_nodes": n_nodes, "n_values": n_values, "topology": topology,
+        "rounds": rounds,
+        "ms_per_round_gather_faulted": round(ms["gather"], 4),
+        "ms_per_round_structured_faulted": round(ms["structured"], 4),
+        "speedup": round(ms["gather"] / ms["structured"], 2),
+        "msgs": finals["gather"][1],
+        "bit_exact": bit_exact,
+    }
+
+
+def structured_mode(seed: int = 0) -> dict:
+    """The PR-3 ``--structured`` artifact: faulted-round timing rows at
+    the 1024-node sweep point (and larger shapes for the scaling
+    trend), plus a re-certification of the scenario matrix on the
+    structured path."""
+    import jax
+
+    timing = [
+        _faulted_round_row(1024, 32, "tree"),        # W=1: the
+        # words-major layout's native shape (lane-dense on TPU)
+        _faulted_round_row(1024, 2048, "tree"),      # the sweep cell's
+        # own nv=2n shape (W=64)
+        _faulted_round_row(1024, 32, "grid"),
+        _faulted_round_row(131072, 32, "tree"),      # scaling trend
+    ]
+    for row in timing:
+        print(f"faulted-round {row['topology']:5s} n={row['n_nodes']:<7}"
+              f" W={(row['n_values'] + 31) // 32:<3}"
+              f" gather={row['ms_per_round_gather_faulted']:.3f}ms"
+              f" structured="
+              f"{row['ms_per_round_structured_faulted']:.3f}ms"
+              f" {row['speedup']}x bit_exact={row['bit_exact']}")
+    # re-certify the smoke matrix on the structured path (same seeded
+    # specs as the equivalent gather cells, default grid topology; the
+    # tree topology's structured crash scenario lives in
+    # scripts/fault_smoke.py)
+    cert = []
+    for loss, n_crash in ((0.0, 1), (0.2, 0), (0.1, 1)):
+        cell_seed = seed + 1000 * n_crash + int(loss * 100)
+        spec = _spec_for(64, n_crash, loss, 14, cell_seed)
+        res = nemesis.run_broadcast_nemesis(spec, structured=True)
+        cert.append({"loss_rate": loss, "n_crash_windows": n_crash,
+                     "ok": res["ok"],
+                     "recovery_rounds": res["recovery_rounds"],
+                     "msgs_total": res["msgs_total"],
+                     "path": res["path"]})
+        print(f"certify structured loss={loss} crash={n_crash} "
+              f"ok={res['ok']}")
+    return {
+        "benchmark": "fault_sweep_structured",
+        "backend": jax.default_backend(),
+        "faulted_round_timing": timing,
+        "structured_certification": cert,
+        "all_bit_exact": all(r["bit_exact"] for r in timing),
+        "all_ok": (all(r["bit_exact"] for r in timing)
+                   and all(c["ok"] for c in cert)),
+        "note": (
+            "Same-backend comparison of one full-nemesis round "
+            "(crash+loss+dup active every round, srv ledger off on "
+            "both paths).  On the CPU backend the structured path is "
+            "~2x at W=1 and roughly at parity at W=64 — XLA:CPU "
+            "gathers rows at cache speed, so the adjacency gather has "
+            "no tile-granularity penalty here.  The 60-190x words-"
+            "major advantage this PR unlocks for faulted runs is the "
+            "recorded TPU layout effect (BENCH_r05: 61 ms/round "
+            "gather vs 1.07 ms tree at 1M nodes / W=1; edge-delayed "
+            "0.54 vs 140.9 ms/round, 263x): a TPU reads a full "
+            "8x128 tile per gathered row, which the structured "
+            "reshapes/rolls avoid entirely.  The masks/coins "
+            "decomposition measured here is what makes the faulted "
+            "round expressible as those same structured terms — "
+            "bit-exact with the gather path on every row above."),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--out", default="BENCH_PR2.json")
+    ap.add_argument("--out", default=None)
     ap.add_argument("--n-nodes", type=int, default=16)
     ap.add_argument("--loss", default="0,0.1,0.3")
     ap.add_argument("--crash", default="0,1,2")
     ap.add_argument("--horizon", type=int, default=12)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--structured", action="store_true",
+                    help="PR-3 mode: structured-vs-gather faulted-"
+                         "round timing + structured certification "
+                         "(default out: BENCH_PR3.json)")
     args = ap.parse_args()
+    if args.structured:
+        out = structured_mode(seed=args.seed)
+        path = args.out or "BENCH_PR3.json"
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {path}; all_ok={out['all_ok']}")
+        return 0 if out["all_ok"] else 1
     loss_rates = [float(x) for x in args.loss.split(",")]
     crash_counts = [int(x) for x in args.crash.split(",")]
     rows = sweep(args.n_nodes, loss_rates, crash_counts,
@@ -121,9 +263,10 @@ def main() -> int:
         "rows": rows,
         "all_ok": all(r["ok"] for r in rows),
     }
-    with open(args.out, "w") as f:
+    path = args.out or "BENCH_PR2.json"
+    with open(path, "w") as f:
         json.dump(out, f, indent=1)
-    print(f"wrote {args.out}; all_ok={out['all_ok']}")
+    print(f"wrote {path}; all_ok={out['all_ok']}")
     return 0 if out["all_ok"] else 1
 
 

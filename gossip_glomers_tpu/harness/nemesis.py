@@ -52,18 +52,34 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                           topology: str = "grid", sync_every: int = 4,
                           parts: Partitions | None = None,
                           max_recovery_rounds: int = 96,
-                          mesh=None) -> dict:
+                          mesh=None, structured: bool = False) -> dict:
     """Broadcast under the full nemesis (crash/loss/dup from ``spec``,
     plus an optional partition schedule): values injected round-robin
     at round 0, convergence = every node holds every value.  A lost
     acknowledged write is a value absent from EVERY node — an amnesia
-    row that took the sole copy down with it."""
+    row that took the sole copy down with it.
+
+    ``structured``: run the words-major structured path (the same plan
+    decomposed into per-direction masks by structured.make_nemesis —
+    bit-exact with the gather path, ~0.5 ms/round at the 1M-node
+    shapes) instead of the adjacency gather."""
+    from ..tpu_sim import structured as S
     n = spec.n_nodes
     nv = n_values if n_values is not None else 2 * n
+    kw = {}
+    if structured:
+        groups = (np.asarray(parts.group) if parts is not None
+                  else None)
+        n_shards = (int(mesh.shape["nodes"])
+                    if mesh is not None else None)
+        kw = dict(exchange=S.make_exchange(topology, n),
+                  nemesis=S.make_nemesis(topology, n, spec,
+                                         groups=groups,
+                                         n_shards=n_shards))
     sim = BroadcastSim(_neighbors(topology, n), n_values=nv,
                        sync_every=sync_every, parts=parts,
                        fault_plan=spec.compile(), srv_ledger=False,
-                       mesh=mesh)
+                       mesh=mesh, **kw)
     inject = make_inject(n, nv)
     target = sim.target_bits(inject)
     clear = spec.clear_round
@@ -87,6 +103,7 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
         msgs_at_clear=msgs_at_clear, msgs_at_converged=int(state.msgs))
     details.update(workload="broadcast", n_nodes=n, n_values=nv,
                    topology=topology, msgs_total=int(state.msgs),
+                   path="structured" if structured else "gather",
                    spec=spec.to_meta())
     return {"ok": ok, **details}
 

@@ -39,7 +39,13 @@ class CounterProgram:
 
     def install(self, node) -> None:
         cfg = self.cfg
-        kv = AsyncKV(node, SEQ_KV, timeout=cfg.kv_op_timeout)
+        # transport retries default 0 (reference parity — a timed-out
+        # flush waits for the next tick); cfg.kv_retries > 0 re-issues
+        # timed-out ops under the node's jittered backoff instead
+        kv = AsyncKV(node, SEQ_KV, timeout=cfg.kv_op_timeout,
+                     retries=cfg.kv_retries,
+                     backoff_base=cfg.kv_backoff_base,
+                     backoff_cap=cfg.kv_backoff_cap)
 
         def handle_read(msg: Message) -> None:
             # reference: HandleRead serves the local cache, add.go:29-31

@@ -85,7 +85,15 @@ class KafkaProgram:
 
     def install(self, node) -> None:
         cfg = self.cfg
-        kv = AsyncKV(node, LIN_KV, timeout=cfg.kv_timeout)
+        # transport retries default 0: the reference already retries
+        # timeouts at the protocol level (set_kv_offset, alloc_offset),
+        # so re-issuing beneath them would double-count attempts;
+        # cfg.kv_transport_retries > 0 adds the jittered-backoff
+        # re-issue for lossy-network runs
+        kv = AsyncKV(node, LIN_KV, timeout=cfg.kv_timeout,
+                     retries=cfg.kv_transport_retries,
+                     backoff_base=cfg.kv_backoff_base,
+                     backoff_cap=cfg.kv_backoff_cap)
 
         # -- offset allocation (reference: getNextOffsetKV,
         #    logmap.go:255-285) --------------------------------------------

@@ -45,7 +45,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import faults
 from .engine import (donate_argnums_for, fori_rounds, shard_map,
-                     stepwise_converge, while_converge)
+                     stepwise_converge, while_converge, windows_fold)
+from .structured import _take_delayed
 
 WORD = 32
 
@@ -124,18 +125,13 @@ def _edge_live(t: jnp.ndarray, row_ids: jnp.ndarray, nbrs: jnp.ndarray,
     single-device; the shard's block under shard_map) — partition groups
     are indexed globally.
     """
-    live = nbr_mask
-    n_windows = parts.starts.shape[0]
-    if n_windows == 0:
-        return live
 
-    def body(w, live):
-        active = (parts.starts[w] <= t) & (t < parts.ends[w])
+    def body(w, active, live):
         g = parts.group[w]                       # (N,) global
         same = g[row_ids][:, None] == g[jnp.clip(nbrs, 0, g.shape[0] - 1)]
         return live & jnp.where(active, same, True)
 
-    return lax.fori_loop(0, n_windows, body, live)
+    return windows_fold(parts.starts, parts.ends, t, body, nbr_mask)
 
 
 def _live_split(t: jnp.ndarray, row_ids: jnp.ndarray, nbrs: jnp.ndarray,
@@ -348,11 +344,23 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
         _popcount(payload).sum(axis=1).astype(jnp.uint32)
         * live_now.sum(axis=1).astype(jnp.uint32), dtype=jnp.uint32)
     if dup is not None:
-        received_full = widen(rec0)
-        pc_all = _popcount(received_full).sum(axis=1).astype(jnp.uint32)
-        src_c = jnp.clip(nbrs, 0, received_full.shape[0] - 1)
+        # 1-hop: a dup edge re-delivers its source's full received set
+        # (charged at its popcount).  Under `delays` the ring stores
+        # payload blocks, not received sets, so a dup edge re-delivers
+        # its IN-FLIGHT message instead — the send-round payload,
+        # charged here at send time; the second delivery of an
+        # identical block is absorbed by dedup with zero state change,
+        # so the dup stream is purely ledger-visible in delay modes.
+        if delays is None:
+            received_full = widen(rec0)
+            pc_src = _popcount(received_full).sum(
+                axis=1).astype(jnp.uint32)
+        else:
+            pc_src = _popcount(payload_full).sum(
+                axis=1).astype(jnp.uint32)
+        src_c = jnp.clip(nbrs, 0, payload_full.shape[0] - 1)
         sent_local = sent_local + jnp.sum(
-            jnp.where(dup, pc_all[src_c], 0), dtype=jnp.uint32)
+            jnp.where(dup, pc_src[src_c], 0), dtype=jnp.uint32)
     sent = reduce_sum(sent_local)
     # reference-accounted server-message ledger (Maelstrom parity):
     # floods charge `broadcast` sends to every TOPOLOGY neighbor minus
@@ -520,6 +528,115 @@ def _round_wm(state: BroadcastState, *, deg: jnp.ndarray, sync_every: int,
                           srv_msgs=srv)
 
 
+def _round_wm_nem(state: BroadcastState, arrs, plan, pstarts, pends, *,
+                  nem, sync_every: int, dup_on: bool,
+                  exchange: Callable, src_pc: Callable,
+                  widen: Callable[[jnp.ndarray], jnp.ndarray] = lambda p: p,
+                  reduce_sum: Callable[[jnp.ndarray], jnp.ndarray]
+                  = lambda s: s,
+                  local_slice: Callable[[jnp.ndarray], jnp.ndarray]
+                  = lambda x: x,
+                  cols_slice: Callable[[jnp.ndarray], jnp.ndarray]
+                  = lambda x: x,
+                  ) -> BroadcastState:
+    """Words-major round under the FULL nemesis — a compiled FaultPlan
+    (crash/restart amnesia, per-direction loss, duplicate delivery)
+    composed with partition windows and, optionally, per-direction-
+    class delays — gather-free, bit-exact with the gather path's
+    :func:`_round` (same received sets and message counts).
+
+    Mirrors the gather round's order of operations: amnesia columns
+    are wiped at crash entry (volatile state dies with the process;
+    the structured twin is a pure elementwise column select), the
+    ``msgs`` ledger charges this round's payload against the live
+    SEND degree (partitions + both endpoints up; loss excluded — a
+    dropped message was still sent), delivery masks each direction's
+    structured term by liveness AND the loss coin at that direction's
+    SEND round, and dup edges re-deliver the source's full received
+    set (1-hop; absorbed by dedup, ledger-visible) or re-deliver the
+    in-flight ring block (under delays: zero state change, charged at
+    send time against the current payload — see :func:`_round`).
+
+    ``arrs`` (faults.WMNemesisArrays), the plan, and the partition
+    window rounds ride as traced operands; ``exchange(take, lv)`` /
+    ``src_pc(d, pc)`` are the bundle's static delivery and
+    count-relocation closures (full-axis or halo — the caller picks);
+    ``cols_slice`` maps full-axis per-column rows to the local block
+    on the all_gather fallback (identity elsewhere).  The srv ledger
+    is always off under a plan (no defined accounting for lost acks),
+    matching the gather path."""
+    t = state.t
+    up_now = faults.wm_up_cols(plan, t, arrs.down_cols)
+    wipe = cols_slice(~up_now & faults.wm_up_cols(plan, t - 1,
+                                                  arrs.down_cols))
+    z = jnp.uint32(0)
+    rec0 = jnp.where(wipe[None, :], z, state.received)
+    fr0 = jnp.where(wipe[None, :], z, state.frontier)
+    is_sync = (t % jnp.int32(sync_every) == 0) & (t > 0)
+    payload = jnp.where(is_sync, rec0, fr0)
+    live_deg = cols_slice(
+        faults.wm_live_rows(plan, t, arrs, pstarts, pends, deg=True)
+        .sum(axis=0, dtype=jnp.int32).astype(jnp.uint32))
+    pc = _popcount(payload).sum(axis=0).astype(jnp.uint32)
+    sent = jnp.sum(pc * live_deg, dtype=jnp.uint32)
+    n_dirs = int(arrs.exists.shape[0])
+
+    def dup_charge(dup_rows, counts):
+        # popcount-at-source per dup edge: `counts` is the (1, rows)
+        # per-node count vector; each direction relocates it to its
+        # contract positions (pure repeat/shift/roll — no gather)
+        out = jnp.uint32(0)
+        for d in range(n_dirs):
+            at_rows = src_pc(d, counts)[0]
+            out = out + jnp.sum(
+                cols_slice(jnp.where(dup_rows[d], at_rows, 0)),
+                dtype=jnp.uint32)
+        return out
+
+    if nem.dir_delays is None:
+        live_del, dup = faults.wm_live_del(plan, t, arrs, pstarts,
+                                           pends, dup_on)
+        payload_full = widen(payload)
+        inbox = local_slice(exchange(lambda d: payload_full, live_del))
+        history = state.history
+        if dup is not None:
+            rec_full = widen(rec0)
+            inbox = inbox | local_slice(
+                exchange(lambda d: rec_full, dup))
+            counts = _popcount(rec_full).sum(axis=0) \
+                .astype(jnp.uint32)[None, :]
+            sent = sent + dup_charge(dup, counts)
+    else:
+        dd = nem.dir_delays
+        ring = state.history.shape[0]
+        history = lax.dynamic_update_index_in_dim(
+            state.history, payload, t % ring, axis=0)
+        vs = sorted(set(dd))
+        # one liveness+coin evaluation and one ring slice per DISTINCT
+        # delay value, shared by all directions with that value
+        coins = {v: faults.wm_live_del(plan, t - (v - 1), arrs,
+                                       pstarts, pends, False)[0]
+                 for v in vs}
+        slices = {v: widen(_take_delayed(history, t, v, ring))
+                  for v in vs}
+        lv_rows = [coins[dd[d]][d] for d in range(n_dirs)]
+        inbox = local_slice(exchange(lambda d: slices[dd[d]], lv_rows))
+        # a message in flight to a node that crashed before delivery
+        # dies with the process (receiver-side mask at delivery time)
+        inbox = jnp.where(cols_slice(up_now)[None, :], inbox, z)
+        if dup_on:
+            _ld, dup_now = faults.wm_live_del(plan, t, arrs, pstarts,
+                                              pends, True)
+            counts = _popcount(widen(payload)).sum(axis=0) \
+                .astype(jnp.uint32)[None, :]
+            sent = sent + dup_charge(dup_now, counts)
+    new = inbox & ~rec0
+    return BroadcastState(received=rec0 | new, frontier=new,
+                          t=t + 1,
+                          msgs=state.msgs + reduce_sum(sent),
+                          history=history, srv_msgs=None)
+
+
 class BroadcastSim:
     """Round-synchronous broadcast simulator over an (optional) device
     mesh.
@@ -562,6 +679,7 @@ class BroadcastSim:
                  delayed=None,
                  edge_delayed=None,
                  fault_plan: "faults.FaultPlan | None" = None,
+                 nemesis=None,
                  ) -> None:
         """``srv_ledger``: keep the reference-accounted server-message
         ledger (default).  It costs a second adjacency pass per round
@@ -600,21 +718,39 @@ class BroadcastSim:
         words-major path — Maelstrom's default latency model
         (random per hop) at structured speed.  The delay rows ride as
         one traced (D, N) array (node-sharded on the halo path).
-        Mutually exclusive with ``delays``/``delayed``/``faulted`` and
-        with partition schedules (compose via the gather path for
-        now); the srv ledger gates exactly like the plain delayed
-        mode (caller-supplied sync_diff closures, current-state
-        approximation).
+        Mutually exclusive with ``delays``/``delayed``/``faulted``;
+        composing with a PARTITION schedule needs the masked bundle
+        (structured.make_edge_delayed_faulted — a FaultedEdgeDelays,
+        which carries its own window masks and masked diffs: the
+        Maelstrom default nemesis, latency AND partitions, at
+        structured speed); the plain bundle's srv ledger gates
+        exactly like the plain delayed mode (caller-supplied
+        sync_diff closures, current-state approximation).
 
         ``fault_plan`` (tpu_sim/faults.py, compiled NemesisSpec): the
         nemesis beyond partitions — crash/restart with amnesia rows,
-        per-direction probabilistic loss, duplicate delivery.  Gather
-        path only (explicitly rejected with the structured exchanges);
-        composes with ``parts`` partition schedules and, dup aside,
-        with per-edge ``delays``.  Forces ``srv_ledger`` off (the
+        per-direction probabilistic loss, duplicate delivery.
+        Composes with ``parts`` partition schedules and per-edge
+        ``delays`` on the gather path; under ``delays`` a dup edge
+        re-delivers its IN-FLIGHT message (the send-round payload
+        block, absorbed by dedup, charged to the msgs ledger at send
+        time) rather than the source's full received set.  On the
+        words-major structured path a plan needs the mask bundle:
+        pass ``nemesis=`` (below).  Forces ``srv_ledger`` off (the
         Maelstrom-parity accounting has no defined semantics for lost
         acks); the ``msgs`` ledger counts loss at send time and dup
-        re-deliveries as real traffic."""
+        re-deliveries as real traffic.
+
+        ``nemesis`` (structured.StructuredNemesis, make_nemesis): the
+        words-major decomposition of the SAME plan — host-precomputed
+        per-direction sender/receiver masks with elementwise loss/dup
+        coins, so the full Maelstrom fault model (crash/loss/dup
+        composed with partition windows and per-direction-class
+        delays, via the bundle's ``dir_delays``) runs gather-free and
+        bit-exact with the gather path.  Requires ``fault_plan`` (the
+        traced operand the masks were compiled from) and a structured
+        ``exchange``; mutually exclusive with ``delays``/``delayed``/
+        ``edge_delayed``/``faulted`` (the bundle subsumes them)."""
         n = nbrs.shape[0]
         self.n_nodes = n
         self.n_values = n_values
@@ -635,17 +771,35 @@ class BroadcastSim:
         n_windows = int(self.parts.starts.shape[0])
         self._delayed = delayed
         self._edge = edge_delayed
+        # composed mode: a FaultedEdgeDelays bundle carries its own
+        # window masks (random per-edge delays AND partitions)
+        self._ef = (edge_delayed is not None
+                    and hasattr(edge_delayed, "del_same"))
         if edge_delayed is not None:
             if not self.words_major:
                 raise ValueError("edge_delayed needs a structured "
                                  "exchange")
             if delays is not None or delayed is not None \
-                    or faulted is not None or n_windows > 0:
+                    or faulted is not None:
                 raise ValueError(
                     "edge_delayed is mutually exclusive with delays/"
-                    "delayed/faulted and partition schedules (compose "
-                    "random per-edge delays with faults via the gather "
-                    "path)")
+                    "delayed/faulted")
+            if self._ef:
+                if n_windows == 0:
+                    raise ValueError(
+                        "FaultedEdgeDelays needs a partition schedule; "
+                        "use make_edge_delayed for the window-free "
+                        "case")
+                if edge_delayed.del_same.shape[0] != n_windows \
+                        or edge_delayed.del_same.shape[-1] != n:
+                    raise ValueError(
+                        "FaultedEdgeDelays masks do not match the "
+                        "partition schedule")
+            elif n_windows > 0:
+                raise ValueError(
+                    "composing random per-edge delays with partitions "
+                    "on the structured path needs a FaultedEdgeDelays "
+                    "bundle (structured.make_edge_delayed_faulted)")
             if mesh is not None and edge_delayed.sharded_exchange \
                     is None:
                 raise ValueError(
@@ -688,7 +842,7 @@ class BroadcastSim:
                                     and n_windows > 0
                                     and not self._df) else None
         if (self.words_major and n_windows > 0 and faulted is None
-                and not self._df):
+                and not self._df and not self._ef and nemesis is None):
             raise ValueError(
                 "a words-major structured run under a partition "
                 "schedule needs the masked closures: pass "
@@ -716,6 +870,14 @@ class BroadcastSim:
                 sync_diff is not None if mesh is None
                 else (self._delayed.sharded_exchange is not None
                       and sharded_sync_diff is not None))
+        elif self._ef:
+            # faulted edge-delayed: the bundle carries its own masked
+            # diffs (same gating as the FaultedDelayed mode)
+            e = self._edge
+            self._srv_on = srv_ledger and (
+                e.sync_diff is not None if mesh is None
+                else (e.sharded_exchange is not None
+                      and e.sharded_sync_diff is not None))
         elif self._edge is not None:
             # edge-delayed: gates exactly like plain delayed
             self._srv_on = srv_ledger and (
@@ -737,30 +899,58 @@ class BroadcastSim:
             self._srv_on = srv_ledger
         # -- nemesis FaultPlan (crash/loss/dup, tpu_sim/faults.py) ------
         self.fault_plan = fault_plan
+        self._nem = nemesis
         self._fp_dup = (fault_plan is not None
                         and int(fault_plan.dup_num) > 0)
-        if fault_plan is not None:
-            if self.words_major:
+        if nemesis is not None:
+            if not self.words_major:
                 raise ValueError(
-                    "a FaultPlan (crash/loss/dup nemesis) runs on the "
-                    "gather path only: the structured words-major "
-                    "exchanges do not compose with amnesia rows — drop "
-                    "exchange=/sharded_exchange= or the plan")
+                    "nemesis= is the words-major structured FaultPlan "
+                    "path — it needs a structured exchange (the gather "
+                    "path takes the plan alone)")
+            if fault_plan is None:
+                raise ValueError(
+                    "nemesis= carries the structured masks FOR a "
+                    "FaultPlan — pass fault_plan=spec.compile() too")
+            if delays is not None or delayed is not None \
+                    or edge_delayed is not None or faulted is not None:
+                raise ValueError(
+                    "nemesis= subsumes delays/delayed/edge_delayed/"
+                    "faulted: compose partition windows via parts= and "
+                    "per-direction delays via make_nemesis(dir_delays=)")
+            if nemesis.arrs.same.shape[0] != n_windows \
+                    or nemesis.arrs.same.shape[-1] != n:
+                raise ValueError(
+                    "StructuredNemesis masks do not match the "
+                    "partition schedule: "
+                    f"same{tuple(nemesis.arrs.same.shape)} vs "
+                    f"{n_windows} windows x {n} nodes")
+            if (nemesis.arrs.down_pair.shape[0]
+                    != int(fault_plan.starts.shape[0])):
+                raise ValueError(
+                    "StructuredNemesis crash masks do not match the "
+                    "FaultPlan's crash windows — rebuild the bundle "
+                    "from the same NemesisSpec")
+        if fault_plan is not None:
+            if self.words_major and nemesis is None:
+                raise ValueError(
+                    "a FaultPlan on the words-major structured path "
+                    "needs the mask bundle: pass "
+                    "nemesis=structured.make_nemesis(topology, n, "
+                    "spec, ...) — or drop exchange=/sharded_exchange= "
+                    "for the gather path")
             if fault_plan.down.shape[1] != n:
                 raise ValueError(
                     f"FaultPlan is for {fault_plan.down.shape[1]} "
                     f"nodes, sim has {n}")
-            if delays is not None and self._fp_dup:
-                raise ValueError(
-                    "duplicate delivery does not compose with per-edge "
-                    "`delays`: the history ring stores payload blocks, "
-                    "not received sets — run dup_rate=0 under delays, "
-                    "or 1-hop edges with the full plan")
             # The Maelstrom-comparable server ledger has no defined
             # accounting for lost acks / duplicate streams; under a
             # plan the value-message ledger (`msgs`, sends counted at
             # send time, dup re-deliveries included) is the
-            # throughput signal.
+            # throughput signal.  (Under per-edge `delays` a dup edge
+            # re-delivers its in-flight payload block — the history
+            # ring stores payload, not received sets — so dup is
+            # state-invisible there and purely ledger-visible.)
             self._srv_on = False
         if delays is not None:
             if exchange is not None:
@@ -771,10 +961,14 @@ class BroadcastSim:
                 raise ValueError("edge delays are rounds >= 1")
         self.delays = (None if delays is None
                        else jnp.asarray(delays, jnp.int32))
+        self._nem_delayed = (nemesis is not None
+                             and nemesis.dir_delays is not None)
         if delayed is not None:
             self.ring = delayed.ring
         elif edge_delayed is not None:
             self.ring = edge_delayed.ring
+        elif self._nem_delayed:
+            self.ring = nemesis.ring
         else:
             self.ring = 1 if delays is None else int(delays.max())
         # distinct delay values, static: delivery runs one masked
@@ -824,6 +1018,36 @@ class BroadcastSim:
                     rows = jax.device_put(
                         rows, NamedSharding(mesh, self._ed_spec))
                 self._ed_rows = rows
+                if self._ef:
+                    # the composed bundle's window masks (ledger rows +
+                    # delivery rows) shard with the node axis too —
+                    # the edge mode is halo-only on a mesh
+                    e2 = jnp.asarray(self._edge.exists)
+                    s2 = jnp.asarray(self._edge.same)
+                    d2 = jnp.asarray(self._edge.del_same)
+                    if mesh is not None:
+                        e_spec = P(None, "nodes")
+                        s_spec = P(None, None, "nodes")
+                        e2 = jax.device_put(
+                            e2, NamedSharding(mesh, e_spec))
+                        s2 = jax.device_put(
+                            s2, NamedSharding(mesh, s_spec))
+                        d2 = jax.device_put(
+                            d2, NamedSharding(mesh, s_spec))
+                        self._ef_specs = (e_spec, s_spec, s_spec)
+                    self._ef_arrs = (e2, s2, d2)
+            if self._nem is not None:
+                arrs = faults.WMNemesisArrays(
+                    *(jnp.asarray(a) for a in self._nem.arrs))
+                if mesh is not None:
+                    # halo: positionally sharded with the node axis;
+                    # all_gather fallback: replicated full-axis masks
+                    self._nem_specs = faults.wm_specs(
+                        self._nem.sharded_exchange is not None)
+                    arrs = faults.WMNemesisArrays(
+                        *(jax.device_put(a, NamedSharding(mesh, s))
+                          for a, s in zip(arrs, self._nem_specs)))
+                self._nem_arrs = arrs
             masked_src = (self._faulted if self._faulted is not None
                           else self._delayed if self._df else None)
             if masked_src is not None:
@@ -874,7 +1098,8 @@ class BroadcastSim:
         # placement so the copy lands with the right sharding.
         frontier = jnp.copy(received)
         history = None
-        if self._delayed is not None or self._edge is not None:
+        if self._delayed is not None or self._edge is not None \
+                or self._nem_delayed:
             # words-major ring of past LOCAL payload blocks (L, W, N),
             # node-sharded like the state
             history = jnp.zeros(
@@ -944,14 +1169,11 @@ class BroadcastSim:
         """Device closure t -> (D, n) combined per-direction liveness:
         exists AND same-group under every active partition window (the
         per-direction-class form of :func:`_edge_live`)."""
-        n_windows = int(starts.shape[0])
 
         def live_rows(t):
-            def body(w, lv):
-                active = (starts[w] <= t) & (t < ends[w])
-                return lv & (same[w] | ~active)
-
-            return lax.fori_loop(0, n_windows, body, exists)
+            return windows_fold(
+                starts, ends, t,
+                lambda w, active, lv: lv & (same[w] | ~active), exists)
 
         return live_rows
 
@@ -984,6 +1206,47 @@ class BroadcastSim:
         else:
             sync_base_once = lambda b: b  # noqa: E731
         f = self._faulted
+        if self._nem is not None:
+            arrs, pstarts, pends, plan = masks
+            psum = lambda s: lax.psum(s, mesh_axes)  # noqa: E731
+            if self._nem.sharded_exchange is not None:
+                # halo path: masks arrive node-sharded, every mask
+                # application is local, delivery is O(block) ppermutes
+                return _round_wm_nem(
+                    state, arrs, plan, pstarts, pends, nem=self._nem,
+                    sync_every=self.sync_every, dup_on=self._fp_dup,
+                    exchange=self._nem.sharded_exchange,
+                    src_pc=self._nem.sharded_src_pc, reduce_sum=psum)
+            # all_gather fallback: replicated full-axis masks, full-
+            # axis delivery per shard, local block sliced back out
+            block = state.received.shape[1]
+            start = lax.axis_index("nodes") * block
+            return _round_wm_nem(
+                state, arrs, plan, pstarts, pends, nem=self._nem,
+                sync_every=self.sync_every, dup_on=self._fp_dup,
+                exchange=self._nem.exchange, src_pc=self._nem.src_pc,
+                reduce_sum=psum,
+                widen=lambda p: lax.all_gather(p, "nodes", axis=1,
+                                               tiled=True),
+                local_slice=lambda x: lax.dynamic_slice_in_dim(
+                    x, start, block, axis=1),
+                cols_slice=lambda x: lax.dynamic_slice_in_dim(
+                    x, start, block))
+        if self._ef:
+            # halo-only (constructor enforces sharded_exchange); all
+            # masks arrive node-sharded, masking is local
+            rows, e2, s2, d2, ps, pe = masks
+            eex = self._edge.sharded_exchange
+            lbd = self._edge.live_by_delay
+            return _round_wm(
+                state, deg=deg, sync_every=self.sync_every,
+                exchange=self.exchange,
+                reduce_sum=lambda s: lax.psum(s, mesh_axes),
+                live_rows=self._live_rows(e2, s2, ps, pe),
+                sync_diff=self._edge.sharded_sync_diff,
+                sync_base_once=sync_base_once,
+                delayed_exchange=lambda h, t: eex(
+                    h, t, rows, lbd(d2, ps, pe, t)))
         if self._edge is not None:
             # halo-only (constructor enforces sharded_exchange); the
             # delay rows arrive node-sharded, masking is local
@@ -1051,7 +1314,8 @@ class BroadcastSim:
         hist_spec = (P(None, *state_spec)       # node-sharded ring
                      if (self.delays is not None
                          or self._delayed is not None
-                         or self._edge is not None) else None)
+                         or self._edge is not None
+                         or self._nem_delayed) else None)
         srv_spec = P() if self._srv_on else None
         return (BroadcastState(state_spec, state_spec, P(), P(),
                                hist_spec, srv_spec),
@@ -1065,6 +1329,23 @@ class BroadcastSim:
         per-node arrays are not baked into every traced program as
         constants."""
         f = self._faulted
+        if self._nem is not None:
+            arrs, pstarts, pends, plan = masks
+            return _round_wm_nem(
+                state, arrs, plan, pstarts, pends, nem=self._nem,
+                sync_every=self.sync_every, dup_on=self._fp_dup,
+                exchange=self._nem.exchange, src_pc=self._nem.src_pc)
+        if self._ef:
+            rows, e2, s2, d2, ps, pe = masks
+            eex = self._edge.exchange
+            lbd = self._edge.live_by_delay
+            return _round_wm(
+                state, deg=deg, sync_every=self.sync_every,
+                exchange=self.exchange,
+                live_rows=self._live_rows(e2, s2, ps, pe),
+                sync_diff=self._edge.sync_diff,
+                delayed_exchange=lambda h, t: eex(
+                    h, t, rows, lbd(d2, ps, pe, t)))
         if self._edge is not None:
             (rows,) = masks
             eex = self._edge.exchange
@@ -1098,8 +1379,16 @@ class BroadcastSim:
 
     def _wm_extra_args(self):
         """The masked words-major modes' extra traced arguments: mask
-        arrays + window rounds (faulted modes) or the delay rows
-        (edge-delayed mode); empty otherwise."""
+        arrays + window rounds (faulted modes), the delay rows (+
+        window masks when composed) in the edge-delayed modes, or the
+        full nemesis operand (mask pytree + window rounds + plan);
+        empty otherwise."""
+        if self._nem is not None:
+            return (self._nem_arrs, self.parts.starts,
+                    self.parts.ends, self.fault_plan)
+        if self._ef:
+            return (self._ed_rows,) + self._ef_arrs \
+                + (self.parts.starts, self.parts.ends)
         if self._edge is not None:
             return (self._ed_rows,)
         if self._faulted is None and not self._df:
@@ -1111,6 +1400,13 @@ class BroadcastSim:
         """Extra (in_specs, args) the sharded words-major programs
         thread through shard_map in masked modes: the mask arrays and
         the window rounds (explicit args, not closure captures)."""
+        if self._nem is not None:
+            return ((self._nem_specs, P(), P(), faults.plan_specs()),
+                    self._wm_extra_args())
+        if self._ef:
+            e_spec, s_spec, d_spec = self._ef_specs
+            return ((self._ed_spec, e_spec, s_spec, d_spec, P(), P()),
+                    self._wm_extra_args())
         if self._edge is not None:
             return ((self._ed_spec,), (self._ed_rows,))
         if self._faulted is None and not self._df:
@@ -1122,8 +1418,9 @@ class BroadcastSim:
         """Extra (in_specs, args) the sharded GATHER-path programs
         thread through shard_map when a FaultPlan is active: the plan
         rides as one replicated traced operand (never donated — the
-        state pytree alone is)."""
-        if self.fault_plan is None:
+        state pytree alone is).  Words-major nemesis runs thread the
+        plan inside :meth:`_wm_extra_args` instead."""
+        if self.fault_plan is None or self.words_major:
             return (), ()
         return ((faults.plan_specs(),), (self.fault_plan,))
 

@@ -237,6 +237,23 @@ def fori_rounds(round_fn: Callable, state, rounds, unroll: int = 1,
     return lax.fori_loop(0, rounds, body, state, **kw)
 
 
+def windows_fold(starts, ends, t, body, init):
+    """Fold a windows-as-data fault schedule at round ``t``: for every
+    window ``w``, ``carry = body(w, active_w, carry)`` with ``active_w
+    = starts[w] <= t < ends[w]`` — the ONE evaluation shape behind
+    every compiled fault mode (partition schedules, crash windows, KV
+    reachability): the schedule rides as tiny traced arrays and the
+    round re-derives the active set from ``t``, so one program replays
+    any schedule.  Zero windows costs nothing (returns ``init``)."""
+    n_windows = starts.shape[0]
+    if n_windows == 0:
+        return init
+    return lax.fori_loop(
+        0, n_windows,
+        lambda w, c: body(w, (starts[w] <= t) & (t < ends[w]), c),
+        init)
+
+
 def scan_rounds(round_fn: Callable, state, xs):
     """R pre-staged rounds as one ``lax.scan``: ``round_fn(state, x) ->
     state`` over the leading axis of the ``xs`` pytree."""

@@ -43,6 +43,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .engine import windows_fold
+
 # distinct stream salts: loss and dup draw independent coins from the
 # same (seed, t, src, dst) counter
 _SALT_LOSS = 0x9E3779B9
@@ -245,16 +247,10 @@ def node_up(plan: FaultPlan, t, ids: jnp.ndarray) -> jnp.ndarray:
     """bool, shaped like ``ids`` — which of the (GLOBAL) node ids are
     up at round ``t``.  Same windows-as-data evaluation as the
     partition masks (broadcast._edge_live, counter._reach)."""
-    n_windows = plan.starts.shape[0]
-    up = jnp.ones(ids.shape, bool)
-    if n_windows == 0:
-        return up
-
-    def body(w, up):
-        active = (plan.starts[w] <= t) & (t < plan.ends[w])
-        return up & ~(active & plan.down[w][ids])
-
-    return lax.fori_loop(0, n_windows, body, up)
+    return windows_fold(
+        plan.starts, plan.ends, t,
+        lambda w, active, up: up & ~(active & plan.down[w][ids]),
+        jnp.ones(ids.shape, bool))
 
 
 def amnesia(plan: FaultPlan, t, ids: jnp.ndarray) -> jnp.ndarray:
@@ -311,6 +307,118 @@ def kv_drop(plan: FaultPlan, t, ids) -> jnp.ndarray:
     round (transient service unreachability: the node retries next
     round, exactly like a reachability window that lasts one round)."""
     return edge_drop(plan, t, ids, KV_DST)
+
+
+# -- words-major (structured-path) mask compilation ----------------------
+#
+# The gather path evaluates crash liveness and the loss/dup coins per
+# adjacency slot — a random gather per round, which the structured
+# words-major exchanges exist to avoid.  The same decomposition that
+# made partition windows gather-free (structured.fault_masks) applies
+# to the whole plan: every structured delivery is a sum of per-
+# DIRECTION terms with a host-known sender map, so
+#
+# - crash liveness becomes a host-precomputed (C, D, N) "either
+#   endpoint down" mask per crash window (``down_pair``), AND-folded at
+#   round t exactly like the partition ``same`` masks;
+# - the loss/dup coins become ELEMENTWISE hashes over host-precomputed
+#   (D, N) sender/receiver id arrays (the stateless counter-based
+#   stream needs only (t, src, dst) — no adjacency read);
+# - amnesia rows and receiver liveness become a (C, N) per-column
+#   ``down`` array, evaluated with zero indexing (``wm_up_cols``).
+#
+# structured.make_nemesis assembles the :class:`WMNemesisArrays`
+# operand from these pieces plus its direction-row contracts; the
+# broadcast words-major round threads it as ONE traced pytree
+# (positionally sharded with the node axis on the halo path), so the
+# full Maelstrom fault model runs at structured speed.
+
+
+class WMNemesisArrays(NamedTuple):
+    """The traced words-major nemesis operand (see above).  Delivery-
+    contract rows (``exists``/``same``/``down_pair``/``src``/``dst``)
+    follow structured.nemesis_dir_pairs; degree-contract rows
+    (``deg_*``) follow structured.fault_dir_senders and drive the
+    message ledgers.  All leaves are host-precomputed and ride as
+    traced arrays — never baked into the program."""
+
+    exists: jnp.ndarray         # (D, N) bool — delivery edges
+    same: jnp.ndarray           # (P, D, N) bool — partition same-group
+    down_pair: jnp.ndarray      # (C, D, N) bool — src or dst down
+    src: jnp.ndarray            # (D, N) uint32 — sender ids (coins)
+    dst: jnp.ndarray            # (D, N) uint32 — receiver ids (coins)
+    deg_exists: jnp.ndarray     # (Dg, N) bool — ledger edges
+    deg_same: jnp.ndarray       # (P, Dg, N) bool
+    deg_down_pair: jnp.ndarray  # (C, Dg, N) bool
+    down_cols: jnp.ndarray      # (C, N) bool — amnesia / receiver-up
+
+
+def wm_specs(sharded: bool) -> WMNemesisArrays:
+    """shard_map in_specs for a :class:`WMNemesisArrays` operand: every
+    row positionally sharded with the node axis on the halo path (all
+    masking is receiver-column-local, zero extra ICI), replicated on
+    the all_gather fallback (the full-axis masked exchange needs
+    full-axis masks)."""
+    r2 = P(None, "nodes") if sharded else P(None, None)
+    r3 = P(None, None, "nodes") if sharded else P(None, None, None)
+    return WMNemesisArrays(r2, r3, r3, r2, r2, r2, r3, r3, r2)
+
+
+def crash_down_rows(spec: "NemesisSpec", ids) -> np.ndarray:
+    """(C, *ids.shape) bool — which of the (possibly -1-padded) global
+    ``ids`` are down in each of the spec's crash windows.  Host
+    compilation for the words-major masks: pad slots read False."""
+    ids = np.asarray(ids)
+    out = np.zeros((len(spec.crash),) + ids.shape, bool)
+    for c, (_s, _e, nodes) in enumerate(spec.crash):
+        d = np.zeros(spec.n_nodes, bool)
+        d[list(nodes)] = True
+        out[c] = d[np.clip(ids, 0, spec.n_nodes - 1)] & (ids >= 0)
+    return out
+
+
+def wm_up_cols(plan: FaultPlan, t, down_cols: jnp.ndarray) -> jnp.ndarray:
+    """(n_cols,) bool — per-COLUMN liveness at round ``t`` from the
+    positionally-(sharded-)precomputed ``down_cols`` rows: the
+    words-major twin of :func:`node_up`, with no index/gather at all."""
+    return windows_fold(
+        plan.starts, plan.ends, t,
+        lambda c, active, up: up & ~(active & down_cols[c]),
+        jnp.ones(down_cols.shape[1:], bool))
+
+
+def wm_live_rows(plan: FaultPlan, t, arrs: WMNemesisArrays,
+                 pstarts, pends, *, deg: bool = False) -> jnp.ndarray:
+    """(D, n_cols) bool — per-direction-row SEND liveness at round
+    ``t``: exists AND same-group under every active partition window
+    AND both endpoints up under every active crash window.  ``deg``
+    selects the degree-contract rows (the ledger side; the delivery
+    rows additionally lose the loss coins via :func:`wm_live_del`)."""
+    exists = arrs.deg_exists if deg else arrs.exists
+    same = arrs.deg_same if deg else arrs.same
+    down_pair = arrs.deg_down_pair if deg else arrs.down_pair
+    lv = windows_fold(pstarts, pends, t,
+                      lambda w, active, lv: lv & (same[w] | ~active),
+                      exists)
+    return windows_fold(plan.starts, plan.ends, t,
+                        lambda c, active, lv:
+                        lv & ~(active & down_pair[c]),
+                        lv)
+
+
+def wm_live_del(plan: FaultPlan, t, arrs: WMNemesisArrays,
+                pstarts, pends, dup_on: bool):
+    """(live_del, dup | None) — the delivery-contract masks at send
+    round ``t`` under the FULL nemesis: send liveness minus the
+    per-direction loss coins, plus the duplicate-delivery coins.  The
+    coins are elementwise over the precomputed (D, N) id arrays —
+    bit-identical to the gather path's per-slot streams (same (t, src,
+    dst) triples hash to the same coin)."""
+    live = wm_live_rows(plan, t, arrs, pstarts, pends)
+    live_del = live & ~edge_drop(plan, t, arrs.src, arrs.dst)
+    dup = (live_del & edge_dup(plan, t, arrs.src, arrs.dst)
+           if dup_on else None)
+    return live_del, dup
 
 
 # -- host mirrors (for op staging and ack accounting) --------------------

@@ -40,7 +40,9 @@ import numpy as np
 from jax import lax
 
 from ..parallel.topology import grid_cols
-from .engine import sharded_roll, sharded_shift  # noqa: F401 — the
+from . import faults
+from .engine import (sharded_roll, sharded_shift,  # noqa: F401 — the
+                     windows_fold)
 #   halo primitives are engine-owned now (engine.py module docstring);
 #   re-exported here because every structured exchange builds on them
 #   and external callers import them from this module.
@@ -1271,6 +1273,16 @@ def make_delayed_faulted(topology: str, n: int, dir_delays,
 # exactly like the fault mask).
 
 
+def _ed_mask(rows, wl, d: int, v: int):
+    """The (direction, delay-class) receiver mask of the edge-delayed
+    delivery: this direction's edges with delay ``v`` — AND, when a
+    window-liveness dict ``wl`` rides along (make_edge_delayed_faulted),
+    the partition liveness of direction ``d`` at delay class ``v``'s
+    SEND round (drops happen at send time, like every other mode)."""
+    m = rows[d] == v
+    return m if wl is None else m & wl[v][d]
+
+
 class EdgeDelays(NamedTuple):
     """Per-edge-random delayed structured delivery (from
     :func:`make_edge_delayed`).
@@ -1330,21 +1342,21 @@ def make_edge_delayed(topology: str, n: int, delay_rows,
             raise ValueError("tree takes (2, N) delay rows "
                              "(down, up — both at child positions)")
 
-        def ex(hist, t, rows):
+        def ex(hist, t, rows, wl=None):
             out = None
             for v in delay_set:
                 pv = take(hist, t, v)
                 if present[(0, v)]:
                     out = acc(out, _mask_cols(tree_from_parent(pv, k),
-                                              rows[0] == v))
+                                              _ed_mask(rows, wl, 0, v)))
                 if present[(1, v)]:
                     out = acc(out, tree_from_kids(
-                        _mask_cols(pv, rows[1] == v), k))
+                        _mask_cols(pv, _ed_mask(rows, wl, 1, v)), k))
             return out
 
         sex = None
         if halo:
-            def sex(hist, t, rows):
+            def sex(hist, t, rows, wl=None):
                 out = None
                 for v in delay_set:
                     pv = take(hist, t, v)
@@ -1352,11 +1364,11 @@ def make_edge_delayed(topology: str, n: int, delay_rows,
                         out = acc(out, _mask_cols(
                             tree_parent_payload(pv, n, n_shards, k,
                                                 axis_name),
-                            rows[0] == v))
+                            _ed_mask(rows, wl, 0, v)))
                     if present[(1, v)]:
                         out = acc(out, tree_kids_payload(
-                            _mask_cols(pv, rows[1] == v), n, n_shards,
-                            k, axis_name))
+                            _mask_cols(pv, _ed_mask(rows, wl, 1, v)),
+                            n, n_shards, k, axis_name))
                 return out
 
         return EdgeDelays(dr, delay_set, ring, ex, sex)
@@ -1367,23 +1379,24 @@ def make_edge_delayed(topology: str, n: int, delay_rows,
             raise ValueError("circulant takes (2*len(strides), N) "
                              "delay rows")
 
-        def ex(hist, t, rows):
+        def ex(hist, t, rows, wl=None):
             out = None
             for v in delay_set:
                 pv = take(hist, t, v)
                 for i, s in enumerate(strides):
                     if present[(2 * i, v)]:
                         out = acc(out, _mask_cols(
-                            jnp.roll(pv, s, axis=1), rows[2 * i] == v))
+                            jnp.roll(pv, s, axis=1),
+                            _ed_mask(rows, wl, 2 * i, v)))
                     if present[(2 * i + 1, v)]:
                         out = acc(out, _mask_cols(
                             jnp.roll(pv, -s, axis=1),
-                            rows[2 * i + 1] == v))
+                            _ed_mask(rows, wl, 2 * i + 1, v)))
             return out
 
         sex = None
         if n_shards is not None and n % n_shards == 0:
-            def sex(hist, t, rows):
+            def sex(hist, t, rows, wl=None):
                 out = None
                 for v in delay_set:
                     pv = take(hist, t, v)
@@ -1392,12 +1405,12 @@ def make_edge_delayed(topology: str, n: int, delay_rows,
                             out = acc(out, _mask_cols(
                                 sharded_roll(pv, s, n, n_shards,
                                              axis_name),
-                                rows[2 * i] == v))
+                                _ed_mask(rows, wl, 2 * i, v)))
                         if present[(2 * i + 1, v)]:
                             out = acc(out, _mask_cols(
                                 sharded_roll(pv, -s, n, n_shards,
                                              axis_name),
-                                rows[2 * i + 1] == v))
+                                _ed_mask(rows, wl, 2 * i + 1, v)))
                 return out
 
         return EdgeDelays(dr, delay_set, ring, ex, sex)
@@ -1408,7 +1421,7 @@ def make_edge_delayed(topology: str, n: int, delay_rows,
             raise ValueError("grid takes (4, N) delay rows "
                              "(up, down, left, right)")
 
-        def ex(hist, t, rows):
+        def ex(hist, t, rows, wl=None):
             out = None
             for v in delay_set:
                 pv = take(hist, t, v)
@@ -1419,13 +1432,13 @@ def make_edge_delayed(topology: str, n: int, delay_rows,
                          grid_terms(z, z, z, pv, cols))
                 for d in range(4):
                     if present[(d, v)]:
-                        out = acc(out, _mask_cols(terms[d],
-                                                  rows[d] == v))
+                        out = acc(out, _mask_cols(
+                            terms[d], _ed_mask(rows, wl, d, v)))
             return out
 
         sex = None
         if halo:
-            def sex(hist, t, rows):
+            def sex(hist, t, rows, wl=None):
                 block = hist.shape[2]
                 start = jax.lax.axis_index(axis_name) * block
                 col_idx = (start
@@ -1438,21 +1451,25 @@ def make_edge_delayed(topology: str, n: int, delay_rows,
                     if present[(0, v)]:
                         out = acc(out, _mask_cols(
                             sharded_shift(pv, cols, n_shards,
-                                          axis_name), rows[0] == v))
+                                          axis_name),
+                            _ed_mask(rows, wl, 0, v)))
                     if present[(1, v)]:
                         out = acc(out, _mask_cols(
                             sharded_shift(pv, -cols, n_shards,
-                                          axis_name), rows[1] == v))
+                                          axis_name),
+                            _ed_mask(rows, wl, 1, v)))
                     if present[(2, v)]:
                         lf = jnp.where(
                             lm, sharded_shift(pv, 1, n_shards,
                                               axis_name), 0)
-                        out = acc(out, _mask_cols(lf, rows[2] == v))
+                        out = acc(out, _mask_cols(
+                            lf, _ed_mask(rows, wl, 2, v)))
                     if present[(3, v)]:
                         rt = jnp.where(
                             rm, sharded_shift(pv, -1, n_shards,
                                               axis_name), 0)
-                        out = acc(out, _mask_cols(rt, rows[3] == v))
+                        out = acc(out, _mask_cols(
+                            rt, _ed_mask(rows, wl, 3, v)))
                 return out
 
         return EdgeDelays(dr, delay_set, ring, ex, sex)
@@ -1461,33 +1478,33 @@ def make_edge_delayed(topology: str, n: int, delay_rows,
         if dr.shape != (2, n):
             raise ValueError("line takes (2, N) delay rows (fwd, bwd)")
 
-        def ex(hist, t, rows):
+        def ex(hist, t, rows, wl=None):
             out = None
             for v in delay_set:
                 pv = take(hist, t, v)
                 z = _zeros(pv, pv.shape[1])
                 if present[(0, v)]:
                     out = acc(out, _mask_cols(line_terms(pv, z),
-                                              rows[0] == v))
+                                              _ed_mask(rows, wl, 0, v)))
                 if present[(1, v)]:
                     out = acc(out, _mask_cols(line_terms(z, pv),
-                                              rows[1] == v))
+                                              _ed_mask(rows, wl, 1, v)))
             return out
 
         sex = None
         if halo:
-            def sex(hist, t, rows):
+            def sex(hist, t, rows, wl=None):
                 out = None
                 for v in delay_set:
                     pv = take(hist, t, v)
                     if present[(0, v)]:
                         out = acc(out, _mask_cols(
                             sharded_shift(pv, 1, n_shards, axis_name),
-                            rows[0] == v))
+                            _ed_mask(rows, wl, 0, v)))
                     if present[(1, v)]:
                         out = acc(out, _mask_cols(
                             sharded_shift(pv, -1, n_shards, axis_name),
-                            rows[1] == v))
+                            _ed_mask(rows, wl, 1, v)))
                 return out
 
         return EdgeDelays(dr, delay_set, ring, ex, sex)
@@ -1538,3 +1555,395 @@ def gather_delays_from_rows(topology: str, n: int, delay_rows, nbrs,
         out = np.where(mask, want, out)
         assigned |= mask
     return out
+
+
+class FaultedEdgeDelays(NamedTuple):
+    """Random per-edge delays COMPOSED with partition windows on the
+    structured path (from :func:`make_edge_delayed_faulted`) — closing
+    Maelstrom's default nemesis configuration (random per-hop latency
+    AND partitions together, reference README.md:16,18) gather-free.
+
+    Delivery follows the :class:`EdgeDelays` row contract; each
+    (direction, delay-class) term is additionally masked by the
+    partition liveness of that direction at ITS send round
+    (``live_by_delay`` evaluates one liveness per distinct delay value,
+    shared by all directions with that value — drops happen at send
+    time, exactly like make_delayed_faulted's delay classes).
+
+    ``exists``/``same`` follow the fault direction-row contract
+    (ledger live degree + the masked srv diffs); ``del_same`` is the
+    (P, D_rows, N) DELIVERY-row twin (differs only for the tree, whose
+    two child-position rows both read the parent edge's window)."""
+
+    delay_rows: np.ndarray
+    delay_set: tuple
+    ring: int
+    exists: np.ndarray
+    same: np.ndarray
+    del_same: np.ndarray
+    exchange: Callable            # (hist, t, rows, wl) -> inbox
+    sharded_exchange: Callable | None
+    live_by_delay: Callable       # (del_same, pstarts, pends, t) -> wl
+    sync_diff: Callable | None = None
+    sharded_sync_diff: Callable | None = None
+
+
+def make_edge_delayed_faulted(topology: str, n: int, delay_rows,
+                              groups: np.ndarray,
+                              n_shards: int | None = None,
+                              axis_name: str = "nodes",
+                              **kw) -> FaultedEdgeDelays | None:
+    """Compose random per-edge delays with a partition schedule,
+    gather-free.  ``delay_rows``/aliasing follow
+    :func:`make_edge_delayed` (whose delivery bodies are shared);
+    window masks follow :func:`fault_masks`.  None for unstructured
+    topologies."""
+    ed = make_edge_delayed(topology, n, delay_rows, n_shards,
+                           axis_name=axis_name, **kw)
+    if ed is None:
+        return None
+    masks = fault_masks(topology, n, groups, **kw)
+    exists, same = masks
+    if topology == "tree":
+        # both delivery rows are the parent edge at child positions
+        del_same = np.concatenate([same[:, :1], same[:, :1]], axis=1)
+    else:
+        del_same = same
+    halo = has_sharded_exchange(topology, n, n_shards,
+                                axis_name=axis_name, **kw)
+    df, sdf = _masked_diffs(topology, n, n_shards,
+                            axis_name=axis_name, halo=halo, **kw)
+    delay_set = ed.delay_set
+
+    def live_by_delay(dsame, pstarts, pends, t):
+        # one window-liveness evaluation per DISTINCT delay value at
+        # that value's send round, shared by all directions
+        out = {}
+        ones = jnp.ones(dsame.shape[1:], bool)
+        for v in delay_set:
+            tt = t - (v - 1)
+            out[v] = windows_fold(
+                pstarts, pends, tt,
+                lambda w, active, lv: lv & (dsame[w] | ~active), ones)
+        return out
+
+    return FaultedEdgeDelays(ed.delay_rows, delay_set, ed.ring,
+                             exists, same, del_same,
+                             ed.exchange, ed.sharded_exchange,
+                             live_by_delay, df, sdf)
+
+
+# -- the FULL nemesis (crash/loss/dup FaultPlan) on the structured path -
+#
+# PR 2's FaultPlan ran gather-path only: crash liveness and the
+# loss/dup coins were evaluated per adjacency slot, a random gather per
+# round (~60-190x slower than the words-major exchanges at 1M nodes).
+# The partition decomposition (make_faulted) extends to the whole
+# Maelstrom fault model:
+#
+# - **amnesia at crash entry** is per-COLUMN: a (C, N) down array
+#   evaluated elementwise at round t (faults.wm_up_cols) wipes the
+#   crashing columns of the (W, N) state — no index, no gather.
+# - **crash liveness per edge** decomposes per direction row into a
+#   host-precomputed (C, D, N) "either endpoint down" mask, AND-folded
+#   at round t exactly like the partition ``same`` masks.
+# - **loss/dup coins** are stateless hashes of (t, src, dst): with
+#   host-precomputed (D, N) sender/receiver id rows they evaluate
+#   ELEMENTWISE per direction — bit-identical to the gather path's
+#   per-slot streams (same triples, same coins), zero random access.
+# - **duplicate delivery** re-delivers the source's full received set:
+#   per direction that is the same structured term applied to
+#   ``received`` under the dup coin mask; its ledger charge
+#   (popcount-at-source per dup edge) rides the same per-direction
+#   relocation applied to the (1, N) popcount vector (``src_pc``).
+#
+# Delivery direction-row contract (nemesis_dir_pairs) — loss is per
+# DIRECTION (the two directions of a link drop independently), so the
+# tree cannot reuse the symmetric one-mask contract of fault_masks:
+#
+# - tree(k): TWO rows, both indexed at CHILD positions (the EdgeDelays
+#   row contract): row 0 = the parent->child edge (src = parent(i),
+#   dst = i), masking the from_parent delivery; row 1 = the
+#   child->parent edge (src = i, dst = parent(i)), masking the kids
+#   payload PRE-fold.
+# - grid / ring / line / circulant: the fault_dir_senders rows
+#   (receiver-side, dst = i).
+#
+# The message ledger still needs the per-node live UNDIRECTED degree,
+# which the 2-row tree contract cannot give per node — the DEGREE
+# contract (fault_dir_senders, 1+k receiver-side rows for the tree)
+# rides along for the ledgers, evaluated elementwise from its own
+# host-precomputed masks (faults.WMNemesisArrays.deg_*).
+
+
+def nemesis_dir_pairs(topology: str, n: int, **kw):
+    """(src, dst, exists), each (D, N) — the nemesis DELIVERY
+    direction-row contract (see above).  ``src``/``dst`` are global
+    node ids with -1 at pad positions; None for unstructured
+    topologies."""
+    idx = np.arange(n, dtype=np.int64)
+    if topology == "tree":
+        k = kw.get("branching", 4)
+        parent = np.where(idx >= 1, (idx - 1) // k, -1)
+        child = np.where(idx >= 1, idx, -1)
+        src = np.stack([parent, child])
+        dst = np.stack([child, parent])
+        return src, dst, src >= 0
+    snd = fault_dir_senders(topology, n, **kw)
+    if snd is None:
+        return None
+    dst = np.where(snd >= 0, idx[None, :], -1)
+    return snd, dst, snd >= 0
+
+
+def _same_groups(groups: np.ndarray, src: np.ndarray,
+                 dst: np.ndarray, n: int) -> np.ndarray:
+    """(P, D, N) bool — per partition window, are the edge's endpoints
+    in the same group (pad positions read True; exists masks them)."""
+    g = np.asarray(groups)
+    if g.shape[0] == 0:
+        return np.zeros((0,) + src.shape, bool)
+    sg = g[:, np.clip(src, 0, n - 1)]
+    dg = g[:, np.clip(dst, 0, n - 1)]
+    return sg == dg
+
+
+def _nem_closures(topology: str, n: int, n_shards: int | None,
+                  axis_name: str, halo: bool, **kw):
+    """The nemesis delivery closures: ``(ex, spc, sex, sspc)`` where
+    ``ex(take, lv)`` ORs direction d's structured term of ``take(d)``
+    masked by ``lv[d]`` (tree row 1 masks the payload PRE-fold), and
+    ``spc(d, pc)`` relocates a (1, rows) per-node count vector to
+    direction d's contract positions (the dup ledger's
+    popcount-at-source — every relocation is a pure repeat/shift/roll,
+    so counts survive where OR-folds would not).  ``sex``/``sspc`` are
+    the halo-path twins over local blocks (None without a halo
+    decomposition)."""
+    if topology == "tree":
+        k = kw.get("branching", 4)
+
+        def ex(take, lv):
+            fp = _mask_cols(tree_from_parent(take(0), k), lv[0])
+            fk = tree_from_kids(_mask_cols(take(1), lv[1]), k)
+            return fp | fk
+
+        def spc(d, pc):
+            return tree_from_parent(pc, k) if d == 0 else pc
+
+        sex = sspc = None
+        if halo:
+            def sex(take, lv):
+                fp = _mask_cols(
+                    tree_parent_payload(take(0), n, n_shards, k,
+                                        axis_name), lv[0])
+                fk = tree_kids_payload(_mask_cols(take(1), lv[1]), n,
+                                       n_shards, k, axis_name)
+                return fp | fk
+
+            def sspc(d, pc):
+                return (tree_parent_payload(pc, n, n_shards, k,
+                                            axis_name)
+                        if d == 0 else pc)
+
+        return ex, spc, sex, sspc
+
+    if topology in ("ring", "circulant"):
+        strides = [1] if topology == "ring" else list(kw["strides"])
+
+        def ex(take, lv):
+            out = None
+            for i, s in enumerate(strides):
+                term = (_mask_cols(jnp.roll(take(2 * i), s, axis=1),
+                                   lv[2 * i])
+                        | _mask_cols(jnp.roll(take(2 * i + 1), -s,
+                                              axis=1), lv[2 * i + 1]))
+                out = term if out is None else out | term
+            return out
+
+        def spc(d, pc):
+            i, back = divmod(d, 2)
+            return jnp.roll(pc, -strides[i] if back else strides[i],
+                            axis=1)
+
+        sex = sspc = None
+        if halo:
+            def sex(take, lv):
+                out = None
+                for i, s in enumerate(strides):
+                    term = (_mask_cols(
+                        sharded_roll(take(2 * i), s, n, n_shards,
+                                     axis_name), lv[2 * i])
+                        | _mask_cols(
+                            sharded_roll(take(2 * i + 1), -s, n,
+                                         n_shards, axis_name),
+                            lv[2 * i + 1]))
+                    out = term if out is None else out | term
+                return out
+
+            def sspc(d, pc):
+                i, back = divmod(d, 2)
+                return sharded_roll(pc, -strides[i] if back
+                                    else strides[i], n, n_shards,
+                                    axis_name)
+
+        return ex, spc, sex, sspc
+
+    if topology == "grid":
+        cols = kw.get("cols") or grid_cols(n)
+
+        def one_dir(d, p):
+            z = _zeros(p, p.shape[1])
+            args = [z, z, z, z]
+            args[d] = p
+            return grid_terms(*args, cols)
+
+        def ex(take, lv):
+            out = None
+            for d in range(4):
+                term = _mask_cols(one_dir(d, take(d)), lv[d])
+                out = term if out is None else out | term
+            return out
+
+        def spc(d, pc):
+            return one_dir(d, pc)
+
+        sex = sspc = None
+        if halo:
+            def sharded_dir(d, p):
+                if d == 0:
+                    return sharded_shift(p, cols, n_shards, axis_name)
+                if d == 1:
+                    return sharded_shift(p, -cols, n_shards, axis_name)
+                block = p.shape[1]
+                start = jax.lax.axis_index(axis_name) * block
+                col_idx = (start
+                           + jnp.arange(block, dtype=jnp.int32)) % cols
+                if d == 2:
+                    t = sharded_shift(p, 1, n_shards, axis_name)
+                    return jnp.where((col_idx < cols - 1)[None, :], t, 0)
+                t = sharded_shift(p, -1, n_shards, axis_name)
+                return jnp.where((col_idx > 0)[None, :], t, 0)
+
+            def sex(take, lv):
+                out = None
+                for d in range(4):
+                    term = _mask_cols(sharded_dir(d, take(d)), lv[d])
+                    out = term if out is None else out | term
+                return out
+
+            def sspc(d, pc):
+                return sharded_dir(d, pc)
+
+        return ex, spc, sex, sspc
+
+    if topology == "line":
+        def one_line(d, p):
+            z = _zeros(p, p.shape[1])
+            return line_terms(p, z) if d == 0 else line_terms(z, p)
+
+        def ex(take, lv):
+            return (_mask_cols(one_line(0, take(0)), lv[0])
+                    | _mask_cols(one_line(1, take(1)), lv[1]))
+
+        def spc(d, pc):
+            return one_line(d, pc)
+
+        sex = sspc = None
+        if halo:
+            def sharded_line(d, p):
+                return sharded_shift(p, 1 if d == 0 else -1, n_shards,
+                                     axis_name)
+
+            def sex(take, lv):
+                return (_mask_cols(sharded_line(0, take(0)), lv[0])
+                        | _mask_cols(sharded_line(1, take(1)), lv[1]))
+
+            def sspc(d, pc):
+                return sharded_line(d, pc)
+
+        return ex, spc, sex, sspc
+
+    return None
+
+
+class StructuredNemesis(NamedTuple):
+    """Everything a words-major BroadcastSim needs to run a compiled
+    :class:`~.faults.FaultPlan` (crash/restart amnesia, loss, dup)
+    gather-free, optionally composed with partition windows and
+    per-direction-class delays (built by :func:`make_nemesis`).
+
+    - ``arrs``: the traced mask operand (faults.WMNemesisArrays) —
+      threaded through the drivers next to the plan, positionally
+      sharded with the node axis on the halo path.
+    - ``dir_delays``/``ring``: per-direction-class delays composed in
+      (None → every edge is 1 hop); the delay contract and aliasing
+      caveat of :func:`make_delayed` apply.
+    - ``exchange(take, lv)`` / ``src_pc(d, pc)``: full-axis delivery
+      and count-relocation closures (see :func:`_nem_closures`);
+      ``sharded_*`` are the halo twins (None → all_gather fallback)."""
+
+    arrs: "faults.WMNemesisArrays"
+    dir_delays: tuple | None
+    ring: int
+    exchange: Callable
+    src_pc: Callable
+    sharded_exchange: Callable | None
+    sharded_src_pc: Callable | None
+
+
+def make_nemesis(topology: str, n: int, spec: "faults.NemesisSpec",
+                 groups: np.ndarray | None = None,
+                 dir_delays=None, n_shards: int | None = None,
+                 axis_name: str = "nodes",
+                 **kw) -> StructuredNemesis | None:
+    """Build the :class:`StructuredNemesis` bundle: the words-major
+    mask decomposition of ``spec`` (a host NemesisSpec — the crash
+    windows must be host data to precompute the per-direction masks),
+    composed with an optional partition schedule (``groups``: the
+    (P, N) per-window group ids of broadcast.Partitions) and optional
+    per-direction-class ``dir_delays``.  Pass the bundle to
+    BroadcastSim(nemesis=..., fault_plan=spec.compile()).  None for
+    unstructured topologies; the sharded closures are None when the
+    halo gates fail (the sim then uses the all_gather fallback)."""
+    if spec.n_nodes != n:
+        raise ValueError(f"spec is for {spec.n_nodes} nodes, "
+                         f"topology has {n}")
+    pairs = nemesis_dir_pairs(topology, n, **kw)
+    if pairs is None:
+        return None
+    src, dst, exists = pairs
+    idx = np.arange(n, dtype=np.int64)
+    deg_src = fault_dir_senders(topology, n, **kw)
+    deg_dst = np.where(deg_src >= 0, idx[None, :], -1)
+    g = (np.zeros((0, n), np.int8) if groups is None
+         else np.asarray(groups))
+    down_pair = (faults.crash_down_rows(spec, src)
+                 | faults.crash_down_rows(spec, dst))
+    deg_down_pair = (faults.crash_down_rows(spec, deg_src)
+                     | faults.crash_down_rows(spec, deg_dst))
+    arrs = faults.WMNemesisArrays(
+        exists=jnp.asarray(exists),
+        same=jnp.asarray(_same_groups(g, src, dst, n)),
+        down_pair=jnp.asarray(down_pair),
+        src=jnp.asarray(np.clip(src, 0, n - 1).astype(np.uint32)),
+        dst=jnp.asarray(np.clip(dst, 0, n - 1).astype(np.uint32)),
+        deg_exists=jnp.asarray(deg_src >= 0),
+        deg_same=jnp.asarray(_same_groups(g, deg_src, deg_dst, n)),
+        deg_down_pair=jnp.asarray(deg_down_pair),
+        down_cols=jnp.asarray(faults.crash_down_rows(spec, idx)))
+    if dir_delays is not None:
+        dd = tuple(int(x) for x in dir_delays)
+        if len(dd) != src.shape[0]:
+            raise ValueError(
+                f"{topology} takes {src.shape[0]} direction delays, "
+                f"got {len(dd)}")
+        if any(d < 1 for d in dd):
+            raise ValueError("direction delays are rounds >= 1")
+        ring = max(dd)
+    else:
+        dd, ring = None, 1
+    halo = has_sharded_exchange(topology, n, n_shards,
+                                axis_name=axis_name, **kw)
+    ex, spc, sex, sspc = _nem_closures(topology, n, n_shards,
+                                       axis_name, halo, **kw)
+    return StructuredNemesis(arrs, dd, ring, ex, spc, sex, sspc)

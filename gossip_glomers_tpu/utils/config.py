@@ -39,6 +39,16 @@ class CounterConfig:
     kv_op_timeout: float = 1.0       # updateKV context timeout, add.go:69
     poll_interval: float = 0.700     # background KV poll, counter/main.go:53
     poll_timeout: float = 0.500      # poll context timeout, counter/main.go:54
+    # AsyncKV transport retries (jittered exponential backoff on the
+    # synthetic code-0 TIMEOUT, runtime/kv.py).  The reference has no
+    # transport-level retry on the counter (a timed-out flush just waits
+    # for the next flush tick), so the default stays 0 to preserve
+    # ledger-calibration parity (test_ledger_calibration.py); raise it
+    # for lossy-network runs where the flush/poll loops should re-issue
+    # instead of skipping a beat.
+    kv_retries: int = 0
+    kv_backoff_base: float = 0.05    # first retry delay (NodeCore.with_backoff)
+    kv_backoff_cap: float = 1.0      # exponential backoff ceiling
 
 
 @dataclass
@@ -51,6 +61,14 @@ class KafkaConfig:
     kv_retries: int = 10             # defaultKVRetries, logmap.go:19
     cas_timeout: float = 5.0         # 5*defaultKVTimeout on CAS paths,
                                      # logmap.go:135,256
+    # AsyncKV TRANSPORT retries (distinct from kv_retries, the
+    # reference's CAS-conflict attempt budget): jittered-backoff
+    # re-issue of timed-out KV ops (runtime/kv.py).  Default 0 — the
+    # reference's loops already retry timeouts at the protocol level
+    # (logmap.go:177-181), and 0 preserves ledger-calibration parity.
+    kv_transport_retries: int = 0
+    kv_backoff_base: float = 0.05    # first retry delay (NodeCore.with_backoff)
+    kv_backoff_cap: float = 1.0      # exponential backoff ceiling
 
 
 @dataclass

@@ -15,9 +15,11 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)"
-# Fault-matrix smoke: one crash + one loss nemesis scenario per sim
-# (CPU, seconds) — certifies recovery on every push, not just in the
-# dedicated nemesis tests.
+# Fault-matrix smoke: one crash + one loss nemesis scenario per sim,
+# plus the words-major STRUCTURED-path crash/loss scenarios (the same
+# plans through structured.make_nemesis) — certifies recovery and the
+# gather-free fault decomposition on every push, not just in the
+# dedicated nemesis tests.  (CPU, seconds.)
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python scripts/fault_smoke.py || rc=1
 exit $rc

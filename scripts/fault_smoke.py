@@ -28,6 +28,12 @@ LOSS = NemesisSpec(n_nodes=N, seed=4, loss_rate=0.2, loss_until=10)
 SCENARIOS = [
     ("broadcast/crash", nemesis.run_broadcast_nemesis, CRASH, {}),
     ("broadcast/loss", nemesis.run_broadcast_nemesis, LOSS, {}),
+    # the words-major structured path under the SAME plans (PR 3):
+    # certifies the gather-free nemesis decomposition on every push
+    ("broadcast/s-crash", nemesis.run_broadcast_nemesis, CRASH,
+     {"structured": True, "topology": "tree"}),
+    ("broadcast/s-loss", nemesis.run_broadcast_nemesis, LOSS,
+     {"structured": True}),
     ("counter/crash", nemesis.run_counter_nemesis, CRASH, {}),
     ("counter/loss", nemesis.run_counter_nemesis, LOSS, {}),
     ("kafka/crash", nemesis.run_kafka_nemesis, CRASH, {}),

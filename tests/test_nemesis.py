@@ -2,12 +2,16 @@
 crash/restart amnesia, probabilistic loss, duplicate delivery — seeded,
 replayable, certified.
 
-Pins the PR-2 contract: a seeded crash+loss+partition scenario on each
+Pins the PR-2 contract — a seeded crash+loss+partition scenario on each
 of broadcast/counter/kafka converges after the faults clear with zero
 lost acknowledged writes, replays bit-exactly from the same FaultPlan
-seed, composes with the existing fault modes on the gather path, and is
-explicitly rejected (with an actionable message) on the structured
-fast paths.
+seed, and composes with the existing fault modes on the gather path —
+plus the PR-3 contract: the SAME plan runs gather-free on the
+words-major structured path (structured.make_nemesis), bit-exact with
+the gather path (received sets AND message ledgers) for tree, grid,
+and circulant under crash+loss+dup composed with partition windows and
+per-direction delays, across the stepwise/fused/donated drivers and
+the mesh halo/fallback paths.
 """
 
 import numpy as np
@@ -121,6 +125,29 @@ def test_broadcast_nemesis_certifies_and_replays():
     other = F.NemesisSpec(**{**SPEC.to_meta(), "seed": 8})
     r3 = nemesis.run_broadcast_nemesis(other, parts=_parts(16))
     assert r3["msgs_total"] != r1["msgs_total"]
+
+
+def test_broadcast_nemesis_structured_path_matches_gather():
+    # the scenario runner's structured mode replays the identical
+    # trajectory (same plan, same ledger) at words-major speed
+    parts = _parts(16)
+    r1 = nemesis.run_broadcast_nemesis(SPEC, parts=parts)
+    r2 = nemesis.run_broadcast_nemesis(SPEC, parts=_parts(16),
+                                       structured=True)
+    assert r2["ok"] and r2["path"] == "structured"
+    assert r2["msgs_total"] == r1["msgs_total"]
+    assert r2["converged_round"] == r1["converged_round"]
+    # tree topology, crash+dup (no loss): a leaf's sole flood to its
+    # parent happens at round 1, so any loss coin there — or crashing
+    # a leaf together with its parent, as SPEC does with 11 and 2 —
+    # loses acked writes on EITHER path; this leg certifies the happy
+    # recovery instead
+    tree_spec = F.NemesisSpec(n_nodes=16, seed=7,
+                              crash=((3, 8, (4, 9)),),
+                              dup_rate=0.1, dup_until=10)
+    r3 = nemesis.run_broadcast_nemesis(tree_spec, topology="tree",
+                                       structured=True)
+    assert r3["ok"] and r3["n_lost_writes"] == 0
 
 
 def test_counter_nemesis_certifies_zero_lost_after_drain():
@@ -323,25 +350,303 @@ def test_dup_delivery_is_absorbed_but_ledger_visible():
     assert int(s2.msgs) > int(s1.msgs)
 
 
-# -- structured-path rejection (explicit, tested messages) --------------
+# -- structured-path nemesis: bit-exact with the gather path ------------
 
 
-def test_fault_plan_rejected_on_structured_path():
+_NEM_TOPOLOGIES = [
+    ("tree", 64, {}),
+    ("tree", 85, {"branching": 4}),          # ragged last level
+    ("grid", 64, {}),
+    ("circulant", 64, {"strides": [1, 5]}),
+]
+
+
+def _nem_builders(topo, n, kw):
+    from gossip_glomers_tpu.parallel.topology import (circulant, tree)
+    if topo == "tree":
+        return to_padded_neighbors(tree(n, kw.get("branching", 4)))
+    if topo == "circulant":
+        return circulant(n, kw["strides"])
+    return to_padded_neighbors(grid(n))
+
+
+def _half_parts(n, start=2, end=9):
+    groups = np.zeros((1, n), np.int8)
+    groups[0, : n // 2] = 1
+    return Partitions(jnp.array([start], jnp.int32),
+                      jnp.array([end], jnp.int32),
+                      jnp.asarray(groups)), groups
+
+
+def test_structured_nemesis_matches_gather_all_topologies():
+    # the tentpole contract: crash+loss+dup composed with a partition
+    # window, words-major structured delivery BIT-EXACT with the
+    # adjacency gather — received sets, rounds, and the msgs ledger
+    # (incl. the dup stream's popcount-at-source charges)
+    from gossip_glomers_tpu.tpu_sim import structured
+    spec = F.NemesisSpec(n_nodes=64, seed=7,
+                         crash=((3, 8, (2, 5, 11)), (10, 13, (0, 1))),
+                         loss_rate=0.2, loss_until=14,
+                         dup_rate=0.15, dup_until=14)
+    for topo, n, kw in _NEM_TOPOLOGIES:
+        sp = spec if n == spec.n_nodes else F.NemesisSpec(
+            **{**spec.to_meta(), "n_nodes": n})
+        nbrs = _nem_builders(topo, n, kw)
+        nv = 48
+        inject = make_inject(n, nv)
+        parts, groups = _half_parts(n)
+        ref = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                           parts=parts, fault_plan=sp.compile(),
+                           srv_ledger=False)
+        s1, r1 = ref.run(inject, max_rounds=300)
+        nem = structured.make_nemesis(topo, n, sp, groups=groups, **kw)
+        parts2, _ = _half_parts(n)
+        fast = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                            parts=parts2,
+                            exchange=structured.make_exchange(
+                                topo, n, **kw),
+                            fault_plan=sp.compile(), nemesis=nem)
+        s2, r2 = fast.run(inject, max_rounds=300)
+        assert r1 == r2, (topo, n)
+        assert (ref.received_node_major(s1)
+                == fast.received_node_major(s2)).all(), (topo, n)
+        assert int(s1.msgs) == int(s2.msgs), (topo, n)
+
+
+def test_structured_nemesis_with_delays_matches_gather():
+    # crash+loss+dup AND per-direction delays AND a partition window:
+    # the full composition, structured vs the gather path's per-edge
+    # delays (bridged by gather_delays_for)
+    from gossip_glomers_tpu.tpu_sim import structured
+    spec = F.NemesisSpec(n_nodes=64, seed=3, crash=((4, 9, (1, 6, 30)),),
+                         loss_rate=0.15, loss_until=12,
+                         dup_rate=0.2, dup_until=12)
+    cases = [("tree", (1, 2), {}), ("grid", (2, 1, 3, 1), {}),
+             ("circulant", (1, 2, 2, 1), {"strides": [1, 5]})]
+    n, nv = 64, 48
+    inject = make_inject(n, nv)
+    for topo, dd, kw in cases:
+        nbrs = _nem_builders(topo, n, kw)
+        gd = structured.gather_delays_for(topo, n, dd, nbrs, **kw)
+        parts, groups = _half_parts(n)
+        ref = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                           parts=parts, delays=gd,
+                           fault_plan=spec.compile(), srv_ledger=False)
+        s1, r1 = ref.run(inject, max_rounds=400)
+        nem = structured.make_nemesis(topo, n, spec, groups=groups,
+                                      dir_delays=dd, **kw)
+        parts2, _ = _half_parts(n)
+        fast = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                            parts=parts2,
+                            exchange=structured.make_exchange(
+                                topo, n, **kw),
+                            fault_plan=spec.compile(), nemesis=nem)
+        s2, r2 = fast.run(inject, max_rounds=400)
+        assert r1 == r2, (topo, dd)
+        assert (ref.received_node_major(s1)
+                == fast.received_node_major(s2)).all(), (topo, dd)
+        assert int(s1.msgs) == int(s2.msgs), (topo, dd)
+
+
+def test_structured_nemesis_sharded_fused_donated_parity():
+    # mesh halo AND all_gather fallback, stepwise AND fused AND the
+    # donated fixed-trip runner: all bit-identical to single-device;
+    # the donated runner consumes its staged input
+    from gossip_glomers_tpu.tpu_sim import structured
+    spec = F.NemesisSpec(n_nodes=64, seed=7, crash=((3, 8, (2, 5, 11)),),
+                         loss_rate=0.2, loss_until=12,
+                         dup_rate=0.15, dup_until=12)
+    n, nv = 64, 48
+    inject = make_inject(n, nv)
+    for topo, kw in [("tree", {}), ("circulant", {"strides": [1, 5]})]:
+        nbrs = _nem_builders(topo, n, kw)
+        parts, groups = _half_parts(n)
+        ref = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                           parts=parts,
+                           exchange=structured.make_exchange(
+                               topo, n, **kw),
+                           fault_plan=spec.compile(),
+                           nemesis=structured.make_nemesis(
+                               topo, n, spec, groups=groups, **kw))
+        s1, r1 = ref.run(inject, max_rounds=200)
+        mesh = mesh_1d()
+        for shards in (8, None):      # halo mode / fallback mode
+            nem = structured.make_nemesis(topo, n, spec, groups=groups,
+                                          n_shards=shards, **kw)
+            if shards is not None:
+                assert nem.sharded_exchange is not None, topo
+            parts2, _ = _half_parts(n)
+            sim = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                               parts=parts2, mesh=mesh,
+                               exchange=structured.make_exchange(
+                                   topo, n, **kw),
+                               fault_plan=spec.compile(), nemesis=nem)
+            s2, r2 = sim.run(inject, max_rounds=200)
+            assert r1 == r2, (topo, shards)
+            assert (ref.received_node_major(s1)
+                    == sim.received_node_major(s2)).all(), (topo, shards)
+            assert int(s1.msgs) == int(s2.msgs), (topo, shards)
+            s3, r3 = sim.run_fused(inject, max_rounds=200)
+            assert r3 == r1 and int(s3.msgs) == int(s1.msgs)
+            st0, _tgt = sim.stage(inject)
+            s4 = sim.run_staged_fixed(st0, r1, donate=True)
+            assert (ref.received_node_major(s1)
+                    == sim.received_node_major(s4)).all(), (topo, shards)
+            assert int(s4.msgs) == int(s1.msgs)
+            with pytest.raises(RuntimeError):
+                np.asarray(st0.received) + 0
+        # words axis too: popcount partials psum across word shards
+        from jax.sharding import Mesh
+        mesh2 = Mesh(np.array(jax.devices()).reshape(4, 2),
+                     ("nodes", "words"))
+        nem2 = structured.make_nemesis(topo, n, spec, groups=groups,
+                                       n_shards=4, **kw)
+        parts3, _ = _half_parts(n)
+        sim2 = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                            parts=parts3, mesh=mesh2,
+                            exchange=structured.make_exchange(
+                                topo, n, **kw),
+                            fault_plan=spec.compile(), nemesis=nem2)
+        s5, r5 = sim2.run(inject, max_rounds=200)
+        assert r5 == r1 and int(s5.msgs) == int(s1.msgs), topo
+        assert (ref.received_node_major(s1)
+                == sim2.received_node_major(s5)).all(), topo
+
+
+def test_structured_nemesis_seed_replay_determinism():
+    # same (spec, workload) seeds -> identical trajectory on the
+    # structured path; a different fault seed diverges
+    from gossip_glomers_tpu.tpu_sim import structured
+    n, nv = 64, 48
+    nbrs = _nem_builders("tree", n, {})
+    inject = make_inject(n, nv)
+
+    def run(seed):
+        spec = F.NemesisSpec(n_nodes=n, seed=seed,
+                             crash=((3, 8, (2, 5)),),
+                             loss_rate=0.25, loss_until=12,
+                             dup_rate=0.1, dup_until=12)
+        sim = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                           exchange=structured.make_exchange("tree", n),
+                           fault_plan=spec.compile(),
+                           nemesis=structured.make_nemesis(
+                               "tree", n, spec))
+        s, r = sim.run(inject, max_rounds=200)
+        return int(s.msgs), r
+
+    assert run(7) == run(7)
+    assert run(7) != run(8)
+
+
+def test_edge_delays_compose_with_partitions_structured():
+    # VERDICT priority 1: random per-edge delays x partition windows,
+    # previously gather-only, now structured via
+    # make_edge_delayed_faulted — received, msgs, AND the srv ledger
+    # bit-exact vs the gather path, single-device and mesh halo
+    from gossip_glomers_tpu.tpu_sim import structured
+    rng = np.random.default_rng(0)
+    cases = [("tree", 64, 2, {}),
+             ("circulant", 64, 4, {"strides": [1, 5]}),
+             ("grid", 256, 4, {})]      # 256: halo needs cols < block
+    for topo, n, d_rows, kw in cases:
+        nv = 48
+        inject = make_inject(n, nv)
+        nbrs = _nem_builders(topo, n, kw)
+        rows = rng.integers(1, 4, (d_rows, n)).astype(np.int32)
+        gd = structured.gather_delays_from_rows(topo, n, rows, nbrs,
+                                                **kw)
+        parts, groups = _half_parts(n)
+        ref = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                           parts=parts, delays=gd)
+        s1, r1 = ref.run(inject, max_rounds=400)
+        ef = structured.make_edge_delayed_faulted(topo, n, rows,
+                                                  groups, **kw)
+        parts2, _ = _half_parts(n)
+        fast = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                            parts=parts2,
+                            exchange=structured.make_exchange(
+                                topo, n, **kw),
+                            edge_delayed=ef)
+        s2, r2 = fast.run(inject, max_rounds=400)
+        assert r1 == r2, (topo, n)
+        assert (ref.received_node_major(s1)
+                == fast.received_node_major(s2)).all(), (topo, n)
+        assert int(s1.msgs) == int(s2.msgs), (topo, n)
+        assert ref.server_msgs(s1) == fast.server_msgs(s2), (topo, n)
+        ef2 = structured.make_edge_delayed_faulted(topo, n, rows,
+                                                   groups, n_shards=8,
+                                                   **kw)
+        parts3, _ = _half_parts(n)
+        shd = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                           parts=parts3, mesh=mesh_1d(),
+                           exchange=structured.make_exchange(
+                               topo, n, **kw),
+                           edge_delayed=ef2)
+        s3, r3 = shd.run(inject, max_rounds=400)
+        assert r3 == r1, (topo, n)
+        assert (ref.received_node_major(s1)
+                == shd.received_node_major(s3)).all(), (topo, n)
+        assert int(s3.msgs) == int(s1.msgs), (topo, n)
+        assert shd.server_msgs(s3) == ref.server_msgs(s1), (topo, n)
+
+
+# -- structured-path guards (explicit, tested messages) -----------------
+
+
+def test_fault_plan_without_bundle_rejected_on_structured_path():
     n, nv = 64, 32
     nbrs = to_padded_neighbors(grid(n))
-    with pytest.raises(ValueError, match="gather path only"):
+    with pytest.raises(ValueError, match="make_nemesis"):
         BroadcastSim(nbrs, n_values=nv,
                      exchange=make_exchange("grid", n),
                      fault_plan=SPEC.compile())
+    # and the bundle without its plan is rejected too
+    from gossip_glomers_tpu.tpu_sim import structured
+    spec = F.NemesisSpec(n_nodes=n, seed=0, loss_rate=0.1,
+                         loss_until=4)
+    nem = structured.make_nemesis("grid", n, spec)
+    with pytest.raises(ValueError, match="fault_plan"):
+        BroadcastSim(nbrs, n_values=nv,
+                     exchange=make_exchange("grid", n), nemesis=nem)
+    with pytest.raises(ValueError, match="structured exchange"):
+        BroadcastSim(nbrs, n_values=nv, nemesis=nem,
+                     fault_plan=spec.compile())
+    # per-edge delays x partitions needs the composed bundle
+    rows = np.ones((4, n), np.int32)
+    groups = np.zeros((1, n), np.int8)
+    groups[0, :8] = 1
+    parts = Partitions(jnp.array([1], jnp.int32),
+                       jnp.array([3], jnp.int32), jnp.asarray(groups))
+    with pytest.raises(ValueError, match="make_edge_delayed_faulted"):
+        BroadcastSim(nbrs, n_values=nv, parts=parts,
+                     exchange=make_exchange("grid", n),
+                     edge_delayed=structured.make_edge_delayed(
+                         "grid", n, rows))
 
 
-def test_dup_rejected_under_per_edge_delays():
+def test_dup_under_per_edge_delays_is_ledger_visible_only():
+    # ROADMAP open item 2 closed: dup composes with per-edge delays —
+    # a dup edge re-delivers its in-flight payload block, which dedup
+    # absorbs (identical final state) while the msgs ledger grows
     n, nv = 16, 24
     nbrs = to_padded_neighbors(grid(n))
-    delays = np.ones_like(nbrs, np.int32)
-    with pytest.raises(ValueError, match="duplicate delivery"):
-        BroadcastSim(nbrs, n_values=nv, delays=delays,
-                     fault_plan=SPEC.compile())
+    rng = np.random.default_rng(0)
+    delays = np.where(nbrs >= 0, rng.integers(1, 4, nbrs.shape),
+                      1).astype(np.int32)
+    base = dict(n_nodes=n, seed=7, crash=((3, 8, (2, 5)),),
+                loss_rate=0.1, loss_until=10)
+    no_dup = F.NemesisSpec(**base)
+    with_dup = F.NemesisSpec(**base, dup_rate=0.4, dup_until=10)
+    inject = make_inject(n, nv)
+    s1, r1 = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                          delays=delays,
+                          fault_plan=no_dup.compile()).run(inject)
+    sim2 = BroadcastSim(nbrs, n_values=nv, sync_every=4, delays=delays,
+                        fault_plan=with_dup.compile())
+    s2, r2 = sim2.run(inject)
+    assert r1 == r2
+    assert (np.asarray(s1.received) == np.asarray(s2.received)).all()
+    assert int(s2.msgs) > int(s1.msgs)
 
 
 def test_structured_mutual_exclusion_messages():

@@ -191,6 +191,67 @@ def test_kv_retry_backoff_on_timeout():
                for line in sent)
 
 
+def test_counter_model_kv_transport_retries_knob():
+    """CounterConfig.kv_retries wires AsyncKV's jittered-backoff
+    transport retries into the counter MODEL (previously the model
+    always issued one attempt per flush tick, reference parity): with
+    a seq-kv that never replies, one flush attempt's read re-issues
+    ``kv_retries`` extra times before giving up — and with the default
+    0 the wire sees exactly one read per attempt, so calibration-parity
+    runs are untouched."""
+    import io
+    import random
+    import time
+
+    from gossip_glomers_tpu.models.counter import CounterProgram
+    from gossip_glomers_tpu.protocol import Message
+    from gossip_glomers_tpu.runtime.node import StdioNode
+    from gossip_glomers_tpu.utils.config import CounterConfig
+
+    def first_attempt_reads(cfg) -> int:
+        out = io.StringIO()
+        node = StdioNode(in_stream=io.StringIO(), out_stream=out,
+                         err_stream=io.StringIO())
+        node.rng = random.Random(0)        # deterministic jitter
+        CounterProgram(cfg).install(node)
+        node.deliver(Message("c1", "n0",
+                             {"type": "init", "msg_id": 1,
+                              "node_id": "n0", "node_ids": ["n0"]}))
+        node.deliver(Message("c1", "n0", {"type": "add", "msg_id": 2,
+                                          "delta": 5}))
+
+        def kv_reads():
+            return [json.loads(line)
+                    for line in out.getvalue().splitlines()
+                    if json.loads(line)["dest"] == "seq-kv"
+                    and json.loads(line)["body"]["type"] == "read"]
+
+        # the flush tick fires at ~flush_interval; its read (plus any
+        # transport retries) times out against the silent KV, and the
+        # NEXT attempt only starts a full flush_interval (1 s) after
+        # that — so everything on the wire 0.15 s after the expected
+        # count arrives belongs to the FIRST attempt, with ~0.85 s of
+        # slack against scheduler stalls on a loaded CI machine
+        want = 1 + cfg.kv_retries
+        deadline = time.monotonic() + 6.0
+        while len(kv_reads()) < want and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.15)
+        reads = kv_reads()
+        # every re-issue is a fresh rpc with a fresh msg_id
+        assert len({r["body"]["msg_id"] for r in reads}) == len(reads)
+        return len(reads)
+
+    base = dict(flush_interval=1.0, kv_op_timeout=0.02,
+                poll_interval=30.0, kv_backoff_base=0.01,
+                kv_backoff_cap=0.05)
+    # retries=2: the flush attempt re-issues its read exactly twice
+    assert first_attempt_reads(CounterConfig(kv_retries=2, **base)) == 3
+    # default 0: exactly one read per attempt — the reference-parity
+    # wire shape the ledger calibration depends on
+    assert first_attempt_reads(CounterConfig(**base)) == 1
+
+
 def test_console_script_entry_points_registered():
     """Packaging (pyproject [project.scripts]): one Maelstrom-style
     executable per challenge, like the reference's checked-in binaries.
