@@ -31,7 +31,6 @@ import argparse
 import json
 import os
 import pathlib
-import subprocess
 import sys
 import time
 
@@ -44,29 +43,17 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 def config_a_mesh_takeover_w128() -> dict:
     """(a) the recorded OOM shape on the 8-way virtual mesh, donated."""
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
-                        "XLA_FLAGS")}
-    env["GG_TAKEOVER_NEXP"] = "22"
-    env["GG_TAKEOVER_W"] = "128"
-    try:
-        out = subprocess.run(
-            [sys.executable, str(pathlib.Path(__file__).parent
-                                 / "mesh_takeover.py")],
-            capture_output=True, text=True, env=env, timeout=4 * 3600)
-    except subprocess.TimeoutExpired:
-        return {"config": "pr1-mesh-takeover-4M-w128", "ok": False,
-                "error": "timeout after 4h on the virtual mesh"}
-    for line in out.stdout.splitlines():
-        if line.startswith("{"):
-            res = json.loads(line)
-            res["config"] = "pr1-mesh-takeover-4M-w128"
-            res["r05_record"] = ("circulant-4096k-w128: OOM on one "
-                                 "16 GB chip (BENCH_ALL_r05.json "
-                                 "broadcast-scale-sweep)")
-            return res
-    return {"config": "pr1-mesh-takeover-4M-w128", "ok": False,
-            "error": (out.stderr or out.stdout)[-400:]}
+    from benchmarks.takeover_subprocess import run_takeover_subprocess
+
+    res = run_takeover_subprocess(
+        {"GG_TAKEOVER_NEXP": "22", "GG_TAKEOVER_W": "128"},
+        timeout=4 * 3600, config_name="pr1-mesh-takeover-4M-w128")
+    res["config"] = "pr1-mesh-takeover-4M-w128"
+    if res.get("ok"):
+        res["r05_record"] = ("circulant-4096k-w128: OOM on one "
+                             "16 GB chip (BENCH_ALL_r05.json "
+                             "broadcast-scale-sweep)")
+    return res
 
 
 def config_b_donation_memory() -> dict:

@@ -12,6 +12,17 @@ Usage::
     python benchmarks/fault_sweep.py [--out BENCH_PR2.json]
         [--n-nodes 16] [--loss 0,0.1,0.3] [--crash 0,1,2]
     python benchmarks/fault_sweep.py --structured [--out BENCH_PR3.json]
+    python benchmarks/fault_sweep.py --pr4 [--out BENCH_PR4.json]
+
+``--pr4`` (PR 4) is the kafka/counter scale artifact: the node sweep
+past 1,024 to the recorded single-chip OOM boundary (run_all config
+5b extension), the faulted origin-union replication vs the
+``repl_fast=False`` matmul oracle at the 1,024-node sweep point
+(bit-exact under crash+loss+dup), large-N faulted counter/kafka
+nemesis rows, the kafka mesh takeover past the boundary on the 8-way
+virtual mesh, and the structured faulted-round words-threshold
+measurement (the BENCH_PR3 W=64 regression resolved as an auto
+fallback pick).
 
 ``--structured`` (PR 3) times one FAULTED round — crash+loss+dup, the
 full plan — on the words-major structured path vs the adjacency gather
@@ -230,6 +241,187 @@ def structured_mode(seed: int = 0) -> dict:
     }
 
 
+def _kafka_faulted_repl_row(n_nodes: int = 1024, n_keys: int = 10_000,
+                            cap: int = 128, s: int = 16,
+                            rounds: int = 2, reps: int = 2,
+                            seed: int = 7) -> dict:
+    """The PR-4 tentpole artifact: the FAULTED origin-union replication
+    (elementwise (t, src, dst) coin fold, no N x N lhs) vs the
+    ``repl_fast=False`` link-mask matmul ORACLE at the 5b sweep's
+    1,024-node point, under crash+loss+dup active every timed round —
+    bit-exact final state asserted field by field, same backend."""
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim
+
+    spec = NemesisSpec(
+        n_nodes=n_nodes, seed=seed,
+        crash=((1, rounds + 1, tuple(range(0, n_nodes, 97))),),
+        loss_rate=0.1, loss_until=rounds + 1,
+        dup_rate=0.05, dup_until=rounds + 1)
+    rng = np.random.default_rng(seed)
+    sks = rng.integers(0, n_keys, (rounds, n_nodes, s)).astype(np.int32)
+    svs = rng.integers(0, 1 << 20,
+                       (rounds, n_nodes, s)).astype(np.int32)
+    finals, ms = {}, {}
+    for name, repl_fast in (("matmul_oracle", False),
+                            ("union_nem", None)):
+        sim = KafkaSim(n_nodes, n_keys, capacity=cap, max_sends=s,
+                       fault_plan=spec.compile(), repl_fast=repl_fast)
+        st = sim.run_rounds(sim.init_state(), sks, svs)  # compile+warm
+        jax.block_until_ready(st.present)
+        t0 = _t.perf_counter()
+        for _ in range(reps):
+            st = sim.run_rounds(sim.init_state(), sks, svs)
+            jax.block_until_ready(st.present)
+        ms[name] = ((_t.perf_counter() - t0) / (reps * rounds) * 1e3)
+        finals[name] = st
+    bit_exact = all(
+        bool((np.asarray(a) == np.asarray(b)).all())
+        for a, b in zip(finals["matmul_oracle"], finals["union_nem"]))
+    return {
+        "n_nodes": n_nodes, "n_keys": n_keys, "capacity": cap,
+        "max_sends": s, "rounds": rounds,
+        "fault": "crash(1 in 97 nodes)+loss(0.1)+dup(0.05), active "
+                 "every timed round",
+        "ms_per_round_matmul_oracle": round(ms["matmul_oracle"], 3),
+        "ms_per_round_union_nem": round(ms["union_nem"], 3),
+        "speedup": round(ms["matmul_oracle"] / ms["union_nem"], 1),
+        "bit_exact": bit_exact,
+    }
+
+
+def _large_n_faulted_rows(seed: int) -> list[dict]:
+    """The ROADMAP's open large-N faulted counter/kafka rows: certified
+    nemesis campaigns far past the PR-2 CPU-scale shapes (counter at
+    131,072 nodes — per-node fault masks, N-scalable; kafka at 4,096
+    nodes on the faulted origin-union path, whose (rows, N·S) coin
+    tensor is the documented N² cost of per-link loss on a full
+    mesh)."""
+    import numpy as np
+
+    rows = []
+    n_c = 1 << 17
+    rng = np.random.default_rng(seed)
+    deltas = rng.integers(0, 10, n_c).astype(np.int32)
+    # crash windows shifted past the allreduce drain (same move as the
+    # sweep's counter cells): a loss-delayed flush caught by a crash is
+    # the genuine ack-before-durability loss the certifier exists to
+    # flag — not what a RECOVERY row should measure
+    spec_c = _shift_crash(
+        random_spec(n_c, seed=seed + 1, horizon=12,
+                    n_crash_windows=2, loss_rate=0.1), 4)
+    t0 = time.perf_counter()
+    r = nemesis.run_counter_nemesis(spec_c, mode="allreduce",
+                                    deltas=deltas)
+    rows.append({
+        "workload": "counter-allreduce", "n_nodes": n_c,
+        "ok": r["ok"], "recovery_rounds": r["recovery_rounds"],
+        "n_lost_writes": r["n_lost_writes"],
+        "msgs_total": r["msgs_total"],
+        "wall_s": round(time.perf_counter() - t0, 2),
+    })
+    n_k = 4096
+    spec_k = random_spec(n_k, seed=seed + 2, horizon=12,
+                         n_crash_windows=1, loss_rate=0.1)
+    t0 = time.perf_counter()
+    rk = nemesis.run_kafka_nemesis(spec_k, n_keys=1024, capacity=128,
+                                   max_sends=1, rounds=12)
+    rows.append({
+        "workload": "kafka-union-nem", "n_nodes": n_k,
+        "ok": rk["ok"], "recovery_rounds": rk["recovery_rounds"],
+        "n_lost_writes": rk["n_lost_writes"],
+        "n_allocated": rk["n_allocated"],
+        "msgs_total": rk["msgs_total"],
+        "wall_s": round(time.perf_counter() - t0, 2),
+    })
+    for row in rows:
+        print(f"large-N faulted {row['workload']:18s} "
+              f"n={row['n_nodes']:<7} ok={row['ok']} "
+              f"recovery={row['recovery_rounds']}")
+    return rows
+
+
+def _kafka_takeover_subprocess() -> dict:
+    """Subprocess launch of the kafka mesh takeover (its own 8-device
+    virtual CPU mesh must not share this process's backend)."""
+    from benchmarks.takeover_subprocess import run_takeover_subprocess
+
+    return run_takeover_subprocess(
+        {"GG_TAKEOVER_WORKLOAD": "kafka"}, timeout=3000,
+        config_name="kafka-mesh-takeover-past-single-chip-oom",
+        timeout_hint="see GG_TAKEOVER_NODES/GG_TAKEOVER_KEYS to shrink")
+
+
+def pr4_mode(seed: int = 0) -> dict:
+    """The PR-4 ``--pr4`` artifact (BENCH_PR4.json): the kafka/counter
+    scale story — node sweep past 1k to the recorded single-chip OOM
+    boundary, faulted origin-union vs the matmul oracle at the
+    1,024-node sweep point, large-N faulted counter/kafka rows, the
+    kafka mesh takeover past the boundary, and the structured
+    faulted-round words-threshold measurement behind
+    structured.faulted_path_pick."""
+    import jax
+
+    from benchmarks.run_all import config5b_kafka_node_sweep
+    from gossip_glomers_tpu.tpu_sim import structured as S
+
+    print("== kafka node sweep (config 5b, extended) ==")
+    sweep = config5b_kafka_node_sweep()
+    for k, v in sweep.items():
+        if isinstance(v, dict):
+            print(f"  {k}: {v.get('ms_per_round', v.get('error'))}")
+    print("== faulted origin-union vs matmul oracle ==")
+    repl = _kafka_faulted_repl_row()
+    print(f"  matmul {repl['ms_per_round_matmul_oracle']}ms vs union "
+          f"{repl['ms_per_round_union_nem']}ms = {repl['speedup']}x "
+          f"bit_exact={repl['bit_exact']}")
+    print("== large-N faulted rows ==")
+    large = _large_n_faulted_rows(seed)
+    print("== kafka mesh takeover (subprocess, 8-way virtual mesh) ==")
+    takeover = _kafka_takeover_subprocess()
+    print(f"  ok={takeover.get('ok')} "
+          f"wall={takeover.get('wall_s_virtual_mesh')}s")
+    print("== structured faulted-round words threshold ==")
+    wt_rows = []
+    for nv in (32, 256, 512, 2048):
+        row = _faulted_round_row(1024, nv, "tree", rounds=8, reps=2)
+        row["picked_path"] = S.faulted_path_pick(
+            (nv + 31) // 32, backend="cpu")
+        wt_rows.append(row)
+        print(f"  W={(nv + 31) // 32:<3} speedup={row['speedup']} "
+              f"pick={row['picked_path']} bit_exact={row['bit_exact']}")
+    out = {
+        "benchmark": "kafka_counter_scale_pr4",
+        "backend": jax.default_backend(),
+        "kafka_node_sweep": sweep,
+        "kafka_faulted_repl": repl,
+        "large_n_faulted": large,
+        "kafka_mesh_takeover": takeover,
+        "words_threshold": {
+            "rows": wt_rows,
+            "nem_gather_min_w": S.NEM_GATHER_MIN_W,
+            "pick": ("CPU backend: auto-fall back to the adjacency "
+                     "gather at W >= NEM_GATHER_MIN_W (measured "
+                     "crossover ~W=8 at 1024 nodes; the BENCH_PR3 "
+                     "W=64 tree row regression, 0.47x, is this "
+                     "effect).  TPU: structured at every W (the "
+                     "recorded 60-190x tile-granularity advantage).  "
+                     "Implemented: structured.faulted_path_pick, "
+                     "harness run_broadcast_nemesis(structured="
+                     "'auto'); override via GG_NEM_GATHER_MIN_W."),
+        },
+    }
+    out["all_ok"] = bool(
+        sweep["ok"] and repl["bit_exact"]
+        and all(r["ok"] for r in large) and takeover.get("ok")
+        and all(r["bit_exact"] for r in wt_rows))
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default=None)
@@ -242,7 +434,20 @@ def main() -> int:
                     help="PR-3 mode: structured-vs-gather faulted-"
                          "round timing + structured certification "
                          "(default out: BENCH_PR3.json)")
+    ap.add_argument("--pr4", action="store_true",
+                    help="PR-4 mode: kafka/counter scale story — node "
+                         "sweep to the OOM boundary, faulted "
+                         "origin-union vs matmul oracle, large-N "
+                         "faulted rows, kafka mesh takeover, words "
+                         "threshold (default out: BENCH_PR4.json)")
     args = ap.parse_args()
+    if args.pr4:
+        out = pr4_mode(seed=args.seed)
+        path = args.out or "BENCH_PR4.json"
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {path}; all_ok={out['all_ok']}")
+        return 0 if out["all_ok"] else 1
     if args.structured:
         out = structured_mode(seed=args.seed)
         path = args.out or "BENCH_PR3.json"

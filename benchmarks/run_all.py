@@ -602,13 +602,28 @@ def config5_kafka_10k():
 
 def config5b_kafka_node_sweep():
     """The kafka NODE axis at scale: presence is a bit-packed
-    (N, K, C/32) uint32 set and replication delivery is a byte-split
-    uint8 MXU matmul (disjoint bits make the masked OR a sum — see
+    (N, K, C/32) uint32 set and replication delivery is the origin-
+    union scatter (disjoint bits make the masked OR a sum — see
     tpu_sim/kafka.py), so the full-mesh fire-and-forget scales to
     1k nodes x 10k keys where the old dense bool layout was ~1.3 GB
     of presence and an (N,N)x(N,K,C) int8 einsum.  Reports memory per
     node and sends/s at each size; ledger/round semantics pinned
-    bit-exact by the existing kafka tests."""
+    bit-exact by the existing kafka tests.
+
+    PR-4 extension — the node axis PAST 1k, to the single-chip OOM
+    boundary.  Every send must land a unique (key, slot), so presence
+    scales as N x (total offsets) ≈ N²·S·R/8 bytes: the extension rows
+    grow keys with nodes (K = N/16, C = 64, round-robin keys so no key
+    overflows capacity) and run the DONATED union-replication driver
+    (one live presence copy + O(K·Wc) temps).  Rows whose donated
+    footprint (~1.5 x presence for copy + temps) exceeds a 16 GB
+    chip's ~14 GB usable HBM are recorded as the OOM boundary rather
+    than silently skipped — the same convention as the broadcast scale
+    sweep (config 7); benchmarks/mesh_takeover.py's kafka mode runs
+    the boundary shape on the 8-way virtual mesh.  Timing for the big
+    rows is a second donated run over the warm program (capacity
+    leaves exactly one re-run of the batch before slots exhaust);
+    override the ceiling with GG_KAFKA_SWEEP_MAX_NEXP."""
     import jax
 
     from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim
@@ -641,8 +656,56 @@ def config5b_kafka_node_sweep():
             "present_mb_total": round(present_mb, 1),
             "present_kb_per_node": round(present_mb * 1e3 / n, 1),
         }
+    # -- extension rows: 4k -> 256k nodes, donated union replication --
+    max_nexp = int(os.environ.get("GG_KAFKA_SWEEP_MAX_NEXP", "17"))
+    boundary = None
+    for n in (4096, 16384, 65536, 131072, 262144):
+        k2, cap2, s2, r2 = max(256, n // 16), 64, 1, 2
+        wc = (cap2 + 31) // 32
+        present_gb = n * k2 * wc * 4 / 1e9
+        row_name = f"nodes-{n}-k{k2}"
+        row = {"n_keys": k2, "capacity": cap2,
+               "present_mb_total": round(present_gb * 1e3, 1),
+               "present_kb_per_node": round(present_gb * 1e6 / n, 1)}
+        if 1.5 * present_gb > 14.0 or n > (1 << max_nexp):
+            if 1.5 * present_gb > 14.0:
+                row["error"] = (
+                    f"exceeds single-chip HBM: ~1.5 x "
+                    f"{present_gb:.1f} GB donated presence footprint")
+                if boundary is None:
+                    boundary = row_name
+            else:
+                row["error"] = "skipped (GG_KAFKA_SWEEP_MAX_NEXP)"
+            entries[row_name] = row
+            continue
+        sim = KafkaSim(n, k2, capacity=cap2, max_sends=s2)
+        rng = np.random.default_rng(n)
+        # round-robin keys: N/K sends per key per round, so two
+        # R-round runs fill capacity exactly and no slot overflows
+        sks = np.tile(
+            (np.arange(n, dtype=np.int32) % k2)[None, :, None],
+            (r2, 1, 1))
+        svs = rng.integers(0, 1 << 20, (r2, n, s2)).astype(np.int32)
+        st = sim.run_fused(sim.init_state(), sks, svs)   # compile+warm
+        jax.block_until_ready(st.kv_val)
+        sends = r2 * n * s2
+        kv = np.asarray(st.kv_val)
+        allocated = int(np.where(kv > 0, kv - 1, 0).sum())
+        ok = allocated == sends
+        ok_all = ok_all and ok
+        t0 = time.perf_counter()
+        st = sim.run_fused(st, sks, svs)                 # timed re-run
+        jax.block_until_ready(st.kv_val)
+        dt = time.perf_counter() - t0
+        row.update({
+            "ok": bool(ok),
+            "sends_per_s": int(sends / dt),
+            "ms_per_round": round(dt / r2 * 1e3, 3),
+        })
+        entries[row_name] = row
     return {"config": "kafka-node-sweep-10k-keys", "ok": bool(ok_all),
-            "n_keys": n_keys, "capacity": cap, **entries}
+            "n_keys": n_keys, "capacity": cap,
+            "oom_boundary": boundary, **entries}
 
 
 def config8_mesh_takeover():
@@ -650,27 +713,11 @@ def config8_mesh_takeover():
     boundary (benchmarks/mesh_takeover.py) — run as a SUBPROCESS so
     its 8-device virtual CPU mesh coexists with this process's TPU
     backend (platforms cannot switch after backend init)."""
-    import subprocess
-    import sys as _sys
+    from benchmarks.takeover_subprocess import run_takeover_subprocess
 
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
-                        "XLA_FLAGS")}
-    try:
-        out = subprocess.run(
-            [_sys.executable, str(pathlib.Path(__file__).parent
-                                  / "mesh_takeover.py")],
-            capture_output=True, text=True, env=env, timeout=3600)
-    except subprocess.TimeoutExpired:
-        return {"config": "mesh-takeover-past-single-chip-oom",
-                "ok": False, "error": "timeout after 3600s (one host "
-                "core executes all 8 virtual shards; see "
-                "GG_TAKEOVER_NEXP/GG_TAKEOVER_W to shrink)"}
-    for line in out.stdout.splitlines():
-        if line.startswith("{"):
-            return json.loads(line)
-    return {"config": "mesh-takeover-past-single-chip-oom",
-            "ok": False, "error": (out.stderr or out.stdout)[-400:]}
+    return run_takeover_subprocess(
+        timeout=3600,
+        timeout_hint="see GG_TAKEOVER_NEXP/GG_TAKEOVER_W to shrink")
 
 
 def main() -> None:

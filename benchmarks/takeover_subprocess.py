@@ -1,0 +1,52 @@
+"""The ONE mesh-takeover subprocess launcher.
+
+benchmarks/mesh_takeover.py force-configures an 8-device virtual CPU
+mesh AT IMPORT (platforms cannot switch after backend init), so every
+caller must launch it as a subprocess with the parent's backend env
+scrubbed — a pattern that had been copy-pasted (and silently diverged:
+first-vs-last JSON-line parsing) across run_all, bench_pr1,
+fault_sweep, and kafka_smoke.  This module has no JAX imports and no
+import side effects, so any driver can share it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+# env vars that would leak the parent's backend/tunnel config into the
+# subprocess's own virtual-mesh setup
+_SCRUB = ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
+
+
+def run_takeover_subprocess(env_overrides: dict[str, str] | None = None,
+                            *, timeout: float = 3600,
+                            config_name: str =
+                            "mesh-takeover-past-single-chip-oom",
+                            timeout_hint: str = "") -> dict:
+    """Launch benchmarks/mesh_takeover.py with a scrubbed env plus
+    ``env_overrides`` and return its one JSON result line (the FIRST
+    stdout line starting with ``{`` — diagnostics may follow it).  On
+    timeout or a missing result line, returns an ``ok: False`` dict
+    with ``config_name`` and the error."""
+    env = {k: v for k, v in os.environ.items() if k not in _SCRUB}
+    env.update(env_overrides or {})
+    script = pathlib.Path(__file__).resolve().parent / "mesh_takeover.py"
+    try:
+        out = subprocess.run([sys.executable, str(script)],
+                             capture_output=True, text=True, env=env,
+                             timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"config": config_name, "ok": False,
+                "error": f"timeout after {timeout:.0f}s (one host core "
+                         f"executes all virtual shards"
+                         + (f"; {timeout_hint}" if timeout_hint else "")
+                         + ")"}
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            return json.loads(line)
+    return {"config": config_name, "ok": False,
+            "error": (out.stderr or out.stdout)[-400:]}

@@ -52,7 +52,8 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                           topology: str = "grid", sync_every: int = 4,
                           parts: Partitions | None = None,
                           max_recovery_rounds: int = 96,
-                          mesh=None, structured: bool = False) -> dict:
+                          mesh=None,
+                          structured: "bool | str" = False) -> dict:
     """Broadcast under the full nemesis (crash/loss/dup from ``spec``,
     plus an optional partition schedule): values injected round-robin
     at round 0, convergence = every node holds every value.  A lost
@@ -62,10 +63,17 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
     ``structured``: run the words-major structured path (the same plan
     decomposed into per-direction masks by structured.make_nemesis —
     bit-exact with the gather path, ~0.5 ms/round at the 1M-node
-    shapes) instead of the adjacency gather."""
+    shapes) instead of the adjacency gather.  ``"auto"`` picks per
+    backend and words count (structured.faulted_path_pick): structured
+    everywhere on TPU, gather on CPU above the measured
+    ``NEM_GATHER_MIN_W`` words crossover — the resolution of the
+    BENCH_PR3 n_values=2048 (W=64) regression row."""
     from ..tpu_sim import structured as S
     n = spec.n_nodes
     nv = n_values if n_values is not None else 2 * n
+    if structured == "auto":
+        structured = (S.faulted_path_pick((nv + 31) // 32)
+                      == "structured")
     kw = {}
     if structured:
         groups = (np.asarray(parts.group) if parts is not None
@@ -184,9 +192,11 @@ def stage_kafka_ops(spec: NemesisSpec, rounds: int, *, n_keys: int,
 
 def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
                       capacity: int = 64, max_sends: int = 2,
-                      resync_every: int = 4, workload_seed: int = 0,
+                      resync_every: int = 4, resync_mode: str = "pull",
+                      workload_seed: int = 0,
                       max_recovery_rounds: int = 48,
                       rounds: int | None = None,
+                      repl_fast: bool | None = None,
                       mesh=None) -> dict:
     """Replicated log under the nemesis: seeded send/commit traffic at
     live nodes through the faulted phase, then quiescent recovery.
@@ -200,7 +210,12 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
     ``rounds``: length of the driven (op-staging) phase — defaults to
     ``spec.clear_round``; raise it to keep traffic flowing past a
     short fault horizon (e.g. the fault-free baseline cell of the
-    sweep, whose clear round is 0)."""
+    sweep, whose clear round is 0).
+
+    ``resync_mode``: the anti-entropy shape — receiver-side union
+    ``"pull"`` (default) or per-origin durable-log ``"push"`` (see
+    KafkaSim).  ``repl_fast=False`` pins the link-mask matmul oracle
+    instead of the faulted origin-union replication."""
     n = spec.n_nodes
     clear = max(spec.clear_round, rounds or 0)
     sks, svs, crs = stage_kafka_ops(
@@ -208,6 +223,7 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
         workload_seed=workload_seed)
     sim = KafkaSim(n, n_keys, capacity=capacity, max_sends=max_sends,
                    fault_plan=spec.compile(), resync_every=resync_every,
+                   resync_mode=resync_mode, repl_fast=repl_fast,
                    mesh=mesh)
     state = sim.init_state()
     if clear > 0:

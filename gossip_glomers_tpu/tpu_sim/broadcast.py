@@ -382,19 +382,44 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
         srv = None
     else:
         deg_topo = nbr_mask.sum(axis=1).astype(jnp.int32)
-        live_deg = live_now.sum(axis=1).astype(jnp.int32)
+        if plan is None:
+            # partition-only regime: every live edge delivers, so the
+            # ack/reply degree IS the live degree and diffs flow over
+            # single live edges
+            ack_edges = live_now
+            diff_edges = live_now
+        else:
+            # LOSS-ONLY plan (crash/dup force the ledger off at
+            # construction): requests are charged at send time like
+            # every message (live_now), but replies exist only when
+            # the triggering request DELIVERED — the outgoing
+            # (row -> neighbor) coin at this round — and a sync pair
+            # exchanges its diff only when BOTH direction coins
+            # survive (read delivered AND read_ok delivered; the diff
+            # pushes then ride the already-delivered direction).  The
+            # flood ack term assumes the sender-edge coin delivered
+            # (the sim does not track per-value senders); windows of
+            # disagreement are one ack per (value, node) whose
+            # sender-edge coin drops during its flood round — exact
+            # otherwise, pinned in test_ledger_calibration.py.
+            src_c = jnp.clip(nbrs, 0, plan.down.shape[1] - 1)
+            out_ok = ~faults.edge_drop(plan, state.t,
+                                       row_ids[:, None], src_c)
+            ack_edges = live_now & out_ok
+            diff_edges = live_del & out_ok
+        ack_deg = ack_edges.sum(axis=1).astype(jnp.int32)
         pcf = _popcount(fr0).sum(axis=1).astype(jnp.uint32)
-        coef = jnp.where(state.t == 0, deg_topo + live_deg,
-                         jnp.maximum(deg_topo + live_deg - 2, 0))
+        coef = jnp.where(state.t == 0, deg_topo + ack_deg,
+                         jnp.maximum(deg_topo + ack_deg - 2, 0))
         flood = jnp.sum(pcf * coef.astype(jnp.uint32), dtype=jnp.uint32)
         base = sync_base_once(
-            jnp.sum(deg_topo + live_deg, dtype=jnp.int32).astype(
+            jnp.sum(deg_topo + ack_deg, dtype=jnp.int32).astype(
                 jnp.uint32))
         # computed every round and masked (a lax.cond would need equal
         # sharding types across branches under shard_map); on sync
         # rounds payload_full IS the widened received set
         diff = _sync_diff_pc(payload_full, rec0, nbrs,
-                             live_now)
+                             diff_edges)
         srv_inc = flood + jnp.where(is_sync, base + 2 * diff,
                                     jnp.uint32(0))
         srv = state.srv_msgs + reduce_sum(srv_inc)
@@ -736,10 +761,16 @@ class BroadcastSim:
         block, absorbed by dedup, charged to the msgs ledger at send
         time) rather than the source's full received set.  On the
         words-major structured path a plan needs the mask bundle:
-        pass ``nemesis=`` (below).  Forces ``srv_ledger`` off (the
-        Maelstrom-parity accounting has no defined semantics for lost
-        acks); the ``msgs`` ledger counts loss at send time and dup
-        re-deliveries as real traffic.
+        pass ``nemesis=`` (below).  The server ledger: LOSS-ONLY
+        plans (no crash windows, no dup) keep it on the gather path —
+        requests charged at send time, replies only when the
+        triggering request's per-round edge coin delivered, sync
+        diffs over both-coin pairs (calibrated against the virtual
+        harness in test_ledger_calibration.py) — while crash or dup
+        (no defined accounting for a process dying mid-round or for
+        re-delivered sets) and every delays/words-major composition
+        force ``srv_ledger`` off; the ``msgs`` ledger counts loss at
+        send time and dup re-deliveries as real traffic either way.
 
         ``nemesis`` (structured.StructuredNemesis, make_nemesis): the
         words-major decomposition of the SAME plan — host-precomputed
@@ -943,15 +974,25 @@ class BroadcastSim:
                 raise ValueError(
                     f"FaultPlan is for {fault_plan.down.shape[1]} "
                     f"nodes, sim has {n}")
-            # The Maelstrom-comparable server ledger has no defined
-            # accounting for lost acks / duplicate streams; under a
-            # plan the value-message ledger (`msgs`, sends counted at
-            # send time, dup re-deliveries included) is the
-            # throughput signal.  (Under per-edge `delays` a dup edge
-            # re-delivers its in-flight payload block — the history
-            # ring stores payload, not received sets — so dup is
-            # state-invisible there and purely ledger-visible.)
-            self._srv_on = False
+            # LOSS-ONLY plans (no crash windows, no dup stream) keep a
+            # DEFINED reference accounting on the gather path: the
+            # per-(t, src, dst) coin makes a round's directed edge
+            # all-or-nothing, so requests are charged at send time
+            # (loss-invisible, like the harness ledger), replies only
+            # when the triggering request's edge-coin delivered, and
+            # sync diffs only where BOTH direction coins survive (the
+            # read AND its read_ok) — see the srv block in _round,
+            # calibrated in test_ledger_calibration.py.  Crash brings
+            # amnesia rows (acks from a process that died mid-round
+            # have no reference semantics) and dup re-delivers whole
+            # received sets — both stay OFF; the value-message ledger
+            # (`msgs`) is the throughput signal there.  Same for the
+            # delays and words-major compositions.
+            loss_only = (int(fault_plan.starts.shape[0]) == 0
+                         and int(fault_plan.dup_num) == 0)
+            if not (loss_only and not self.words_major
+                    and delays is None):
+                self._srv_on = False
         if delays is not None:
             if exchange is not None:
                 raise ValueError("per-edge delays need the gather path")
@@ -1919,9 +1960,12 @@ class BroadcastSim:
         path too."""
         if state.srv_msgs is None:
             raise ValueError(
-                "server-message ledger is off: srv_ledger=False, or a "
+                "server-message ledger is off: srv_ledger=False, a "
                 "words-major run without its sync_diff closure "
-                "(structured.make_sync_diff / make_sharded_sync_diff)")
+                "(structured.make_sync_diff / make_sharded_sync_diff), "
+                "or a FaultPlan beyond the loss-only regime (crash/dup "
+                "have no defined reference accounting; gather-path "
+                "loss-only plans keep the ledger — see __init__)")
         return int(state.srv_msgs)
 
     def inject_mid(self, state: BroadcastState, node: int,

@@ -130,6 +130,12 @@ class CounterSim:
         #   tie-break, matching the packed layout's semantics).  This
         #   lifts the 2^24-node cap to the broadcast path's demonstrated
         #   16.8M+ reach at the cost of one extra pmin per round.
+        #   Both pmins ride the mesh 'nodes' axis directly, so the wide
+        #   winner IS the sharded driver at scale: the compiled sharded
+        #   step carries psum/pmin collectives only — no all-gather
+        #   (pinned by tests/test_engine.py::
+        #   test_counter_wide_sharded_step_hlo_has_no_all_gather, the
+        #   counter twin of the kafka sharded-presence HLO gate).
         # "auto" keeps the measured-and-pinned packed behavior wherever
         # it fits and switches to wide only when it must.
         self._row_bits = max(1, (n_nodes - 1).bit_length())

@@ -175,6 +175,17 @@ class Collectives(NamedTuple):
       pmax, pmin).  ``reduce_sum`` reduces over ALL mesh axes (ledger
       scalars psum linearly across word shards too); min/max reduce over
       the node axis.
+    - ``reduce_or``: bitwise-OR all-reduce over the node axis — the
+      "psum of OR" the sharded kafka presence union rides.  XLA has no
+      OR all-reduce collective for packed words, so on a mesh it is a
+      recursive-doubling (power-of-two axes) or ring ppermute exchange
+      of the per-shard partial: O(log shards) / O(shards) block moves
+      over ICI, collective-permute only — never an all_gather of the
+      operands being reduced.
+    - ``exclusive_sum``: per-element sum of the operand over all LOWER
+      shard indices (zeros on shard 0; identity off-mesh returns
+      zeros) — the cross-shard exclusive prefix a global rank/offset
+      allocation needs, as a Hillis-Steele ppermute scan (log steps).
     - ``local_cols(m)``: this shard's column block of a full (N, N)
       matrix (the replication matmul's destination side).
     - ``axis_name``: the node axis name, or None off-mesh.
@@ -185,6 +196,8 @@ class Collectives(NamedTuple):
     reduce_sum: Callable[[jnp.ndarray], jnp.ndarray]
     reduce_max: Callable[[jnp.ndarray], jnp.ndarray]
     reduce_min: Callable[[jnp.ndarray], jnp.ndarray]
+    reduce_or: Callable[[jnp.ndarray], jnp.ndarray]
+    exclusive_sum: Callable[[jnp.ndarray], jnp.ndarray]
     local_cols: Callable[[jnp.ndarray], jnp.ndarray]
     axis_name: str | None
 
@@ -199,10 +212,43 @@ def collectives(block: int, mesh=None, *, axis: str = "nodes",
         return Collectives(
             row_ids=jnp.arange(block, dtype=jnp.int32),
             widen=ident, reduce_sum=ident, reduce_max=ident,
-            reduce_min=ident, local_cols=ident, axis_name=None)
+            reduce_min=ident, reduce_or=ident,
+            exclusive_sum=jnp.zeros_like,
+            local_cols=ident, axis_name=None)
     axes = tuple(mesh.axis_names)
+    n_sh = int(mesh.shape[axis])
     row_ids = (lax.axis_index(axis) * block
                + jnp.arange(block, dtype=jnp.int32))
+
+    def reduce_or(x):
+        # OR all-reduce via collective-permute only (class docstring):
+        # recursive doubling when the axis is a power of two (each step
+        # pairs shard p with p XOR d), ring otherwise
+        if n_sh & (n_sh - 1) == 0:
+            d = 1
+            while d < n_sh:
+                x = x | lax.ppermute(x, axis,
+                                     [(p ^ d, p) for p in range(n_sh)])
+                d <<= 1
+            return x
+        acc, cur = x, x
+        for _ in range(n_sh - 1):
+            cur = lax.ppermute(cur, axis,
+                               [((p + 1) % n_sh, p) for p in range(n_sh)])
+            acc = acc | cur
+        return acc
+
+    def exclusive_sum(x):
+        # Hillis-Steele inclusive scan over the shard axis (shards below
+        # the stride receive ppermute's missing-source zeros), minus the
+        # local contribution
+        acc, d = x, 1
+        while d < n_sh:
+            acc = acc + lax.ppermute(
+                acc, axis, [(p, p + d) for p in range(n_sh - d)])
+            d <<= 1
+        return acc - x
+
     return Collectives(
         row_ids=row_ids,
         widen=lambda x: lax.all_gather(x, axis, axis=gather_axis,
@@ -210,6 +256,8 @@ def collectives(block: int, mesh=None, *, axis: str = "nodes",
         reduce_sum=lambda x: lax.psum(x, axes),
         reduce_max=lambda x: lax.pmax(x, axis),
         reduce_min=lambda x: lax.pmin(x, axis),
+        reduce_or=reduce_or,
+        exclusive_sum=exclusive_sum,
         local_cols=lambda m: lax.dynamic_slice_in_dim(
             m, lax.axis_index(axis) * block, block, axis=1),
         axis_name=axis)

@@ -38,8 +38,18 @@ bytes and it is a uint8 x uint8 -> int32 matmul the MXU executes
 natively (byte sums of disjoint bits stay <= 255, so int32
 accumulation is exact); the delivered high-water mark then falls out
 of a count-leading-zeros over the delivered words instead of an
-(N, N, K) max intermediate.  Link loss stays a (N, N) boolean mask —
-it is the matmul's lhs.
+(N, N, K) max intermediate.  An EXPLICIT link mask stays an (N, N)
+boolean — it is the matmul's lhs — but the nemesis fault model needs
+no materialized lhs at all: its loss coins are stateless hashes of
+(t, src, dst) and its liveness is a per-column window fold, so the
+faulted full-mesh delivery folds both elementwise into the per-origin
+bits (``repl_mode="union_nem"``) and the matmul survives only as the
+``repl_fast=False`` bit-exactness oracle.  On a mesh the fault-free
+union is a blocked psum-of-OR over ICI (engine ``reduce_or``) and the
+offset linearization is a ppermute prefix scan (engine
+``exclusive_sum``), so the sharded fault-free round compiles with no
+``all-gather`` anywhere (pinned by
+tests/test_engine.py::test_kafka_sharded_step_hlo_has_no_all_gather).
 
 Within a round, sends complete before commits (the round-aligned
 equivalent of a harness scenario that issues sends and commits in
@@ -89,6 +99,10 @@ class KafkaState(NamedTuple):
     present: jnp.ndarray          # (N, K, ceil(C/32)) uint32 bitset
     kv_val: jnp.ndarray           # (K,) int32 — shared lin-kv cell
     local_committed: jnp.ndarray  # (N, K) int32 — kd.commitOffset
+    # (N, K, ceil(C/32)) uint32 under resync_mode="push" (the bits each
+    # node ORIGINATED — the durable per-origin log the push resync
+    # re-replicates from; NOT wiped by amnesia), (N, K, 0) otherwise
+    origin_bits: jnp.ndarray
     t: jnp.ndarray                # () int32
     msgs: jnp.ndarray             # () uint32
 
@@ -126,7 +140,8 @@ class KafkaSim:
                  kv_sched: KVReach | None = None,
                  repl_fast: bool | None = None,
                  fault_plan: "faults.FaultPlan | None" = None,
-                 resync_every: int = 4) -> None:
+                 resync_every: int = 4,
+                 resync_mode: str = "pull") -> None:
         """``kv_sched``: lin-kv reachability windows (counter.KVReach —
         the same nemesis shape the counter's flush is gated by).  A
         node partitioned from lin-kv at round t:
@@ -145,11 +160,17 @@ class KafkaSim:
           gated.
 
         ``repl_fast``: replication-path pick.  None (default) selects
-        the origin-union fast path whenever ``repl_ok`` is omitted or
-        all-True (see :meth:`_round`'s replication block) and the
-        link-mask matmul otherwise; False pins the matmul
-        unconditionally (the parity tests use it to pin the two paths
-        bit-identical).
+        an origin-union fast path whenever ``repl_ok`` is omitted or
+        all-True (see :meth:`_round`'s replication block) — under a
+        crash/loss ``fault_plan`` that is the FAULTED origin-union
+        path, which folds the plan's elementwise (t, src, dst) loss
+        coins and liveness columns directly into the per-origin
+        delivery bits (O(rows·N·S) coin evaluations + one scatter —
+        no materialized N x N lhs, no O(N²·K·C/32) matmul).  An
+        explicit non-full ``repl_ok`` matrix takes the link-mask
+        matmul; False pins the matmul unconditionally — it is the
+        bit-exactness ORACLE the parity tests (and BENCH_PR4's faulted
+        rows) hold the fast paths against.
 
         ``fault_plan`` (tpu_sim/faults.py): the crash/loss nemesis.  A
         down node cannot allocate, commit, receive replicate_msgs, or
@@ -159,18 +180,29 @@ class KafkaSim:
         cells and the log content survive (the service is durable).
         The plan's loss stream drops individual replicate deliveries
         in flight (the reference's acks=0 stance) and per-round KV
-        exchanges.  Crash/loss pin the link-mask matmul replication
-        path (the origin-union shortcut assumes every link delivers);
-        duplicate delivery is inert here — replicate inserts are
-        idempotent on (key, offset) (logmap.go:315-317), bit-OR in
-        this model.
+        exchanges.  Duplicate delivery is inert here — replicate
+        inserts are idempotent on (key, offset) (logmap.go:315-317),
+        bit-OR in this model.
 
         ``resync_every``: with a plan, every ``resync_every``-th round
-        each LIVE node pulls the union of the live peers' presence
-        (and max-bumps its committed cache from it) — the anti-entropy
-        repair loop that re-replicates what crashed origins appended
-        and what loss dropped, so runs converge after faults clear.
-        Inert without a plan (the fault-free paths are untouched)."""
+        the anti-entropy repair loop runs, so runs converge after
+        faults clear.  Inert without a plan (the fault-free paths are
+        untouched).  Two shapes, picked by ``resync_mode``:
+
+        - ``"pull"`` (default): each LIVE node pulls the union of the
+          live peers' presence (and max-bumps its committed cache from
+          it) — 2 ledger msgs per live node per resync round.
+        - ``"push"``: each LIVE node with any DURABLE own appends
+          re-replicates its OWN appends from the durable log to every
+          peer (the reference's restart recovery message shape:
+          re-running sendReplicateMsg off the log) — ``N - 1``
+          replicate msgs per pusher.  Tracks the per-origin bits in
+          ``KafkaState.origin_bits`` (durable: survives amnesia, like
+          the log content).  A bit whose origin is DOWN at a resync
+          round is NOT re-replicated until the origin restarts —
+          narrower per-round coverage than the pull union, same
+          converged fixpoint once every origin has been live for a
+          resync round."""
         self.n_nodes = n_nodes
         self.n_keys = n_keys
         self.capacity = capacity
@@ -185,13 +217,17 @@ class KafkaSim:
         self.repl_fast = repl_fast
         self.fault_plan = fault_plan
         self.resync_every = resync_every
+        if resync_mode not in ("pull", "push"):
+            raise ValueError(f"unknown resync_mode {resync_mode!r}")
+        self.resync_mode = resync_mode
+        self._push = resync_mode == "push"
         if fault_plan is not None \
                 and fault_plan.down.shape[1] != n_nodes:
             raise ValueError(
                 f"FaultPlan is for {fault_plan.down.shape[1]} nodes, "
                 f"sim has {n_nodes}")
-        # crash windows or loss force the matmul path; a dup-only plan
-        # is inert here (idempotent replicate inserts)
+        # a crash/loss plan drives the replication masks (a dup-only
+        # plan is inert here: idempotent replicate inserts)
         self._fp_active = fault_plan is not None and (
             int(fault_plan.starts.shape[0]) > 0
             or int(fault_plan.loss_num) > 0)
@@ -202,17 +238,19 @@ class KafkaSim:
 
     def init_state(self) -> KafkaState:
         n, k, c = self.n_nodes, self.n_keys, self.capacity
+        wo = self.n_pwords if self._push else 0
         state = KafkaState(
             log_vals=jnp.full((k, c), -1, jnp.int32),
             present=jnp.zeros((n, k, self.n_pwords), jnp.uint32),
             kv_val=jnp.zeros((k,), jnp.int32),
             local_committed=jnp.zeros((n, k), jnp.int32),
+            origin_bits=jnp.zeros((n, k, wo), jnp.uint32),
             t=jnp.int32(0), msgs=jnp.uint32(0))
         if self.mesh is not None:
+            node3 = NamedSharding(self.mesh, P("nodes", None, None))
             state = state._replace(
-                present=jax.device_put(
-                    state.present,
-                    NamedSharding(self.mesh, P("nodes", None, None))),
+                present=jax.device_put(state.present, node3),
+                origin_bits=jax.device_put(state.origin_bits, node3),
                 local_committed=jax.device_put(
                     state.local_committed,
                     NamedSharding(self.mesh, P("nodes", None))))
@@ -222,81 +260,114 @@ class KafkaSim:
 
     def _round(self, state: KafkaState, send_key, send_val, commit_req,
                repl_ok, sched: KVReach, coll, *,
-               repl_full: bool = False, plan=None) -> KafkaState:
+               repl_mode: str = "union", plan=None) -> KafkaState:
         """One round: allocate + append + replicate, then commit.
 
-        send_key/send_val: (rows, S) int32, key = -1 for no-op.
-        commit_req: (rows, K) int32, -1 for no commit of that key.
+        send_key/send_val: (rows, S) int32 LOCAL batch rows, key = -1
+        for no-op.  commit_req: (rows, K) int32, -1 for no commit of
+        that key.
         repl_ok: (N, N) bool — repl_ok[o, d]: o's replicate_msg reaches
-        d; None (with ``repl_full=True``) for the lossless full mesh.
+        d; None outside ``repl_mode="matmul"``.
         sched: lin-kv reachability windows (see __init__) — blocked
         nodes' sends fail allocation and their active commit dances
         time out.
         coll: the engine collective surface (identity single-device;
-        all_gather / psum / pmax / pmin over 'nodes' under shard_map).
-        repl_full (static): every link delivers — replication collapses
-        to the origin-union fast path (see the replication block).
+        psum / pmax / pmin / ppermute reduce_or / exclusive_sum over
+        'nodes' under shard_map).
+        repl_mode (static): the replication path —
+
+        - ``"union"``: lossless full mesh.  Each shard scatters its
+          LOCAL new-append bits into a (K, Wc) partial union and the
+          shards combine with ``reduce_or`` (recursive-doubling
+          ppermutes): O(K·Wc) per shard, zero all_gather anywhere in
+          the round (allocation included — see the prefix-scan below).
+        - ``"union_nem"``: full mesh under a crash/loss plan.  The
+          plan's (t, src, dst) loss coins and liveness columns fold
+          ELEMENTWISE into the per-origin delivery bits: each shard
+          evaluates (rows, N·S) coins against the widened per-send
+          metadata and scatters the surviving bits — no N x N lhs is
+          ever materialized, no matmul.  Own appends ride via the
+          origin == dest term (a node always keeps its own append).
+        - ``"matmul"``: the link-mask byte-split MXU matmul — the
+          general-``repl_ok`` path and the bit-exactness ORACLE for
+          both unions (``repl_fast=False`` pins it).
+
         plan (traced FaultPlan operand): amnesia rows, liveness/loss
         gating, and the periodic presence resync — see __init__.
         """
         row_ids = coll.row_ids
         widen, reduce_sum = coll.widen, coll.reduce_sum
         reduce_max, reduce_min = coll.reduce_max, coll.reduce_min
+        reduce_or, exclusive_sum = coll.reduce_or, coll.exclusive_sum
         local_cols = coll.local_cols
         n, k_dim, cap = self.n_nodes, self.n_keys, self.capacity
-        s_dim = send_key.shape[1]
+        rows, s_dim = send_key.shape
         big = jnp.int32(n + 1)
-        # who can reach lin-kv this round — computed over the GLOBAL
-        # node axis (send linearization is global), tiny arrays
-        reach = _reach(state.t, jnp.arange(n, dtype=jnp.int32), sched)
-        up = None
+        # who can reach lin-kv this round — LOCAL rows only (every
+        # cross-shard combine below is a collective, not a gather)
+        reach = _reach(state.t, row_ids, sched)
+        up_rows = None
         if plan is not None:
-            ids = jnp.arange(n, dtype=jnp.int32)
-            up = faults.node_up(plan, state.t, ids)          # (N,)
-            wipe_rows = faults.amnesia(plan, state.t, ids)[row_ids]
+            wipe_rows = faults.amnesia(plan, state.t, row_ids)
             # amnesia: a crashing node's in-memory presence bitset and
             # committed-offset cache die with the process (survives:
-            # log content and the lin-kv cells — the service is
-            # durable); it restarts empty when the window ends
+            # log content, the lin-kv cells, and the per-origin
+            # origin_bits — the durable side); it restarts empty when
+            # the window ends
             state = state._replace(
                 present=jnp.where(wipe_rows[:, None, None],
                                   jnp.uint32(0), state.present),
                 local_committed=jnp.where(wipe_rows[:, None], 0,
                                           state.local_committed))
+            up_rows = faults.node_up(plan, state.t, row_ids)
             # down nodes cannot reach the KV; loss eats one round's
             # exchange (retried next round, like a 1-round window)
-            reach = reach & up & ~faults.kv_drop(plan, state.t, ids)
+            reach = reach & up_rows & ~faults.kv_drop(plan, state.t,
+                                                      row_ids)
 
-        # -- offset allocation (global, linearized in (node, slot) order:
-        #    the reference's lin-kv CAS loop, logmap.go:255-285).  The
-        #    shared cell holds the NEXT offset; missing key reads as
-        #    defaultOffset = 1 (logmap.go:262-266).
+        # -- offset allocation (globally linearized in (node, slot)
+        #    order: the reference's lin-kv CAS loop, logmap.go:255-285).
+        #    The shared cell holds the NEXT offset; missing key reads
+        #    as defaultOffset = 1 (logmap.go:262-266).  Decomposed
+        #    shard-locally: global rank = local rank within the shard
+        #    + exclusive prefix (over lower shards) of per-key valid
+        #    counts — a ppermute scan of a (K,) vector, so the send
+        #    batch is never all_gather-ed.
         current = jnp.where(state.kv_val > 0, state.kv_val, 1)  # (K,)
-        all_key = widen(send_key).reshape(-1)            # (N*S,)
-        all_val = widen(send_val).reshape(-1)
-        tried = all_key >= 0
-        if up is not None:
+        loc_key = send_key.reshape(-1)                   # (rows*S,)
+        loc_val = send_val.reshape(-1)
+        tried = loc_key >= 0
+        if up_rows is not None:
             # a down node submits nothing: its batch rows are dead ops,
             # not charged-and-timed-out ones
-            tried = tried & jnp.repeat(up, s_dim)
+            tried = tried & jnp.repeat(up_rows, s_dim)
         # a KV-blocked send never allocates: the read times out and the
         # node aborts after one attempt (models/kafka.py alloc_offset)
         valid = tried & jnp.repeat(reach, s_dim)
-        keys_c = jnp.clip(all_key, 0, k_dim - 1)
-        rank = _rank_within_key(keys_c, valid)
-        offset = current[keys_c] + rank                  # (N*S,)
+        keys_c = jnp.clip(loc_key, 0, k_dim - 1)
+        cnt_valid = jnp.zeros((k_dim,), jnp.int32).at[keys_c].add(
+            valid.astype(jnp.int32))
+        rank = (_rank_within_key(keys_c, valid)
+                + exclusive_sum(cnt_valid)[keys_c])
+        offset = current[keys_c] + rank                  # (rows*S,)
         slot = offset - 1
         ok = valid & (slot < cap)
 
-        # -- append: content is global (offsets unique ⇒ no conflicts).
-        # Invalid entries scatter to an out-of-bounds row and are dropped
-        # (in-bounds dummy slots would race real writes).
+        # -- append: content is global (offsets unique ⇒ no conflicts
+        #    across shards), so the replicated log_vals update is a
+        #    psum of disjoint per-shard write scatters.  Invalid
+        #    entries scatter to an out-of-bounds row and are dropped
+        #    (in-bounds dummy slots would race real writes).
         scat_k = jnp.where(ok, keys_c, jnp.int32(k_dim))
         scat_c = jnp.where(ok, slot, 0)
-        log_vals = state.log_vals.at[scat_k, scat_c].set(
-            all_val, mode="drop")
-        counts = jnp.zeros((k_dim,), jnp.int32).at[keys_c].add(
-            ok.astype(jnp.int32))
+        wrote = reduce_sum(jnp.zeros((k_dim, cap), jnp.int32).at[
+            scat_k, scat_c].add(ok.astype(jnp.int32), mode="drop"))
+        wvals = reduce_sum(jnp.zeros((k_dim, cap), jnp.int32).at[
+            scat_k, scat_c].add(jnp.where(ok, loc_val, 0),
+                                mode="drop"))
+        log_vals = jnp.where(wrote > 0, wvals, state.log_vals)
+        counts = reduce_sum(jnp.zeros((k_dim,), jnp.int32).at[
+            keys_c].add(ok.astype(jnp.int32)))
         kv_sent = jnp.where(counts > 0, current + counts, state.kv_val)
 
         # -- replication.  Offsets are globally unique per key, so every
@@ -304,39 +375,66 @@ class KafkaSim:
         #    bits is scatter-OR and the words are DISJOINT across
         #    origins.
         wc = self.n_pwords
-        origin = jnp.repeat(jnp.arange(n, dtype=jnp.int32), s_dim)
         slot_ok = jnp.where(ok, slot, 0)
+        word_idx = slot_ok // 32
         bit = jnp.where(ok, jnp.uint32(1)
                         << (slot_ok % 32).astype(jnp.uint32),
                         jnp.uint32(0))
-        if repl_full:
-            # Full-mesh fast path (repl_ok all-True, the fire-and-
-            # forget default): every node receives every replicate_msg,
-            # so delivery is ONE origin-union of the new-append bits —
-            # an O(K*Wc) scatter instead of the O(N^2*K*Wc) link-mask
-            # matmul below, with the per-origin (N, K, Wc) new_words
-            # buffer never materialized.  The union is computed
-            # identically on every shard from the widened send batch
-            # (zero ICI), and it contains each node's OWN appends too
-            # (the full mesh includes the self link), so it is
-            # bit-identical to the all-ones matmul delivery.
-            deliver = jnp.zeros((k_dim, wc), jnp.uint32).at[
-                scat_k, slot_ok // 32].add(bit, mode="drop")[None]
+        # this shard's own new-append words (rows, K, Wc) — the matmul
+        # path's local new_words block, the push resync's durable
+        # origin record, and the source of every union partial
+        i_loc = jnp.repeat(jnp.arange(rows, dtype=jnp.int32), s_dim)
+        own_words = jnp.zeros((rows, k_dim, wc), jnp.uint32).at[
+            i_loc, scat_k, word_idx].add(bit, mode="drop")
+        if repl_mode == "union":
+            # blocked psum-of-OR: per-shard partial union combined over
+            # ICI by recursive-doubling ppermutes (engine.reduce_or) —
+            # O(K·Wc) per shard, the union already contains every
+            # node's OWN appends (the full mesh includes the self
+            # link), bit-identical to the all-ones matmul delivery.
+            deliver = reduce_or(jnp.zeros((k_dim, wc), jnp.uint32).at[
+                scat_k, word_idx].add(bit, mode="drop"))[None]
+            present = state.present | deliver
+        elif repl_mode == "union_nem":
+            # faulted origin-union: the coins need (origin, dest)
+            # pairs, so widen the tiny per-send metadata ((N, S) ints —
+            # the ONE gather of this path; presence never moves) and
+            # fold liveness + the loss stream elementwise into the
+            # delivery bits.  bit == 0 already encodes "no append"
+            # (ok ⇒ bit >= 1), and a capacity-dropped key scatters out
+            # of bounds, so no separate ok mask is needed.
+            g_bit = widen(bit.reshape(rows, s_dim)).reshape(-1)
+            g_k = widen(scat_k.reshape(rows, s_dim)).reshape(-1)
+            g_w = widen(word_idx.reshape(rows, s_dim)).reshape(-1)
+            g_origin = jnp.repeat(jnp.arange(n, dtype=jnp.int32), s_dim)
+            # dest down ⇒ nothing lands; the origin's own append always
+            # lands (ok ⇒ origin was up); otherwise the delivery coin
+            # at the send round decides (fire-and-forget,
+            # log.go:159-175 — nothing retries a dropped replicate)
+            recv = ((up_rows[:, None]
+                     & ~faults.edge_drop(plan, state.t,
+                                         g_origin[None, :],
+                                         row_ids[:, None]))
+                    | (g_origin[None, :] == row_ids[:, None]))
+            deliver = jnp.zeros((rows, k_dim, wc), jnp.uint32).at[
+                :, g_k, g_w].add(
+                jnp.where(recv, g_bit[None, :], jnp.uint32(0)),
+                mode="drop")
             present = state.present | deliver
         else:
-            if up is not None:
-                # the plan drives the replication matrix per round:
-                # both endpoints up, delivery coin survives the loss
-                # stream (fire-and-forget, log.go:159-175 — nothing
-                # retries a dropped replicate)
+            if up_rows is not None:
+                # explicit link mask composed with the plan: both
+                # endpoints up, delivery coin survives the loss stream
                 ids = jnp.arange(n, dtype=jnp.int32)
-                repl_ok = (repl_ok & up[:, None] & up[None, :]
+                up_all = faults.node_up(plan, state.t, ids)
+                repl_ok = (repl_ok & up_all[:, None] & up_all[None, :]
                            & ~faults.edge_drop(plan, state.t,
                                                ids[:, None],
                                                ids[None, :]))
-            # new appends per origin node, bit-packed: (N, K, Wc).
-            new_words = jnp.zeros((n, k_dim, wc), jnp.uint32).at[
-                origin, scat_k, slot_ok // 32].add(bit, mode="drop")
+            # new appends per origin node, bit-packed: (N, K, Wc) —
+            # the all_gather of the per-shard own blocks (the oracle
+            # path keeps the full operand).
+            new_words = widen(own_words)
             # the masked OR over origins IS a matmul (fire-and-forget
             # with link loss, log.go:159-175): disjoint bits make
             # OR == SUM, so split the words into bytes and ride the
@@ -349,7 +447,6 @@ class KafkaSim:
             # (identity single-device): each shard does rows/N of the
             # matmul and lands its (rows, ...) delivery block directly
             repl_local = local_cols(repl_ok)             # (N, rows)
-            rows = repl_local.shape[1]
             deliver_b = lax.dot_general(
                 repl_local.astype(jnp.uint8),
                 nb.reshape(n, k_dim * wc * 4),
@@ -358,7 +455,7 @@ class KafkaSim:
             db = deliver_b.astype(jnp.uint32).reshape(rows, k_dim, wc, 4)
             deliver = (db[..., 0] | (db[..., 1] << 8)
                        | (db[..., 2] << 16) | (db[..., 3] << 24))
-            present = state.present | deliver | new_words[row_ids]
+            present = state.present | deliver | own_words
 
         # -- local HWM after sends: own append sets kd.commitOffset
         #    unconditionally (logmap.go:298; == max here, offsets grow),
@@ -367,51 +464,73 @@ class KafkaSim:
         # + 1, straight off the delivered words via count-leading-zeros
         # (no (N, N, K) max intermediate)
         word_base = (jnp.arange(wc, dtype=jnp.int32) * 32)[None, None, :]
-        top = jnp.where(deliver > 0,
-                        word_base + 32 - lax.clz(deliver).astype(
-                            jnp.int32),
-                        0)
-        deliv_off = jnp.max(top, axis=2)         # (rows, K) / (1, K)
-        if repl_full:
-            # the union delivery contains every own append, so its top
-            # bit already covers the unconditional own-append bump
-            hwm = jnp.maximum(state.local_committed, deliv_off)
-        else:
-            own_off = jnp.zeros((n, k_dim), jnp.int32).at[
-                origin, scat_k].max(jnp.where(ok, offset, 0),
-                                    mode="drop")
+
+        def top_off(words):
+            return jnp.max(jnp.where(
+                words > 0,
+                word_base + 32 - lax.clz(words).astype(jnp.int32),
+                0), axis=2)
+
+        deliv_off = top_off(deliver)             # (rows, K) / (1, K)
+        if repl_mode == "matmul":
+            # the union deliveries contain every own append (full-mesh
+            # self link / the origin == dest term), so their top bit
+            # already covers the unconditional own-append bump; the
+            # masked matmul may exclude it, so bump explicitly
             hwm = jnp.maximum(state.local_committed,
-                              jnp.maximum(own_off[row_ids], deliv_off))
+                              jnp.maximum(top_off(own_words),
+                                          deliv_off))
+        else:
+            hwm = jnp.maximum(state.local_committed, deliv_off)
+
+        # -- durable per-origin record (push resync only): every append
+        #    a node ever made, bit-packed — survives amnesia like the
+        #    log content (the reference's durable log per origin)
+        origin_bits = state.origin_bits
+        if self._push:
+            origin_bits = origin_bits | own_words
 
         # -- presence resync (plan only): every resync_every-th round
-        #    each LIVE node pulls the union of live peers' presence —
-        #    the anti-entropy that re-replicates crashed origins'
-        #    appends and loss-dropped deliveries (observably what the
-        #    reference would get from re-running sendReplicateMsg off
-        #    the durable log after a restart).  Pulled bits max-bump
+        #    the anti-entropy repair loop re-replicates what crashed
+        #    origins appended and what loss dropped.  Pull mode: each
+        #    LIVE node takes the union of live peers' presence.  Push
+        #    mode: each LIVE origin with durable appends re-replicates
+        #    its OWN origin_bits to every live peer (the reference's
+        #    restart recovery shape: re-running sendReplicateMsg off
+        #    the durable log).  Either way the landed bits max-bump
         #    the committed cache exactly like replicate deliveries
         #    (logmap.go:309-311).
         n_resync = jnp.uint32(0)
         if plan is not None:
             is_rs = ((state.t % jnp.int32(self.resync_every) == 0)
                      & (state.t > 0))
-            pres_full = widen(present)               # (N, K, Wc)
-            union = lax.reduce(
-                jnp.where(up[:, None, None], pres_full, jnp.uint32(0)),
-                jnp.uint32(0), lax.bitwise_or, (0,))  # (K, Wc)
-            take = is_rs & up[row_ids]
+            if self._push:
+                pushers = up_rows & jnp.any(origin_bits > 0,
+                                            axis=(1, 2))
+                union = reduce_or(lax.reduce(
+                    jnp.where(pushers[:, None, None], origin_bits,
+                              jnp.uint32(0)),
+                    jnp.uint32(0), lax.bitwise_or, (0,)))  # (K, Wc)
+                # ledger: one fire-and-forget replicate batch per
+                # (pusher, peer) pair per resync round
+                n_resync = (reduce_sum(jnp.sum(jnp.where(
+                    is_rs, pushers, False).astype(jnp.uint32)))
+                    * jnp.uint32(n - 1))
+            else:
+                union = reduce_or(lax.reduce(
+                    jnp.where(up_rows[:, None, None], present,
+                              jnp.uint32(0)),
+                    jnp.uint32(0), lax.bitwise_or, (0,)))  # (K, Wc)
+            take = is_rs & up_rows
             sync_new = jnp.where(take[:, None, None],
-                                 union & ~present, jnp.uint32(0))
+                                 union[None] & ~present, jnp.uint32(0))
             present = present | sync_new
-            top_rs = jnp.where(sync_new > 0,
-                               word_base + 32
-                               - lax.clz(sync_new).astype(jnp.int32),
-                               0)
-            hwm = jnp.maximum(hwm, jnp.max(top_rs, axis=2))
-            # ledger: one pull request + one response per live node
-            # per resync round
-            n_resync = reduce_sum(jnp.sum(
-                take.astype(jnp.uint32))) * jnp.uint32(2)
+            hwm = jnp.maximum(hwm, top_off(sync_new))
+            if not self._push:
+                # ledger: one pull request + one response per live
+                # node per resync round
+                n_resync = reduce_sum(jnp.sum(
+                    take.astype(jnp.uint32))) * jnp.uint32(2)
 
         # -- commits (after this round's sends).  Local skip when the
         #    HWM covers the request (logmap.go:247-251); otherwise the
@@ -438,17 +557,16 @@ class KafkaSim:
         # commit of 0 would write the cell's "missing" sentinel, so it
         # is treated as a no-op rather than allowed to desync the cell
         want = req >= 1
-        if up is not None:
+        if up_rows is not None:
             # down nodes submit no commits (dead ops, not timed-out
             # dances)
-            want = want & up[row_ids][:, None]
+            want = want & up_rows[:, None]
         skip = want & (hwm > 0) & (hwm >= req)
         dance = want & ~skip
         # KV-blocked active dances time out and re-run kv_retries times
         # (logmap.go:177-181), then give up: no contention, no learn
-        reach_rows = reach[row_ids]
-        active = dance & reach_rows[:, None]
-        blocked_commit = dance & ~reach_rows[:, None]
+        active = dance & reach[:, None]
+        blocked_commit = dance & ~reach[:, None]
         exists = (kv_sent > 0)[None, :]
         readv = kv_sent[None, :]
         read_only = active & exists & (req <= readv)
@@ -479,28 +597,28 @@ class KafkaSim:
         #    race to the r earlier ones, so the reference's allocation
         #    loop (logmap.go:255-285) serializes into r+1 attempts of
         #    read + read_ok + cas + cas-reply = 4 messages each, capped
-        #    at defaultKVRetries (logmap.go:19).  `rank` is global and
-        #    identical on every shard, so its sum is NOT psum-reduced.
+        #    at defaultKVRetries (logmap.go:19).  Sums are per-shard
+        #    partials over the LOCAL batch rows, psum-combined.
         #    Commits: 2 per active dance (read + reply) + 2 more when it
         #    writes (CAS or create-write leg, winners and losers alike);
         #    locally-skipped commits cost nothing.
         #    Replication: N-1 fire-and-forget replicate_msg per send.
         attempts = jnp.minimum(rank + 1, self.kv_retries)
-        kv_send_msgs = jnp.sum(
+        kv_send_msgs = reduce_sum(jnp.sum(
             jnp.where(valid, 4 * attempts, 0).astype(jnp.uint32),
-            dtype=jnp.uint32)
+            dtype=jnp.uint32))
         # KV-blocked sends: 1 dropped read request each (the model
         # aborts allocation after one timed-out attempt); blocked
         # active commits: kv_retries dropped read requests each.
         # Requests count at send time, like every other ledger here.
-        blocked_send_msgs = jnp.sum(
-            (tried & ~valid).astype(jnp.uint32), dtype=jnp.uint32)
+        blocked_send_msgs = reduce_sum(jnp.sum(
+            (tried & ~valid).astype(jnp.uint32), dtype=jnp.uint32))
         # replication fires only for ALLOCATED sends (no offset -> no
         # append -> no replicate_msg, log.go:66-77) — `ok`, not
         # `valid`: a capacity-overflow send pays its KV attempts but
-        # never appends.  `ok` is global like `rank`, so its sum is
-        # NOT psum-reduced.
-        n_sends = jnp.sum(ok.astype(jnp.uint32), dtype=jnp.uint32)
+        # never appends.
+        n_sends = reduce_sum(jnp.sum(ok.astype(jnp.uint32),
+                                     dtype=jnp.uint32))
         n_active = reduce_sum(jnp.sum(active.astype(jnp.uint32)))
         n_blocked_c = reduce_sum(jnp.sum(
             blocked_commit.astype(jnp.uint32)))
@@ -512,44 +630,48 @@ class KafkaSim:
                 + n_blocked_c * jnp.uint32(self.kv_retries)
                 + n_resync)
         return KafkaState(log_vals, present, kv_val,
-                          local_committed, state.t + 1, msgs)
+                          local_committed, origin_bits,
+                          state.t + 1, msgs)
 
     def _state_spec(self):
         return KafkaState(P(None, None), P("nodes", None, None),
-                          P(), P("nodes", None), P(), P())
+                          P(), P("nodes", None),
+                          P("nodes", None, None), P(), P())
 
-    def _repl_full(self, repl_ok) -> bool:
-        """Host-side path pick: the origin-union fast path applies when
-        every link delivers (``repl_ok`` omitted or all-True) unless the
-        constructor pinned ``repl_fast=False`` — or a crash/loss
-        FaultPlan is active (the union shortcut assumes every link
-        delivers; the plan's per-round masks need the matmul's lhs)."""
+    def _repl_mode(self, repl_ok) -> str:
+        """Host-side path pick (see :meth:`_round`): the origin-union
+        fast paths apply when every link delivers (``repl_ok`` omitted
+        or all-True) — ``"union_nem"`` with an active crash/loss plan,
+        ``"union"`` without — unless the constructor pinned
+        ``repl_fast=False``, which keeps the link-mask matmul as the
+        bit-exactness oracle.  An explicit non-full ``repl_ok`` always
+        takes the matmul (the mask is its lhs)."""
         if self.repl_fast is False:
-            return False
-        if self._fp_active:
-            return False
-        return repl_ok is None or bool(np.all(repl_ok))
+            return "matmul"
+        if not (repl_ok is None or bool(np.all(repl_ok))):
+            return "matmul"
+        return "union_nem" if self._fp_active else "union"
 
-    def _step_prog(self, repl_full: bool):
+    def _step_prog(self, repl_mode: str):
         """The one-round program, keyed by the (static) replication
-        path.  check_vma=False on a mesh: log_vals/kv_val are computed
-        identically on every shard from all_gather-ed send batches —
-        genuinely replicated, but derived from gathered
-        (varying-marked) values, which the static replication checker
-        cannot prove."""
-        if repl_full not in self._step_progs:
+        path.  check_vma=False on a mesh: log_vals/kv_val are combined
+        across shards by psums of disjoint partials — genuinely
+        replicated, but the static replication checker cannot prove
+        values derived from collectives over varying-marked inputs."""
+        if repl_mode not in self._step_progs:
             mesh = self.mesh
             fp = self._fp_active
+            matmul = repl_mode == "matmul"
 
             def step(state, send_key, send_val, commit_req, *rest):
                 rest = list(rest)
                 plan = rest.pop() if fp else None
                 sched = rest.pop()
-                repl = None if repl_full else rest.pop()
+                repl = rest.pop() if matmul else None
                 coll = collectives(send_key.shape[0], mesh)
                 return self._round(state, send_key, send_val,
                                    commit_req, repl, sched, coll,
-                                   repl_full=repl_full, plan=plan)
+                                   repl_mode=repl_mode, plan=plan)
 
             if mesh is None:
                 prog = jit_program(step)
@@ -557,14 +679,14 @@ class KafkaSim:
                 node2 = P("nodes", None)
                 state_spec = self._state_spec()
                 in_specs = ((state_spec, node2, node2, node2)
-                            + (() if repl_full else (P(None, None),))
+                            + ((P(None, None),) if matmul else ())
                             + (KVReach(P(), P(), P(None, None)),)
                             + ((faults.plan_specs(),) if fp else ()))
                 prog = jit_program(step, mesh=mesh, in_specs=in_specs,
                                    out_specs=state_spec,
                                    check_vma=False)
-            self._step_progs[repl_full] = prog
-        return self._step_progs[repl_full]
+            self._step_progs[repl_mode] = prog
+        return self._step_progs[repl_mode]
 
     def run_rounds(self, state: KafkaState, send_key: np.ndarray,
                    send_val: np.ndarray,
@@ -590,10 +712,11 @@ class KafkaSim:
         # broadcast constant, `want = req >= 1` folds to False and XLA
         # dead-codes the whole commit pipeline.
         has_commits = commit_req is not None
-        repl_full = self._repl_full(repl_ok)
-        if not repl_full and repl_ok is None:
+        repl_mode = self._repl_mode(repl_ok)
+        matmul = repl_mode == "matmul"
+        if matmul and repl_ok is None:
             repl_ok = np.ones((self.n_nodes, self.n_nodes), bool)
-        key = (has_commits, repl_full, donate)
+        key = (has_commits, repl_mode, donate)
         if key not in self._run_rounds:
             k_dim = self.n_keys
             mesh = self.mesh
@@ -604,7 +727,7 @@ class KafkaSim:
                 rest = list(rest)
                 plan = rest.pop() if fp else None
                 sched = rest.pop()
-                repl = None if repl_full else rest.pop()
+                repl = rest.pop() if matmul else None
                 coll = collectives(sks.shape[1], mesh)
 
                 def body(s, xs):
@@ -612,7 +735,7 @@ class KafkaSim:
                     cr = (xs[2] if has_commits else jnp.full(
                         (sk.shape[0], k_dim), -1, jnp.int32))
                     return self._round(s, sk, sv, cr, repl, sched,
-                                       coll, repl_full=repl_full,
+                                       coll, repl_mode=repl_mode,
                                        plan=plan)
 
                 xs = ((sks, svs) + ((rest[0],) if has_commits
@@ -626,7 +749,7 @@ class KafkaSim:
                 state_spec = self._state_spec()
                 in_specs = ((state_spec, node3, node3)
                             + ((node3,) if has_commits else ())
-                            + (() if repl_full else (P(None, None),))
+                            + ((P(None, None),) if matmul else ())
                             + (KVReach(P(), P(), P(None, None)),)
                             + ((faults.plan_specs(),) if fp else ()))
                 prog = jit_program(run, mesh=mesh, in_specs=in_specs,
@@ -640,7 +763,7 @@ class KafkaSim:
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(None, "nodes", None))
             args = [jax.device_put(a, sh) for a in args]
-        if not repl_full:
+        if matmul:
             args.append(jnp.asarray(repl_ok))
         args.append(self.kv_sched)
         if self._fp_active:
@@ -668,8 +791,9 @@ class KafkaSim:
             send_val = np.zeros((n, s), np.int32)
         if commit_req is None:
             commit_req = np.full((n, k), -1, np.int32)
-        repl_full = self._repl_full(repl_ok)
-        if not repl_full and repl_ok is None:
+        repl_mode = self._repl_mode(repl_ok)
+        matmul = repl_mode == "matmul"
+        if matmul and repl_ok is None:
             repl_ok = np.ones((n, n), bool)
         args = [jnp.asarray(send_key, jnp.int32),
                 jnp.asarray(send_val, jnp.int32),
@@ -677,12 +801,12 @@ class KafkaSim:
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P("nodes", None))
             args = [jax.device_put(a, sh) for a in args]
-        if not repl_full:
+        if matmul:
             args.append(jnp.asarray(repl_ok))
         args.append(self.kv_sched)
         if self._fp_active:
             args.append(self.fault_plan)
-        return self._step_prog(repl_full)(state, *args)
+        return self._step_prog(repl_mode)(state, *args)
 
     # -- host-side reads (reference read semantics) ------------------------
 

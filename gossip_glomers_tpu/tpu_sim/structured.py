@@ -83,6 +83,33 @@ def _roll_fold_window() -> tuple[int, int]:
     return ROLL_FOLD_W
 
 
+# FAULTED-round path pick by words count (the BENCH_PR3 n_values=2048
+# i.e. W=64 tree row regression, resolved in PR 4): on the CPU BACKEND
+# the words-major
+# faulted round loses to the adjacency gather once the words axis is
+# wide — XLA:CPU gathers rows at cache speed while the masked
+# structured round re-touches the full (W, N) payload once per
+# direction, so the measured crossover sits at W ≈ 8 at 1024 nodes
+# (BENCH_PR4.json words_threshold rows: 1.8x at W=1, parity at W=8,
+# 0.57-0.75x at W=16-64).  On TPU the structured path wins at every W
+# (the recorded 60-190x tile-granularity effect — a TPU reads a full
+# 8x128 tile per gathered row), so the fallback applies to CPU only.
+# Read once at import, like ROLL_FOLD_W; performance-only (both paths
+# are pinned bit-identical by tests/test_nemesis.py).
+NEM_GATHER_MIN_W = int(os.environ.get("GG_NEM_GATHER_MIN_W", "8"))
+
+
+def faulted_path_pick(n_words: int, backend: str | None = None) -> str:
+    """``"structured"`` or ``"gather"`` — the faster faulted-round path
+    for ``n_words`` bitset words on ``backend`` (default: the current
+    JAX backend).  Used by harness.nemesis.run_broadcast_nemesis's
+    ``structured="auto"`` mode; see :data:`NEM_GATHER_MIN_W`."""
+    backend = backend or jax.default_backend()
+    if backend == "cpu" and n_words >= NEM_GATHER_MIN_W:
+        return "gather"
+    return "structured"
+
+
 def _zeros(payload: jnp.ndarray, n: int) -> jnp.ndarray:
     return jnp.zeros(payload.shape[:-1] + (n,), payload.dtype)
 

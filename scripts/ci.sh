@@ -22,4 +22,12 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
 # dedicated nemesis tests.  (CPU, seconds.)
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python scripts/fault_smoke.py || rc=1
+# Kafka scale smoke (PR 4): 4-device sharded-kafka parity (union +
+# faulted origin-union, no all-gather in the sharded step HLO) + the
+# kafka mesh-takeover at a small shape on the 8-way virtual mesh.
+# (CPU, seconds.)  Outer budget > the smoke's inner 600 s subprocess
+# timeout so a wedged takeover surfaces its diagnostic dict instead
+# of a bare SIGTERM.
+timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    python scripts/kafka_smoke.py || rc=1
 exit $rc

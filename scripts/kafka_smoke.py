@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""CI smoke for the PR-4 kafka scale paths (seconds on CPU):
+
+- **4-device sharded-kafka parity**: a toy KafkaSim on a 4-device
+  virtual CPU mesh (a DIFFERENT shard count than the 8-way mesh the
+  tier-1 suite runs on — shard-count bugs in the prefix-scan/reduce_or
+  decomposition would alias at one fixed count) must be bit-identical
+  to single-device, fault-free (union replication) AND under a
+  crash/loss plan (faulted origin-union), and the fault-free sharded
+  step HLO must contain no all-gather — the blocked psum-of-OR
+  replication contract.
+- **kafka mesh-takeover smoke**: benchmarks/mesh_takeover.py kafka
+  mode at a small shape (subprocess: its own 8-device virtual mesh)
+  must allocate every send and report ok.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gossip_glomers_tpu.parallel.mesh import force_virtual_devices  # noqa: E402
+
+force_virtual_devices(4)
+
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+import numpy as np                                          # noqa: E402
+from jax.sharding import Mesh                               # noqa: E402
+
+from gossip_glomers_tpu.harness import nemesis              # noqa: E402
+from gossip_glomers_tpu.tpu_sim import faults as F          # noqa: E402
+from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim       # noqa: E402
+
+
+def parity_4dev() -> None:
+    n, k, cap, s, r = 8, 6, 32, 2, 5
+    rng = np.random.default_rng(0)
+    sks = rng.integers(-1, k, (r, n, s)).astype(np.int32)
+    svs = rng.integers(0, 1000, (r, n, s)).astype(np.int32)
+    crs = np.where(rng.random((r, n, k)) < 0.25,
+                   rng.integers(1, 5, (r, n, k)), -1).astype(np.int32)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("nodes",))
+    ref = KafkaSim(n, k, capacity=cap, max_sends=s)
+    shd = KafkaSim(n, k, capacity=cap, max_sends=s, mesh=mesh)
+    s1 = ref.run_rounds(ref.init_state(), sks, svs, crs)
+    s2 = shd.run_rounds(shd.init_state(), sks, svs, crs)
+    for a, b, name in zip(s1, s2, s1._fields):
+        assert (np.asarray(a) == np.asarray(b)).all(), \
+            f"fault-free 4-dev mismatch: {name}"
+    prog = shd._step_prog("union")
+    args = [jnp.full((n, s), -1, jnp.int32),
+            jnp.zeros((n, s), jnp.int32),
+            jnp.full((n, k), -1, jnp.int32), shd.kv_sched]
+    hlo = prog.lower(shd.init_state(), *args).compile().as_text()
+    assert "all-gather" not in hlo, \
+        "sharded kafka step regained an all-gather"
+    spec = F.NemesisSpec(n_nodes=n, seed=5, crash=((2, 4, (1,)),),
+                         loss_rate=0.2, loss_until=6)
+    fs, fv, fc = nemesis.stage_kafka_ops(spec, 6, n_keys=k,
+                                         max_sends=s)
+    f_ref = KafkaSim(n, k, capacity=cap, max_sends=s,
+                     fault_plan=spec.compile())
+    f_shd = KafkaSim(n, k, capacity=cap, max_sends=s,
+                     fault_plan=spec.compile(), mesh=mesh)
+    assert f_shd._repl_mode(None) == "union_nem"
+    t1 = f_ref.run_rounds(f_ref.init_state(), fs, fv, fc)
+    t2 = f_shd.run_rounds(f_shd.init_state(), fs, fv, fc)
+    for a, b, name in zip(t1, t2, t1._fields):
+        assert (np.asarray(a) == np.asarray(b)).all(), \
+            f"faulted 4-dev mismatch: {name}"
+    print("kafka 4-device sharded parity OK (union + union_nem, "
+          "no all-gather)")
+
+
+def takeover_smoke() -> None:
+    from benchmarks.takeover_subprocess import run_takeover_subprocess
+
+    res = run_takeover_subprocess(
+        {"GG_TAKEOVER_WORKLOAD": "kafka", "GG_TAKEOVER_NODES": "4096",
+         "GG_TAKEOVER_ROUNDS": "2"}, timeout=600)
+    assert res["ok"], res
+    print(f"kafka mesh-takeover smoke OK "
+          f"({res['wall_s_virtual_mesh']}s, "
+          f"{res['n_devices']}-way virtual mesh)")
+
+
+if __name__ == "__main__":
+    parity_4dev()
+    takeover_smoke()

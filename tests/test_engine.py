@@ -235,15 +235,15 @@ def test_kafka_repl_fast_path_matches_matmul(use_mesh):
 
 
 def test_kafka_masked_repl_keeps_matmul_path():
-    # a lossy link mask must never take the fast path: the auto pick is
+    # a lossy link mask must never take a fast path: the auto pick is
     # host-side on the concrete repl_ok
     n, k = 4, 3
     sim = KafkaSim(n, k, capacity=16, max_sends=1)
-    assert sim._repl_full(None)
-    assert sim._repl_full(np.ones((n, n), bool))
-    assert not sim._repl_full(np.eye(n, dtype=bool))
-    assert not KafkaSim(n, k, capacity=16, max_sends=1,
-                        repl_fast=False)._repl_full(None)
+    assert sim._repl_mode(None) == "union"
+    assert sim._repl_mode(np.ones((n, n), bool)) == "union"
+    assert sim._repl_mode(np.eye(n, dtype=bool)) == "matmul"
+    assert KafkaSim(n, k, capacity=16, max_sends=1,
+                    repl_fast=False)._repl_mode(None) == "matmul"
 
 
 @pytest.mark.parametrize("use_mesh", [False, True])
@@ -274,6 +274,92 @@ def test_kafka_sharded_fast_path_matches_single_device():
         assert (np.asarray(a) == np.asarray(b)).all()
 
 
+# -- kafka: faulted origin-union (matmul-free) replication --------------
+
+
+def _nem_spec(n):
+    from gossip_glomers_tpu.tpu_sim import faults as F
+    return F.NemesisSpec(n_nodes=n, seed=11, crash=((3, 7, (1, 4)),),
+                         loss_rate=0.25, loss_until=10,
+                         dup_rate=0.1, dup_until=10)
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_kafka_faulted_union_matches_matmul_oracle(use_mesh):
+    # the PR-4 tentpole contract: under crash+loss+dup the origin-union
+    # fast path (elementwise coin fold, no N x N lhs) is bit-identical
+    # to the repl_fast=False link-mask matmul oracle — state AND
+    # ledger, commits and the resync included, single-device and
+    # sharded
+    from gossip_glomers_tpu.harness import nemesis as H
+    n, k, cap, s = 8, 4, 64, 2
+    spec = _nem_spec(n)
+    sks, svs, crs = H.stage_kafka_ops(spec, 12, n_keys=k, max_sends=s)
+    mesh = mesh_1d() if use_mesh else None
+    fast = KafkaSim(n, k, capacity=cap, max_sends=s, mesh=mesh,
+                    fault_plan=spec.compile())
+    slow = KafkaSim(n, k, capacity=cap, max_sends=s, mesh=mesh,
+                    fault_plan=spec.compile(), repl_fast=False)
+    assert fast._repl_mode(None) == "union_nem"
+    assert slow._repl_mode(None) == "matmul"
+    s1 = fast.run_rounds(fast.init_state(), sks, svs, crs)
+    s2 = slow.run_rounds(slow.init_state(), sks, svs, crs)
+    for a, b, name in zip(s1, s2, s1._fields):
+        assert (np.asarray(a) == np.asarray(b)).all(), name
+    # stepwise too (separate program cache)
+    t1, t2 = fast.init_state(), slow.init_state()
+    for t in range(12):
+        t1 = fast.step(t1, sks[t], svs[t], crs[t])
+        t2 = slow.step(t2, sks[t], svs[t], crs[t])
+    for a, b, name in zip(t1, t2, t1._fields):
+        assert (np.asarray(a) == np.asarray(b)).all(), name
+
+
+def test_kafka_sharded_step_hlo_has_no_all_gather():
+    # the sharded-presence contract: the fault-free sharded round's
+    # replication reduce is a blocked psum-of-OR over ICI (ppermute
+    # recursive doubling) and the offset linearization is a ppermute
+    # prefix scan — no all-gather anywhere in the compiled step
+    n, k, s = 8, 4, 2
+    sim = KafkaSim(n, k, capacity=64, max_sends=s, mesh=mesh_1d())
+    st = sim.init_state()
+    prog = sim._step_prog("union")
+    args = [jnp.full((n, s), -1, jnp.int32), jnp.zeros((n, s), jnp.int32),
+            jnp.full((n, k), -1, jnp.int32), sim.kv_sched]
+    hlo = prog.lower(st, *args).compile().as_text()
+    assert "all-gather" not in hlo
+    assert "collective-permute" in hlo
+
+
+def test_counter_wide_sharded_step_hlo_has_no_all_gather():
+    # counter's wide two-pmin winner on the same sharded driver: the
+    # whole round is collective-based (psum/pmin), so the compiled
+    # sharded step carries no all-gather either
+    from gossip_glomers_tpu.tpu_sim.counter import KVReach
+    from jax.sharding import PartitionSpec as P
+    mesh = mesh_1d()
+    sim = CounterSim(32, mode="cas", poll_every=2, winner_key="wide",
+                     mesh=mesh)
+    sched_spec = KVReach(P(), P(), P(None, None))
+
+    def step(state, sched):
+        coll = engine.collectives(state.pending.shape[0], mesh)
+        return sim._round(state, coll, sched)
+
+    prog = engine.jit_program(step, mesh=mesh,
+                              in_specs=(sim._state_spec(), sched_spec),
+                              out_specs=sim._state_spec())
+    hlo = prog.lower(sim.init_state(), sim.kv_sched).compile().as_text()
+    assert "all-gather" not in hlo
+    # parity of the wide winner on the mesh vs single-device
+    ref = CounterSim(32, mode="cas", poll_every=2, winner_key="wide")
+    deltas = np.arange(1, 33, dtype=np.int32)
+    a = ref.run_fused(ref.add(ref.init_state(), deltas), 12)
+    b = sim.run_fused(sim.add(sim.init_state(), deltas), 12)
+    for x, y in zip(a, b):
+        assert (np.asarray(x) == np.asarray(y)).all()
+
+
 # -- engine internals ---------------------------------------------------
 
 
@@ -282,9 +368,36 @@ def test_collectives_single_device_identity():
     x = jnp.arange(8)
     assert (np.asarray(coll.row_ids) == np.arange(8)).all()
     for f in (coll.widen, coll.reduce_sum, coll.reduce_max,
-              coll.reduce_min, coll.local_cols):
+              coll.reduce_min, coll.reduce_or, coll.local_cols):
         assert (np.asarray(f(x)) == np.asarray(x)).all()
+    assert (np.asarray(coll.exclusive_sum(x)) == 0).all()
     assert coll.axis_name is None
+
+
+def test_collectives_reduce_or_and_exclusive_sum_on_mesh():
+    # the two new sharded-kafka collectives: bitwise-OR all-reduce and
+    # the cross-shard exclusive prefix, both collective-permute only
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = mesh_1d()
+    x = (jnp.uint32(1) << jnp.arange(8, dtype=jnp.uint32))[:, None]
+    y = jnp.arange(8, dtype=jnp.int32)[:, None] + 1
+
+    def f(xs, ys):
+        coll = engine.collectives(1, mesh)
+        return coll.reduce_or(xs), coll.exclusive_sum(ys)
+
+    prog = engine.jit_program(f, mesh=mesh,
+                              in_specs=(P("nodes"), P("nodes")),
+                              out_specs=(P(None), P("nodes")),
+                              check_vma=False)
+    sh = NamedSharding(mesh, P("nodes"))
+    ors, excl = prog(jax.device_put(x, sh), jax.device_put(y, sh))
+    assert int(np.asarray(ors)[0, 0]) == 0xFF
+    assert (np.asarray(excl)[:, 0]
+            == np.concatenate([[0], np.cumsum(np.arange(1, 8))])).all()
+    hlo = prog.lower(jax.device_put(x, sh),
+                     jax.device_put(y, sh)).compile().as_text()
+    assert "all-gather" not in hlo
 
 
 def test_stepwise_converge_check_every():

@@ -181,6 +181,65 @@ def test_kafka_nemesis_certifies_and_replays():
         == (r1["msgs_total"], r1["converged_round"])
 
 
+def test_kafka_push_resync_certifies_and_replays():
+    # the per-origin push variant (crashed origin re-replicates its own
+    # appends from the durable log): certifies the same scenario as the
+    # pull union, replays bit-exactly, and its ledger reflects the
+    # push shape (N-1 replicate msgs per pusher, not 2 per puller)
+    spec = F.NemesisSpec(n_nodes=8, seed=11, crash=((3, 7, (1, 4)),),
+                         loss_rate=0.25, loss_until=10)
+    r1 = nemesis.run_kafka_nemesis(spec, resync_mode="push")
+    assert r1["ok"], r1
+    assert r1["n_lost_writes"] == 0
+    r2 = nemesis.run_kafka_nemesis(spec, resync_mode="push")
+    assert (r2["msgs_total"], r2["converged_round"]) \
+        == (r1["msgs_total"], r1["converged_round"])
+    pull = nemesis.run_kafka_nemesis(spec)
+    assert pull["msgs_total"] != r1["msgs_total"]
+    # sharded push run (origin_bits node-sharded) == single-device
+    sks, svs, crs = nemesis.stage_kafka_ops(spec, 12, n_keys=4,
+                                            max_sends=2)
+    ref = KafkaSim(8, 4, capacity=64, max_sends=2,
+                   fault_plan=spec.compile(), resync_mode="push")
+    shd = KafkaSim(8, 4, capacity=64, max_sends=2,
+                   fault_plan=spec.compile(), resync_mode="push",
+                   mesh=mesh_1d())
+    a = ref.run_rounds(ref.init_state(), sks, svs, crs)
+    b = shd.run_rounds(shd.init_state(), sks, svs, crs)
+    for x, y, name in zip(a, b, a._fields):
+        assert (np.asarray(x) == np.asarray(y)).all(), name
+
+
+def test_kafka_push_resync_waits_for_crashed_origin():
+    # a bit whose ORIGIN is down is not re-replicated by the push until
+    # the origin restarts (its origin_bits are durable and survive the
+    # amnesia wipe) — the run still converges with zero lost writes
+    # once the origin is back for a resync round
+    spec = F.NemesisSpec(n_nodes=6, seed=3, crash=((1, 9, (0,)),))
+    r = nemesis.run_kafka_nemesis(spec, resync_mode="push",
+                                  workload_seed=2)
+    assert r["ok"], r
+    assert r["n_lost_writes"] == 0
+    # mid-run: while node 0 is down, its round-0 appends exist ONLY in
+    # the peers' presence (delivered at round 0) and in node 0's
+    # durable origin_bits — the amnesia wipe cleared its presence row
+    sim = KafkaSim(6, 4, capacity=64, max_sends=2,
+                   fault_plan=spec.compile(), resync_mode="push")
+    sks, svs, crs = nemesis.stage_kafka_ops(spec, 6, n_keys=4,
+                                            max_sends=2,
+                                            workload_seed=2)
+    st = sim.init_state()
+    for t in range(4):
+        st = sim.step(st, sks[t], svs[t], crs[t])
+    assert np.asarray(st.present)[0].sum() == 0       # amnesia wiped
+    assert np.asarray(st.origin_bits)[0].sum() > 0    # durable record
+
+
+def test_kafka_resync_mode_validated():
+    with pytest.raises(ValueError, match="resync_mode"):
+        KafkaSim(4, 2, capacity=8, resync_mode="gossip")
+
+
 def test_check_recovery_verdicts():
     ok, d = check_recovery(clear_round=10, converged_round=14,
                            max_recovery_rounds=8, lost_writes=[],
@@ -258,7 +317,8 @@ def test_kafka_faulted_scan_matches_stepwise_and_mesh():
                                             max_sends=s)
     sim = KafkaSim(n, k, capacity=cap, max_sends=s,
                    fault_plan=spec.compile())
-    assert not sim._repl_full(None)          # crash/loss pin the matmul
+    # crash/loss select the FAULTED origin-union (matmul-free) path
+    assert sim._repl_mode(None) == "union_nem"
     ref = sim.init_state()
     for t in range(12):
         ref = sim.step(ref, sks[t], svs[t], crs[t])
@@ -511,6 +571,29 @@ def test_structured_nemesis_sharded_fused_donated_parity():
         assert r5 == r1 and int(s5.msgs) == int(s1.msgs), topo
         assert (ref.received_node_major(s1)
                 == sim2.received_node_major(s5)).all(), topo
+
+
+def test_faulted_path_pick_words_threshold():
+    # the PR-4 resolution of the BENCH_PR3 n_values=2048 (W=64) tree
+    # regression: on CPU the faulted round auto-falls back to the
+    # gather at W >= NEM_GATHER_MIN_W; TPU stays structured at every W
+    from gossip_glomers_tpu.tpu_sim import structured
+    w = structured.NEM_GATHER_MIN_W
+    assert structured.faulted_path_pick(1, backend="cpu") \
+        == "structured"
+    assert structured.faulted_path_pick(w - 1, backend="cpu") \
+        == "structured"
+    assert structured.faulted_path_pick(w, backend="cpu") == "gather"
+    assert structured.faulted_path_pick(2048, backend="cpu") == "gather"
+    assert structured.faulted_path_pick(2048, backend="tpu") \
+        == "structured"
+    # the auto mode routes through the pick: W=64 on this CPU backend
+    # takes the gather path and still certifies
+    spec = F.NemesisSpec(n_nodes=16, seed=5, loss_rate=0.1,
+                         loss_until=4)
+    r = nemesis.run_broadcast_nemesis(spec, n_values=2048,
+                                      structured="auto")
+    assert r["ok"] and r["path"] == "gather"
 
 
 def test_structured_nemesis_seed_replay_determinism():

@@ -252,6 +252,145 @@ def test_counter_model_kv_transport_retries_knob():
     assert first_attempt_reads(CounterConfig(**base)) == 1
 
 
+def _wire(out, dest=None, typ=None):
+    msgs = [json.loads(line) for line in out.getvalue().splitlines()
+            if line]
+    return [m for m in msgs
+            if (dest is None or m["dest"] == dest)
+            and (typ is None or m["body"]["type"] == typ)]
+
+
+def _wait_for(out, pred, deadline=6.0):
+    """Poll ``pred`` until truthy (the stdio runtime schedules on real
+    threads); fail with the full wire transcript."""
+    import time
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.005)
+    raise AssertionError("wire condition never met: " + out.getvalue())
+
+
+def test_counter_kv_retries_recover_lost_read_wire_shape():
+    """The recalibrated read-count wire shape under a LOSSY harness
+    (the PR-3 knob left open): with ``kv_retries > 0`` a flush whose
+    first read request is lost in flight re-issues it under backoff,
+    the retry's reply completes the SAME flush attempt (read ->
+    read_ok -> cas -> cas_ok), and no further retries fire — exactly
+    2 reads + 1 cas on the wire, fresh msg_ids, and the delta lands
+    without waiting out another flush_interval."""
+    import io
+    import random
+    import time
+
+    from gossip_glomers_tpu.models.counter import CounterProgram
+    from gossip_glomers_tpu.protocol import Message
+    from gossip_glomers_tpu.runtime.node import StdioNode
+    from gossip_glomers_tpu.utils.config import CounterConfig
+
+    out = io.StringIO()
+    node = StdioNode(in_stream=io.StringIO(), out_stream=out,
+                     err_stream=io.StringIO())
+    node.rng = random.Random(0)
+    cfg = CounterConfig(flush_interval=0.05, kv_op_timeout=0.05,
+                        poll_interval=30.0, kv_retries=2,
+                        kv_backoff_base=0.01, kv_backoff_cap=0.05)
+    CounterProgram(cfg).install(node)
+    node.deliver(Message("c1", "n0", {"type": "init", "msg_id": 1,
+                                      "node_id": "n0",
+                                      "node_ids": ["n0"]}))
+    node.deliver(Message("c1", "n0", {"type": "add", "msg_id": 2,
+                                      "delta": 7}))
+
+    def wait_for(pred, deadline=6.0):
+        return _wait_for(out, pred, deadline)
+
+    # the flush tick's first read hits the wire and is LOST (never
+    # answered); the transport retry re-issues it with a fresh msg_id
+    reads = wait_for(lambda: (_wire(out, "seq-kv", "read")
+                              if len(_wire(out, "seq-kv", "read")) >= 2
+                              else None))
+    assert len({m["body"]["msg_id"] for m in reads}) == len(reads) >= 2
+    # answer the RETRY: the same flush attempt proceeds to its CAS
+    retry = reads[1]
+    node.deliver(Message("seq-kv", "n0",
+                         {"type": "read_ok", "value": 0,
+                          "in_reply_to": retry["body"]["msg_id"]}))
+    cas = wait_for(lambda: _wire(out, "seq-kv", "cas") or None)[0]
+    assert cas["body"]["from"] == 0 and cas["body"]["to"] == 7
+    node.deliver(Message("seq-kv", "n0",
+                         {"type": "cas_ok",
+                          "in_reply_to": cas["body"]["msg_id"]}))
+    # the flush landed: the cached read serves the flushed value
+    node.deliver(Message("c1", "n0", {"type": "read", "msg_id": 3}))
+    reply = wait_for(lambda: [m for m in _wire(out, "c1", "read_ok")
+                              if m["body"].get("in_reply_to") == 3]
+                     or None)[0]
+    assert reply["body"]["value"] == 7
+    # recalibrated read count: the lost read + its ONE successful
+    # retry — the reply stopped the backoff ladder (retries=2 allows a
+    # third read; it must NOT have fired), and the one CAS completes
+    # the attempt
+    assert len(_wire(out, "seq-kv", "read")) == 2, out.getvalue()
+    assert len(_wire(out, "seq-kv", "cas")) == 1
+
+
+def test_kafka_transport_retries_recover_lost_alloc_read():
+    """Same contract for the kafka allocator: a lost allocation read
+    under ``kv_transport_retries=1`` re-issues once, the retry's reply
+    drives the CAS, and the send acks with offset 1 — 2 reads + 1 cas
+    on the lin-kv wire for the whole send."""
+    import io
+    import random
+    import time
+
+    from gossip_glomers_tpu.models.kafka import KafkaProgram
+    from gossip_glomers_tpu.protocol import Message
+    from gossip_glomers_tpu.runtime.node import StdioNode
+    from gossip_glomers_tpu.utils.config import KafkaConfig
+
+    out = io.StringIO()
+    node = StdioNode(in_stream=io.StringIO(), out_stream=out,
+                     err_stream=io.StringIO())
+    node.rng = random.Random(0)
+    cfg = KafkaConfig(kv_timeout=0.05, cas_timeout=0.05,
+                      kv_transport_retries=1,
+                      kv_backoff_base=0.01, kv_backoff_cap=0.05)
+    KafkaProgram(cfg).install(node)
+    node.deliver(Message("c1", "n0", {"type": "init", "msg_id": 1,
+                                      "node_id": "n0",
+                                      "node_ids": ["n0", "n1"]}))
+    node.deliver(Message("c1", "n0", {"type": "send", "msg_id": 2,
+                                      "key": "k0", "msg": 42}))
+
+    def wait_for(pred, deadline=6.0):
+        return _wait_for(out, pred, deadline)
+
+    reads = wait_for(lambda: (_wire(out, "lin-kv", "read")
+                              if len(_wire(out, "lin-kv", "read")) >= 2
+                              else None))
+    assert len({m["body"]["msg_id"] for m in reads}) == len(reads) >= 2
+    from gossip_glomers_tpu.protocol import KEY_DOES_NOT_EXIST
+    node.deliver(Message("lin-kv", "n0",
+                         {"type": "error", "code": KEY_DOES_NOT_EXIST,
+                          "text": "missing",
+                          "in_reply_to": reads[1]["body"]["msg_id"]}))
+    cas = wait_for(lambda: _wire(out, "lin-kv", "cas") or None)[0]
+    assert cas["body"]["from"] == 1 and cas["body"]["to"] == 2
+    node.deliver(Message("lin-kv", "n0",
+                         {"type": "cas_ok",
+                          "in_reply_to": cas["body"]["msg_id"]}))
+    ack = wait_for(lambda: _wire(out, "c1", "send_ok") or None)[0]
+    assert ack["body"]["offset"] == 1
+    # the replicate fan-out fired to the peer (acks=0, no reply)
+    assert _wire(out, "n1", "replicate_msg")
+    assert len(_wire(out, "lin-kv", "read")) == 2, out.getvalue()
+    assert len(_wire(out, "lin-kv", "cas")) == 1
+
+
 def test_console_script_entry_points_registered():
     """Packaging (pyproject [project.scripts]): one Maelstrom-style
     executable per challenge, like the reference's checked-in binaries.
