@@ -120,6 +120,7 @@ def run_counter_nemesis(spec: NemesisSpec, *,
                         deltas: np.ndarray | None = None,
                         mode: str = "cas", poll_every: int = 2,
                         max_recovery_rounds: int = 64,
+                        union_block: "int | str | None" = None,
                         mesh=None) -> dict:
     """G-counter under the nemesis: per-node deltas acked at round 0,
     convergence = pending fully drained AND every node's cached read
@@ -132,7 +133,8 @@ def run_counter_nemesis(spec: NemesisSpec, *,
         deltas = np.arange(1, n + 1, dtype=np.int32)
     acked_sum = int(np.sum(deltas))
     sim = CounterSim(n, mode=mode, poll_every=poll_every,
-                     fault_plan=spec.compile(), mesh=mesh)
+                     fault_plan=spec.compile(),
+                     union_block=union_block, mesh=mesh)
     state = sim.add(sim.init_state(), deltas)
     clear = spec.clear_round
     if clear > 0:
@@ -165,15 +167,30 @@ def run_counter_nemesis(spec: NemesisSpec, *,
 def stage_kafka_ops(spec: NemesisSpec, rounds: int, *, n_keys: int,
                     max_sends: int, send_prob: float = 0.7,
                     commit_prob: float = 0.2, workload_seed: int = 0,
-                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+                    commits: bool = True,
+                    ) -> "tuple[np.ndarray, np.ndarray, np.ndarray | None]":
     """Seeded (R, N, S) send batches + (R, N, K) commit requests for a
     nemesis campaign: ops are staged only at nodes that are UP that
     round (a dead process receives no client RPCs), values are
-    globally unique."""
+    globally unique.  ``commits=False`` returns ``crs=None`` and
+    stages the sends VECTORIZED — the large-N campaigns (the PR-5
+    65k-node blocked-union row) skip both the O(R·N·K) commit-request
+    host array and the per-node python loop."""
     rng = np.random.default_rng(workload_seed)
     n, s = spec.n_nodes, max_sends
     sks = np.full((rounds, n, s), -1, np.int32)
     svs = np.zeros((rounds, n, s), np.int32)
+    if not commits:
+        vid = 0
+        for t in range(rounds):
+            up = spec.host_up(t)
+            send = (rng.random(n) < send_prob) & up
+            k = rng.integers(0, n_keys, n).astype(np.int32)
+            sks[t, :, 0] = np.where(send, k, -1)
+            cnt = int(send.sum())
+            svs[t, send, 0] = np.arange(vid, vid + cnt, dtype=np.int32)
+            vid += cnt
+        return sks, svs, None
     crs = np.full((rounds, n, n_keys), -1, np.int32)
     vid = 0
     for t in range(rounds):
@@ -197,6 +214,9 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
                       max_recovery_rounds: int = 48,
                       rounds: int | None = None,
                       repl_fast: bool | None = None,
+                      union_block: "int | str | None" = None,
+                      commits: bool = True,
+                      send_prob: float = 0.7,
                       mesh=None) -> dict:
     """Replicated log under the nemesis: seeded send/commit traffic at
     live nodes through the faulted phase, then quiescent recovery.
@@ -215,16 +235,22 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
     ``resync_mode``: the anti-entropy shape — receiver-side union
     ``"pull"`` (default) or per-origin durable-log ``"push"`` (see
     KafkaSim).  ``repl_fast=False`` pins the link-mask matmul oracle
-    instead of the faulted origin-union replication."""
+    instead of the faulted origin-union replication; ``union_block``
+    picks the streaming-union destination slab (KafkaSim — the PR-5
+    blocked path that carries faulted campaigns past the materialized
+    coin tensor's N² wall); ``commits=False`` stages a send-only
+    campaign (vectorized, no O(R·N·K) commit array — the large-N
+    rows)."""
     n = spec.n_nodes
     clear = max(spec.clear_round, rounds or 0)
     sks, svs, crs = stage_kafka_ops(
         spec, clear, n_keys=n_keys, max_sends=max_sends,
-        workload_seed=workload_seed)
+        workload_seed=workload_seed, commits=commits,
+        send_prob=send_prob)
     sim = KafkaSim(n, n_keys, capacity=capacity, max_sends=max_sends,
                    fault_plan=spec.compile(), resync_every=resync_every,
                    resync_mode=resync_mode, repl_fast=repl_fast,
-                   mesh=mesh)
+                   union_block=union_block, mesh=mesh)
     state = sim.init_state()
     if clear > 0:
         state = sim.run_fused(state, sks, svs, crs)
@@ -234,10 +260,20 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
         pres = np.asarray(s.present)
         return bool((pres == pres[:1]).all())
 
+    def step1(s):
+        if commits:
+            return sim.step(s)
+        # send-only campaigns drive quiescent recovery rounds through
+        # run_rounds with NO commit operand — the (N, K) all--1
+        # commit_req host array a plain step() stages every round is
+        # itself O(N²/16) at the large-N shapes
+        sk1 = np.full((1, n, max_sends), -1, np.int32)
+        return sim.run_rounds(s, sk1, np.zeros_like(sk1))
+
     converged_round = clear if converged(state) else None
     while converged_round is None \
             and int(state.t) < clear + max_recovery_rounds:
-        state = sim.step(state)
+        state = step1(state)
         if converged(state):
             converged_round = int(state.t)
 

@@ -44,8 +44,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import faults
-from .engine import (donate_argnums_for, fori_rounds, shard_map,
-                     stepwise_converge, while_converge, windows_fold)
+from .engine import (donate_argnums_for, fori_rounds, resolve_block,
+                     scan_blocks, shard_map, stepwise_converge,
+                     while_converge, windows_fold)
 from .structured import _take_delayed
 
 WORD = 32
@@ -300,6 +301,7 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
            = lambda x: x,
            plan: "faults.FaultPlan | None" = None,
            dup_on: bool = False,
+           union_block: int | None = None,
            ) -> BroadcastState:
     """One simulation round == one base network hop — the single source
     of the node-major (adjacency-gather) round semantics, shared by the
@@ -322,6 +324,17 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
     (:func:`_live_split`).  ``dup_on`` edges additionally re-deliver
     their source's full received set (at-least-once duplicates, absorbed
     by the ``& ~received`` dedup, visible in the msgs ledger).
+
+    ``union_block`` (ISSUE 5): stream the faulted round over
+    destination-row slabs of that size (engine.scan_blocks) instead of
+    materializing the full (rows, D) liveness/coin masks at once — the
+    full-mesh/star faulted shapes, whose per-edge coin tensor is
+    O(N²), hold one O(B·D) slab of mask temps at a time.  The coins
+    are stateless (t, src, dst) hashes, so any blocking is
+    bit-identical to the materialized round — including the uint32
+    ``msgs`` ledger, whose per-slab partial sums are exact modular
+    adds.  Applies to 1-hop faulted rounds with the srv ledger off
+    (``delays`` rings and the srv pass keep the materialized shape).
     """
     if plan is None:
         rec0, fr0 = state.received, state.frontier
@@ -335,6 +348,50 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
     # frontier ⊆ received, so the anti-entropy payload is just `received`.
     payload = jnp.where(is_sync, rec0, fr0)
     payload_full = widen(payload)
+    if (union_block is not None and plan is not None
+            and delays is None and state.srv_msgs is None):
+        # -- streaming faulted round (see docstring) ------------------
+        rows = nbrs.shape[0]
+        ub = union_block
+        pc_pay = _popcount(payload).sum(axis=1).astype(jnp.uint32)
+        if dup_on:
+            received_full = widen(rec0)
+            pc_src = _popcount(received_full).sum(
+                axis=1).astype(jnp.uint32)
+
+        def blk(carry, lo):
+            inbox_c, sent_c = carry
+            rid = lax.dynamic_slice_in_dim(row_ids, lo, ub)
+            nb = lax.dynamic_slice_in_dim(nbrs, lo, ub, axis=0)
+            nm = lax.dynamic_slice_in_dim(nbr_mask, lo, ub, axis=0)
+            ln, ld, dp = _live_split(state.t, rid, nb, nm, parts,
+                                     plan, dup_on)
+            s = jnp.sum(lax.dynamic_slice_in_dim(pc_pay, lo, ub)
+                        * ln.sum(axis=1).astype(jnp.uint32),
+                        dtype=jnp.uint32)
+            ib = _gather_or(payload_full, nb, ld)
+            if dp is not None:
+                ib = ib | _gather_or(received_full, nb, dp)
+                src_c = jnp.clip(nb, 0, payload_full.shape[0] - 1)
+                s = s + jnp.sum(jnp.where(dp, pc_src[src_c], 0),
+                                dtype=jnp.uint32)
+            return (lax.dynamic_update_slice_in_dim(inbox_c, ib, lo,
+                                                    axis=0),
+                    sent_c + s)
+
+        # carry zeros derived from varying operands so the scan carry
+        # keeps the body's sharding/varying type under shard_map (the
+        # same scan-vma rule as _gather_or's d=0 init)
+        inbox, sent_local = scan_blocks(
+            blk,
+            (payload & jnp.uint32(0),
+             jnp.sum(pc_pay, dtype=jnp.uint32) * jnp.uint32(0)),
+            rows, ub)
+        new = inbox & ~rec0
+        return BroadcastState(received=rec0 | new, frontier=new,
+                              t=state.t + 1,
+                              msgs=state.msgs + reduce_sum(sent_local),
+                              history=state.history, srv_msgs=None)
     live_now, live_del, dup = _live_split(state.t, row_ids, nbrs,
                                           nbr_mask, parts, plan, dup_on)
     # throughput ledger: one value-message per (value, live edge) —
@@ -460,7 +517,8 @@ def flood_step(state: BroadcastState, *, nbrs: jnp.ndarray,
                delays: jnp.ndarray | None = None,
                delay_set: tuple = (),
                plan: "faults.FaultPlan | None" = None,
-               dup_on: bool = False) -> BroadcastState:
+               dup_on: bool = False,
+               union_block: int | None = None) -> BroadcastState:
     """Single-device node-major round (the ``entry()`` compile-check
     target)."""
     row_ids = jnp.arange(nbrs.shape[0], dtype=jnp.int32)
@@ -470,7 +528,8 @@ def flood_step(state: BroadcastState, *, nbrs: jnp.ndarray,
         delay_set = tuple(int(x) for x in np.unique(np.asarray(delays)))
     return _round(state, row_ids=row_ids, nbrs=nbrs, nbr_mask=nbr_mask,
                   parts=parts, sync_every=sync_every, delays=delays,
-                  delay_set=delay_set, plan=plan, dup_on=dup_on)
+                  delay_set=delay_set, plan=plan, dup_on=dup_on,
+                  union_block=union_block)
 
 
 def _round_wm(state: BroadcastState, *, deg: jnp.ndarray, sync_every: int,
@@ -563,6 +622,9 @@ def _round_wm_nem(state: BroadcastState, arrs, plan, pstarts, pends, *,
                   = lambda x: x,
                   cols_slice: Callable[[jnp.ndarray], jnp.ndarray]
                   = lambda x: x,
+                  sync_diff: Callable | None = None,
+                  sync_base_once: Callable[[jnp.ndarray], jnp.ndarray]
+                  = lambda x: x,
                   ) -> BroadcastState:
     """Words-major round under the FULL nemesis — a compiled FaultPlan
     (crash/restart amnesia, per-direction loss, duplicate delivery)
@@ -587,9 +649,16 @@ def _round_wm_nem(state: BroadcastState, arrs, plan, pstarts, pends, *,
     ``src_pc(d, pc)`` are the bundle's static delivery and
     count-relocation closures (full-axis or halo — the caller picks);
     ``cols_slice`` maps full-axis per-column rows to the local block
-    on the all_gather fallback (identity elsewhere).  The srv ledger
-    is always off under a plan (no defined accounting for lost acks),
-    matching the gather path."""
+    on the all_gather fallback (identity elsewhere).
+
+    The srv ledger runs here for LOSS-ONLY plans (PR 5, matching the
+    gather path's loss-only accounting): ``sync_diff`` is the bundle's
+    masked per-edge diff closure, fed the both-coin rows of
+    faults.wm_srv_rows; requests charge at send time, replies per
+    delivered request's edge coin (the ack rows), sync diffs over
+    pairs where both direction coins survive.  Crash/dup plans and
+    ``dir_delays`` arrive with ``state.srv_msgs is None`` (the
+    constructor forces the ledger off loudly there)."""
     t = state.t
     up_now = faults.wm_up_cols(plan, t, arrs.down_cols)
     wipe = cols_slice(~up_now & faults.wm_up_cols(plan, t - 1,
@@ -604,6 +673,29 @@ def _round_wm_nem(state: BroadcastState, arrs, plan, pstarts, pends, *,
         .sum(axis=0, dtype=jnp.int32).astype(jnp.uint32))
     pc = _popcount(payload).sum(axis=0).astype(jnp.uint32)
     sent = jnp.sum(pc * live_deg, dtype=jnp.uint32)
+    if state.srv_msgs is None or sync_diff is None:
+        srv = None
+    else:
+        # LOSS-ONLY reference accounting (see docstring) — the same
+        # formulas as the gather path's srv block in _round, over the
+        # bundle's deg-contract coin rows.  On the halo path every
+        # array here is already node-sharded (cols local); the
+        # all_gather fallback keeps the ledger off (constructor).
+        deg_topo = arrs.deg_exists.sum(axis=0).astype(jnp.int32)
+        _lv, ack_r, both_r = faults.wm_srv_rows(plan, t, arrs,
+                                                pstarts, pends)
+        ack_deg = ack_r.sum(axis=0, dtype=jnp.int32)
+        pcf = _popcount(fr0).sum(axis=0).astype(jnp.uint32)
+        coef = jnp.where(t == 0, deg_topo + ack_deg,
+                         jnp.maximum(deg_topo + ack_deg - 2, 0)
+                         ).astype(jnp.uint32)
+        flood = jnp.sum(pcf * coef, dtype=jnp.uint32)
+        base = sync_base_once(jnp.sum(deg_topo + ack_deg,
+                                      dtype=jnp.int32)
+                              .astype(jnp.uint32))
+        diff = sync_diff(rec0, both_r)
+        srv = state.srv_msgs + reduce_sum(
+            flood + jnp.where(is_sync, base + 2 * diff, jnp.uint32(0)))
     n_dirs = int(arrs.exists.shape[0])
 
     def dup_charge(dup_rows, counts):
@@ -659,7 +751,7 @@ def _round_wm_nem(state: BroadcastState, arrs, plan, pstarts, pends, *,
     return BroadcastState(received=rec0 | new, frontier=new,
                           t=t + 1,
                           msgs=state.msgs + reduce_sum(sent),
-                          history=history, srv_msgs=None)
+                          history=history, srv_msgs=srv)
 
 
 class BroadcastSim:
@@ -705,6 +797,7 @@ class BroadcastSim:
                  edge_delayed=None,
                  fault_plan: "faults.FaultPlan | None" = None,
                  nemesis=None,
+                 union_block: "int | str | None" = None,
                  ) -> None:
         """``srv_ledger``: keep the reference-accounted server-message
         ledger (default).  It costs a second adjacency pass per round
@@ -781,7 +874,26 @@ class BroadcastSim:
         bit-exact with the gather path.  Requires ``fault_plan`` (the
         traced operand the masks were compiled from) and a structured
         ``exchange``; mutually exclusive with ``delays``/``delayed``/
-        ``edge_delayed``/``faulted`` (the bundle subsumes them)."""
+        ``edge_delayed``/``faulted`` (the bundle subsumes them).
+        LOSS-ONLY plans keep the srv ledger HERE TOO (PR 5): the
+        bundle's deg-contract coin rows (faults.wm_srv_rows) and
+        masked per-edge diff closures reproduce the gather path's
+        loss-only accounting gather-free — requests charged at send,
+        replies per delivered request's edge coin, sync diffs over
+        both-coin pairs — calibrated against the gather ledger (and
+        transitively the virtual harness) in
+        test_ledger_calibration.py; crash windows, dup streams, and
+        ``dir_delays`` still force it off loudly.
+
+        ``union_block`` (ISSUE 5): stream the GATHER path's faulted
+        rounds over destination-row slabs (engine.scan_blocks) — the
+        full-mesh/star faulted shapes' O(N²) per-edge coin masks are
+        evaluated one O(B·D) slab at a time, bit-identical (the coins
+        are stateless (t, src, dst) hashes).  None defers to
+        ``GG_UNION_BLOCK`` (auto: materialized until the whole mask
+        exceeds the slab budget); ``"materialized"`` pins the
+        unblocked oracle.  Gather path only, 1-hop faulted rounds,
+        srv ledger off (loud otherwise)."""
         n = nbrs.shape[0]
         self.n_nodes = n
         self.n_values = n_values
@@ -921,6 +1033,14 @@ class BroadcastSim:
                 f.sync_diff is not None if mesh is None
                 else f.sharded_exchange is not None
                 and f.sharded_sync_diff is not None)
+        elif nemesis is not None:
+            # words-major nemesis: the bundle carries its own masked
+            # diff closures (loss-only gating follows below — crash/
+            # dup/dir_delays force the ledger back off)
+            self._srv_on = srv_ledger and (
+                nemesis.sync_diff is not None if mesh is None
+                else (nemesis.sharded_exchange is not None
+                      and nemesis.sharded_sync_diff is not None))
         elif self.words_major:
             self._srv_on = srv_ledger and (
                 sync_diff is not None if mesh is None
@@ -975,23 +1095,36 @@ class BroadcastSim:
                     f"FaultPlan is for {fault_plan.down.shape[1]} "
                     f"nodes, sim has {n}")
             # LOSS-ONLY plans (no crash windows, no dup stream) keep a
-            # DEFINED reference accounting on the gather path: the
-            # per-(t, src, dst) coin makes a round's directed edge
-            # all-or-nothing, so requests are charged at send time
-            # (loss-invisible, like the harness ledger), replies only
-            # when the triggering request's edge-coin delivered, and
-            # sync diffs only where BOTH direction coins survive (the
-            # read AND its read_ok) — see the srv block in _round,
-            # calibrated in test_ledger_calibration.py.  Crash brings
-            # amnesia rows (acks from a process that died mid-round
-            # have no reference semantics) and dup re-delivers whole
-            # received sets — both stay OFF; the value-message ledger
-            # (`msgs`) is the throughput signal there.  Same for the
-            # delays and words-major compositions.
+            # DEFINED reference accounting: the per-(t, src, dst) coin
+            # makes a round's directed edge all-or-nothing, so
+            # requests are charged at send time (loss-invisible, like
+            # the harness ledger), replies only when the triggering
+            # request's edge-coin delivered, and sync diffs only where
+            # BOTH direction coins survive (the read AND its read_ok).
+            # Gather path: the srv block in _round; words-major
+            # nemesis runs (PR 5): the same formulas over the bundle's
+            # deg-contract coin rows and masked diff closures
+            # (_round_wm_nem) — both calibrated in
+            # test_ledger_calibration.py.  Crash brings amnesia rows
+            # (acks from a process that died mid-round have no
+            # reference semantics) and dup re-delivers whole received
+            # sets — both stay OFF; the value-message ledger (`msgs`)
+            # is the throughput signal there.  Same for every delays
+            # composition (gather `delays` and the bundle's
+            # dir_delays).
             loss_only = (int(fault_plan.starts.shape[0]) == 0
                          and int(fault_plan.dup_num) == 0)
-            if not (loss_only and not self.words_major
-                    and delays is None):
+            if self.words_major:
+                wm_srv_ok = (
+                    nemesis is not None
+                    and nemesis.dir_delays is None
+                    and (nemesis.sync_diff is not None if mesh is None
+                         else (nemesis.sharded_exchange is not None
+                               and nemesis.sharded_sync_diff
+                               is not None)))
+            else:
+                wm_srv_ok = delays is None
+            if not (loss_only and wm_srv_ok):
                 self._srv_on = False
         if delays is not None:
             if exchange is not None:
@@ -1002,6 +1135,33 @@ class BroadcastSim:
                 raise ValueError("edge delays are rounds >= 1")
         self.delays = (None if delays is None
                        else jnp.asarray(delays, jnp.int32))
+        # -- streaming faulted gather rounds (ISSUE 5) ------------------
+        if union_block is not None and (self.words_major
+                                        or delays is not None):
+            raise ValueError(
+                "union_block streams the GATHER path's 1-hop faulted "
+                "rounds; the words-major path is already gather-free "
+                "and the delays ring keeps the materialized shape")
+        if self.words_major or delays is not None or fault_plan is None:
+            self._ub = None
+        else:
+            n_sh_nodes = (int(mesh.shape["nodes"])
+                          if mesh is not None else 1)
+            # per destination row: D edges x (liveness + loss/dup
+            # coins + gather temps) ~ 16 bytes per edge slot
+            self._ub = resolve_block(n // n_sh_nodes, union_block,
+                                     per_row_bytes=nbrs.shape[1] * 16)
+            if self._ub is not None and self._srv_on:
+                if union_block is not None:
+                    raise ValueError(
+                        "blocked faulted gather rounds keep no srv "
+                        "ledger: pass srv_ledger=False (or "
+                        "union_block='materialized' to keep the "
+                        "loss-only ledger on the materialized path)")
+                # env-auto pick: the loss-only srv ledger needs the
+                # materialized masks — keep them rather than erroring
+                # on a sim the caller never asked to block
+                self._ub = None
         self._nem_delayed = (nemesis is not None
                              and nemesis.dir_delays is not None)
         if delayed is not None:
@@ -1203,7 +1363,7 @@ class BroadcastSim:
             reduce_sum=lambda s: lax.psum(s, mesh_axes),
             delays=delays, delay_set=self._delay_set,
             sync_base_once=sync_base_once, plan=plan,
-            dup_on=self._fp_dup)
+            dup_on=self._fp_dup, union_block=self._ub)
 
     @staticmethod
     def _live_rows(exists, same, starts, ends):
@@ -1257,7 +1417,9 @@ class BroadcastSim:
                     state, arrs, plan, pstarts, pends, nem=self._nem,
                     sync_every=self.sync_every, dup_on=self._fp_dup,
                     exchange=self._nem.sharded_exchange,
-                    src_pc=self._nem.sharded_src_pc, reduce_sum=psum)
+                    src_pc=self._nem.sharded_src_pc, reduce_sum=psum,
+                    sync_diff=self._nem.sharded_sync_diff,
+                    sync_base_once=sync_base_once)
             # all_gather fallback: replicated full-axis masks, full-
             # axis delivery per shard, local block sliced back out
             block = state.received.shape[1]
@@ -1375,7 +1537,8 @@ class BroadcastSim:
             return _round_wm_nem(
                 state, arrs, plan, pstarts, pends, nem=self._nem,
                 sync_every=self.sync_every, dup_on=self._fp_dup,
-                exchange=self._nem.exchange, src_pc=self._nem.src_pc)
+                exchange=self._nem.exchange, src_pc=self._nem.src_pc,
+                sync_diff=self._nem.sync_diff)
         if self._ef:
             rows, e2, s2, d2, ps, pe = masks
             eex = self._edge.exchange
@@ -1489,7 +1652,8 @@ class BroadcastSim:
                                   delays=self.delays,
                                   delay_set=self._delay_set,
                                   plan=fp[0] if fp else None,
-                                  dup_on=self._fp_dup)
+                                  dup_on=self._fp_dup,
+                                  union_block=self._ub)
             return lambda state, nbrs, nbr_mask: step(
                 state, nbrs, nbr_mask, *fp_args)
 
@@ -1588,7 +1752,8 @@ class BroadcastSim:
                                       delays=self.delays,
                                       delay_set=self._delay_set,
                                       plan=rest[0] if rest else None,
-                                      dup_on=self._fp_dup)
+                                      dup_on=self._fp_dup,
+                                      union_block=self._ub)
 
                 return while_converge(
                     body, lambda s: eq_target(s, target), state, limit)
@@ -1741,7 +1906,8 @@ class BroadcastSim:
                                       delays=self.delays,
                                       delay_set=self._delay_set,
                                       plan=rest[0] if rest else None,
-                                      dup_on=self._fp_dup)
+                                      dup_on=self._fp_dup,
+                                      union_block=self._ub)
 
                 return iterate(state, one)
 
@@ -1964,8 +2130,10 @@ class BroadcastSim:
                 "words-major run without its sync_diff closure "
                 "(structured.make_sync_diff / make_sharded_sync_diff), "
                 "or a FaultPlan beyond the loss-only regime (crash/dup "
-                "have no defined reference accounting; gather-path "
-                "loss-only plans keep the ledger — see __init__)")
+                "have no defined reference accounting; loss-only plans "
+                "keep the ledger on the gather path AND on words-major "
+                "nemesis runs whose bundle carries the masked diff "
+                "closures — see __init__)")
         return int(state.srv_msgs)
 
     def inject_mid(self, state: BroadcastState, node: int,

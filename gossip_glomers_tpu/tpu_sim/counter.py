@@ -44,7 +44,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import faults
 from .engine import (Collectives, collectives, donate_argnums_for,
-                     fori_rounds, jit_program)
+                     fori_rounds, jit_program, resolve_block,
+                     scan_blocks)
 
 
 class KVReach(NamedTuple):
@@ -99,7 +100,8 @@ class CounterSim:
                  kv_sched: KVReach | None = None,
                  mesh: Mesh | None = None, seed: int = 0,
                  winner_key: str = "auto",
-                 fault_plan: "faults.FaultPlan | None" = None) -> None:
+                 fault_plan: "faults.FaultPlan | None" = None,
+                 union_block: "int | str | None" = None) -> None:
         """``fault_plan`` (tpu_sim/faults.py): the crash/loss nemesis.
         A down node cannot flush, poll, or win the CAS; on restart its
         AMNESIA row loses ``pending`` (acked-but-unflushed deltas die
@@ -109,7 +111,16 @@ class CounterSim:
         stream models transient per-round KV unreachability (a dropped
         exchange retried next round); duplicate delivery has no effect
         on a read/CAS protocol (the KV correlates by msg id) and is
-        ignored here."""
+        ignored here.
+
+        ``union_block``: destination-slab size of the faulted
+        ALLREDUCE's per-node fault-gate evaluation (liveness + the KV
+        loss coin), run as an ``engine.scan_blocks`` sweep — the same
+        streaming-coin driver the kafka union rides (ISSUE 5).  The
+        counter's masks are O(N), so this is a driver-uniformity knob
+        rather than a memory cliff; None defers to ``GG_UNION_BLOCK``
+        (auto = materialized at every practical N), and parity across
+        block sizes is pinned by tests/test_nemesis.py."""
         if mode not in ("cas", "allreduce"):
             raise ValueError(f"unknown mode {mode!r}")
         if winner_key not in ("auto", "packed", "wide"):
@@ -158,6 +169,10 @@ class CounterSim:
             raise ValueError(
                 f"FaultPlan is for {fault_plan.down.shape[1]} nodes, "
                 f"sim has {n_nodes}")
+        n_sh = 1 if mesh is None else int(mesh.shape["nodes"])
+        # two uint32 coin/mask evaluations per node row
+        self._ub = resolve_block(max(1, n_nodes // n_sh), union_block,
+                                 per_row_bytes=8)
         self._node_spec = P("nodes") if mesh is not None else None
         self._step = self._build_step()
         self._run_n = self._build_run_n(donate=False)
@@ -217,8 +232,28 @@ class CounterSim:
             state = state._replace(
                 pending=jnp.where(wipe, 0, state.pending),
                 cached=jnp.where(wipe, 0, state.cached))
-            reach = (reach & faults.node_up(plan, state.t, row_ids)
-                     & ~faults.kv_drop(plan, state.t, row_ids))
+            if self._ub is not None and self.mode == "allreduce":
+                # streaming fault gate (ISSUE 5): evaluate the per-node
+                # liveness + KV-loss coins slab by slab on the engine's
+                # scan_blocks driver — the counter twin of the kafka
+                # blocked union (stateless coins ⇒ bit-identical to the
+                # materialized gate at any block size)
+                rows, ub = row_ids.shape[0], self._ub
+                t = state.t
+
+                def gate_blk(carry, lo):
+                    ids = lax.dynamic_slice_in_dim(row_ids, lo, ub)
+                    g = (faults.node_up(plan, t, ids)
+                         & ~faults.kv_drop(plan, t, ids))
+                    return lax.dynamic_update_slice_in_dim(
+                        carry, g, lo, axis=0)
+
+                reach = reach & scan_blocks(
+                    gate_blk, jnp.zeros((rows,), bool), rows, ub)
+            else:
+                reach = (reach
+                         & faults.node_up(plan, state.t, row_ids)
+                         & ~faults.kv_drop(plan, state.t, row_ids))
         want = (state.pending > 0) & reach
 
         if self.mode == "allreduce":

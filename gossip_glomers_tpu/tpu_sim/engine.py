@@ -39,10 +39,12 @@ everything else imports it from this module.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 # -- shard_map entry-point compat ---------------------------------------
@@ -302,6 +304,84 @@ def windows_fold(starts, ends, t, body, init):
         init)
 
 
+def scan_blocks(body: Callable, carry, axis_len: int, block: int):
+    """Destination-axis blocking as one ``lax.scan``: ``body(carry,
+    lo) -> carry`` for slab starts ``lo = 0, block, 2*block, ...`` —
+    the streaming-coin driver (ISSUE 5).  The carry is the
+    destination-major accumulator (a per-row inbox / delivery array
+    updated slab by slab via ``dynamic_update_slice``, which XLA
+    aliases in place inside the loop), so a per-link mask evaluation
+    over ``axis_len`` destination rows holds only one ``block``-row
+    slab of coin temps live at a time: O(rows·B·S) instead of the
+    materialized O(rows·N·S).  ``block`` must divide ``axis_len``
+    (use :func:`resolve_block`); a single whole-axis slab skips the
+    scan machinery entirely (bit-identical either way: the coins are
+    stateless hashes of global (t, src, dst))."""
+    if axis_len % block != 0:
+        raise ValueError(
+            f"block {block} must divide the destination axis "
+            f"{axis_len}")
+    n_blocks = axis_len // block
+    if n_blocks == 1:
+        return body(carry, jnp.int32(0))
+    los = jnp.arange(n_blocks, dtype=jnp.int32) * block
+    out, _ = lax.scan(lambda c, lo: (body(c, lo), None), carry, los)
+    return out
+
+
+def _divisors(n: int) -> list:
+    out = set()
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            out.add(d)
+            out.add(n // d)
+        d += 1
+    return sorted(out)
+
+
+def resolve_block(rows: int, setting=None, *, per_row_bytes: int = 1,
+                  budget_bytes: int | None = None) -> int | None:
+    """Static destination-slab size for :func:`scan_blocks`, or None
+    for the materialized whole-axis path (the bit-exactness oracle —
+    the ``repl_fast=False`` pattern applied to blocking).
+
+    ``setting`` (a sim's ``union_block`` constructor arg; None defers
+    to the ``GG_UNION_BLOCK`` env, default ``"auto"``):
+
+    - ``"materialized"`` → None: pin the unblocked path.
+    - an int → that slab size, clamped to the largest divisor of
+      ``rows`` not above it (scan_blocks needs even slabs); <= 0 means
+      materialized.
+    - ``"auto"`` → materialized while the whole-axis mask temp
+      (``rows * per_row_bytes``) fits ``budget_bytes`` (default
+      ``GG_UNION_BLOCK_BUDGET_MB``, 512 MB — small shapes keep the
+      measured-and-pinned unblocked programs), else the largest
+      divisor of ``rows`` whose slab stays inside the budget.
+    """
+    if setting is None:
+        setting = os.environ.get("GG_UNION_BLOCK", "auto")
+    if setting == "materialized":
+        return None
+    if setting == "auto":
+        if budget_bytes is None:
+            budget_bytes = int(os.environ.get(
+                "GG_UNION_BLOCK_BUDGET_MB", "512")) * 1_000_000
+        if rows * per_row_bytes <= budget_bytes:
+            return None
+        # a single row's mask can itself exceed the budget at extreme
+        # shapes — clamp to the smallest slab instead of failing the
+        # construction the streaming path exists to serve
+        return max((d for d in _divisors(rows)
+                    if d * per_row_bytes <= budget_bytes), default=1)
+    b = int(setting)
+    if b <= 0:
+        return None
+    if b >= rows:
+        return rows
+    return max(d for d in _divisors(rows) if d <= b)
+
+
 def scan_rounds(round_fn: Callable, state, xs):
     """R pre-staged rounds as one ``lax.scan``: ``round_fn(state, x) ->
     state`` over the leading axis of the ``xs`` pytree."""
@@ -383,6 +463,42 @@ def memory_footprint(jitted: Callable, *args, **kw) -> dict | None:
     (and only compiles — use :func:`aot_compile` when the same program
     will also be executed)."""
     return aot_compile(jitted, *args, **kw)[1]
+
+
+def operand_bytes(tree) -> int:
+    """Total bytes of a traced operand pytree (a compiled FaultPlan,
+    a KVReach schedule, staged batch arrays, ...) — the operand term of
+    :func:`analytic_peak_bytes`.  Works on concrete arrays and on
+    ShapeDtypeStruct-like leaves alike."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def analytic_peak_bytes(*, state_bytes: int, operand_bytes: int = 0,
+                        slab_bytes: int = 0,
+                        donated: bool = True) -> dict:
+    """The ONE audited analytic peak-live-bytes formula behind the
+    OOM-boundary rows (BENCH_PR5.json, the config-7 convention):
+
+        peak ≈ state x (1 donated / 2 undonated, the engine's aliasing
+               contract) + traced operands (FaultPlan leaves, staged
+               batches — never donated) + transient slab temps (the
+               blocked coin slab of scan_blocks, or the whole
+               materialized mask for the unblocked path).
+
+    The XLA-measured twin is :func:`memory_footprint` (which reads the
+    compiled buffer assignment and therefore already counts the plan
+    operands and the blocked carry); this formula is for shapes too
+    big to compile — the boundary rows — and is pinned against the
+    measured footprint at small shapes by tests/test_engine.py."""
+    state_term = state_bytes * (1 if donated else 2)
+    return {"state_bytes": state_bytes,
+            "operand_bytes": operand_bytes,
+            "slab_bytes": slab_bytes,
+            "donated": donated,
+            "peak_live_bytes": state_term + operand_bytes + slab_bytes}
 
 
 def donate_argnums_for(donate: bool, *argnums: int) -> tuple:

@@ -302,6 +302,28 @@ def edge_dup(plan: FaultPlan, t, src, dst) -> jnp.ndarray:
     return (t < plan.dup_until) & (h < plan.dup_num)
 
 
+def coin_block(plan: FaultPlan, t, src_ids: jnp.ndarray, dst_lo,
+               block: int, *, dup: bool = False):
+    """Streaming coin evaluation for ONE destination slab (the ISSUE-5
+    tentpole primitive): ``(up, drop, dup | None)`` for the global
+    destination rows ``dst_lo + [0, block)`` against the (flat) global
+    ``src_ids`` — ``up`` is the (block,) destination liveness, ``drop``
+    / ``dup`` are the (block, len(src_ids)) per-link coins.
+
+    The coins are stateless hashes of (t, src, dst), so evaluating
+    them slab by slab inside an ``engine.scan_blocks`` sweep is
+    bit-identical to the materialized full-axis ``edge_drop`` /
+    ``edge_dup`` masks — nothing forces the O(rows·N·S) widening; the
+    peak mask temp drops to O(block·N·S).  ``dst_lo`` may be traced
+    (a scan slab start, plus the shard's global row offset)."""
+    dst = dst_lo + jnp.arange(block, dtype=jnp.int32)
+    up = node_up(plan, t, dst)
+    drop = edge_drop(plan, t, src_ids[None, :], dst[:, None])
+    dups = (edge_dup(plan, t, src_ids[None, :], dst[:, None])
+            if dup else None)
+    return up, drop, dups
+
+
 def kv_drop(plan: FaultPlan, t, ids) -> jnp.ndarray:
     """bool, shaped like ``ids`` — node i's KV exchange is lost this
     round (transient service unreachability: the node retries next
@@ -350,6 +372,12 @@ class WMNemesisArrays(NamedTuple):
     deg_exists: jnp.ndarray     # (Dg, N) bool — ledger edges
     deg_same: jnp.ndarray       # (P, Dg, N) bool
     deg_down_pair: jnp.ndarray  # (C, Dg, N) bool
+    # (Dg, N) uint32 — sender/receiver ids of the DEGREE-contract rows:
+    # the loss-only srv ledger's ack/diff coins (the gather path's
+    # out_ok term) are elementwise hashes over these, one coin pair per
+    # in-edge of each receiver column
+    deg_src: jnp.ndarray
+    deg_dst: jnp.ndarray
     down_cols: jnp.ndarray      # (C, N) bool — amnesia / receiver-up
 
 
@@ -361,7 +389,7 @@ def wm_specs(sharded: bool) -> WMNemesisArrays:
     full-axis masks)."""
     r2 = P(None, "nodes") if sharded else P(None, None)
     r3 = P(None, None, "nodes") if sharded else P(None, None, None)
-    return WMNemesisArrays(r2, r3, r3, r2, r2, r2, r3, r3, r2)
+    return WMNemesisArrays(r2, r3, r3, r2, r2, r2, r3, r3, r2, r2, r2)
 
 
 def crash_down_rows(spec: "NemesisSpec", ids) -> np.ndarray:
@@ -419,6 +447,23 @@ def wm_live_del(plan: FaultPlan, t, arrs: WMNemesisArrays,
     dup = (live_del & edge_dup(plan, t, arrs.src, arrs.dst)
            if dup_on else None)
     return live_del, dup
+
+
+def wm_srv_rows(plan: FaultPlan, t, arrs: WMNemesisArrays,
+                pstarts, pends):
+    """(live, ack, both) — the LOSS-ONLY srv-ledger mask rows over the
+    DEGREE contract at round ``t``, (Dg, n_cols) each: ``live`` is the
+    send-liveness (requests charged at send time), ``ack`` additionally
+    requires the receiver column's OUTGOING coin (the reply exists only
+    when the triggering request delivered — the gather path's
+    ``out_ok`` term, here an elementwise hash over the precomputed
+    deg_dst -> deg_src ids), and ``both`` requires BOTH direction
+    coins (the sync-diff pairs).  Bit-identical to the gather path's
+    per-slot streams: same (t, src, dst) triples, same coins."""
+    live = wm_live_rows(plan, t, arrs, pstarts, pends, deg=True)
+    out_ok = ~edge_drop(plan, t, arrs.deg_dst, arrs.deg_src)
+    in_ok = ~edge_drop(plan, t, arrs.deg_src, arrs.deg_dst)
+    return live, live & out_ok, live & in_ok & out_ok
 
 
 # -- host mirrors (for op staging and ack accounting) --------------------

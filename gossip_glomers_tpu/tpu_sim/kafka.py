@@ -90,8 +90,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import faults
 from .counter import KVReach, _reach
-from .engine import (collectives, donate_argnums_for, jit_program,
-                     scan_rounds)
+from .engine import (analytic_peak_bytes, collectives,
+                     donate_argnums_for, jit_program, operand_bytes,
+                     resolve_block, scan_blocks, scan_rounds)
 
 
 class KafkaState(NamedTuple):
@@ -141,7 +142,8 @@ class KafkaSim:
                  repl_fast: bool | None = None,
                  fault_plan: "faults.FaultPlan | None" = None,
                  resync_every: int = 4,
-                 resync_mode: str = "pull") -> None:
+                 resync_mode: str = "pull",
+                 union_block: "int | str | None" = None) -> None:
         """``kv_sched``: lin-kv reachability windows (counter.KVReach —
         the same nemesis shape the counter's flush is gated by).  A
         node partitioned from lin-kv at round t:
@@ -202,7 +204,26 @@ class KafkaSim:
           round is NOT re-replicated until the origin restarts —
           narrower per-round coverage than the pull union, same
           converged fixpoint once every origin has been live for a
-          resync round."""
+          resync round.
+
+        ``union_block`` (ISSUE 5 tentpole): the destination-slab size
+        of the STREAMING faulted union — the ``union_nem`` coins are
+        stateless hashes of (t, src, dst), so instead of the
+        materialized (rows, N·S) coin tensor (the inherent-looking N²
+        cost of per-link loss on a full mesh — the PR-4 faulted
+        ceiling at 4,096 nodes) the round evaluates them on the fly
+        over destination slabs inside one ``engine.scan_blocks``
+        sweep: O(rows·B·S) mask temps, bit-identical results.  On a
+        mesh each shard scans only its LOCAL destination rows and the
+        per-send metadata visits shards by ring ppermute (one block
+        rotation per shard step) instead of the materialized path's
+        all_gather — the blocked sharded step HLO stays
+        all-gather-free.  None defers to ``GG_UNION_BLOCK`` (default
+        ``"auto"``: materialized while the whole coin tensor fits the
+        slab budget — small shapes keep the measured PR-4 programs);
+        an int pins the slab; ``"materialized"`` pins the unblocked
+        path as the blocking bit-exactness oracle (the ``repl_fast=
+        False`` pattern, one level up)."""
         self.n_nodes = n_nodes
         self.n_keys = n_keys
         self.capacity = capacity
@@ -231,6 +252,16 @@ class KafkaSim:
         self._fp_active = fault_plan is not None and (
             int(fault_plan.starts.shape[0]) > 0
             or int(fault_plan.loss_num) > 0)
+        # streaming-union destination slab (None = materialized): per
+        # LOCAL destination row the union_nem coin slab costs N·S
+        # uint32 hashes
+        n_sh = 1 if mesh is None else int(mesh.shape["nodes"])
+        if n_nodes % n_sh != 0:
+            raise ValueError("node axis must shard evenly")
+        self._rows_local = n_nodes // n_sh
+        self._ub = resolve_block(
+            self._rows_local, union_block,
+            per_row_bytes=n_nodes * max_sends * 4)
         self._run_rounds = {}
         self._step_progs = {}
         self._poll_batch_fn = None
@@ -395,14 +426,16 @@ class KafkaSim:
             deliver = reduce_or(jnp.zeros((k_dim, wc), jnp.uint32).at[
                 scat_k, word_idx].add(bit, mode="drop"))[None]
             present = state.present | deliver
-        elif repl_mode == "union_nem":
-            # faulted origin-union: the coins need (origin, dest)
-            # pairs, so widen the tiny per-send metadata ((N, S) ints —
-            # the ONE gather of this path; presence never moves) and
-            # fold liveness + the loss stream elementwise into the
-            # delivery bits.  bit == 0 already encodes "no append"
-            # (ok ⇒ bit >= 1), and a capacity-dropped key scatters out
-            # of bounds, so no separate ok mask is needed.
+        elif repl_mode == "union_nem" and self._ub is None:
+            # MATERIALIZED faulted origin-union (the blocking
+            # bit-exactness oracle — ``union_block="materialized"``):
+            # the coins need (origin, dest) pairs, so widen the tiny
+            # per-send metadata ((N, S) ints — the ONE gather of this
+            # path; presence never moves) and fold liveness + the loss
+            # stream elementwise into the delivery bits as one
+            # (rows, N·S) coin tensor.  bit == 0 already encodes "no
+            # append" (ok ⇒ bit >= 1), and a capacity-dropped key
+            # scatters out of bounds, so no separate ok mask is needed.
             g_bit = widen(bit.reshape(rows, s_dim)).reshape(-1)
             g_k = widen(scat_k.reshape(rows, s_dim)).reshape(-1)
             g_w = widen(word_idx.reshape(rows, s_dim)).reshape(-1)
@@ -420,6 +453,60 @@ class KafkaSim:
                 :, g_k, g_w].add(
                 jnp.where(recv, g_bit[None, :], jnp.uint32(0)),
                 mode="drop")
+            present = state.present | deliver
+        elif repl_mode == "union_nem":
+            # STREAMING faulted origin-union (ISSUE 5): same coins,
+            # never materialized — a scan_blocks sweep over destination
+            # slabs evaluates each slab's (B, rows·S) coin block on the
+            # fly (faults.coin_block) and ORs the surviving bits into
+            # the delivery carry in place.  Cross-shard, the per-send
+            # metadata makes one ring circuit (a block ppermute per
+            # shard step — each shard scans only its LOCAL destination
+            # rows against every visiting origin block), so the
+            # compiled sharded step has NO all-gather, matching the
+            # fault-free union contract.  Disjoint-bit ORs commute, so
+            # any (block, shard-step) order is bit-identical to the
+            # materialized oracle.
+            ub = self._ub
+            n_sh = n // rows
+            shard0 = row_ids[0]
+            cur_bit, cur_k, cur_w = bit, scat_k, word_idx  # (rows*S,)
+
+            def rot(x):
+                return lax.ppermute(
+                    x, coll.axis_name,
+                    [(p, (p + 1) % n_sh) for p in range(n_sh)])
+
+            i_row = jnp.repeat(jnp.arange(rows, dtype=jnp.int32), s_dim)
+            deliver = jnp.zeros((rows, k_dim, wc), jnp.uint32)
+            for step in range(n_sh):
+                # after `step` rotations the local metadata block came
+                # from shard (p - step) mod n_sh — global origin rows
+                base = (shard0 - jnp.int32(step * rows)) % jnp.int32(n)
+                g_origin = base + i_row
+                o_bit, o_k, o_w = cur_bit, cur_k, cur_w
+
+                def blk(carry, lo, g_origin=g_origin, o_bit=o_bit,
+                        o_k=o_k, o_w=o_w):
+                    dst_lo = shard0 + lo
+                    up_b, drop_b, _ = faults.coin_block(
+                        plan, state.t, g_origin, dst_lo, ub)
+                    dst = dst_lo + jnp.arange(ub, dtype=jnp.int32)
+                    recv = ((up_b[:, None] & ~drop_b)
+                            | (g_origin[None, :] == dst[:, None]))
+                    d_blk = jnp.zeros((ub, k_dim, wc), jnp.uint32).at[
+                        :, o_k, o_w].add(
+                        jnp.where(recv, o_bit[None, :], jnp.uint32(0)),
+                        mode="drop")
+                    old = lax.dynamic_slice_in_dim(carry, lo, ub,
+                                                   axis=0)
+                    return lax.dynamic_update_slice_in_dim(
+                        carry, old | d_blk, lo, axis=0)
+
+                deliver = scan_blocks(blk, deliver, rows, ub)
+                if step + 1 < n_sh:
+                    cur_bit, cur_k, cur_w = (rot(cur_bit), rot(cur_k),
+                                             rot(cur_w))
             present = state.present | deliver
         else:
             if up_rows is not None:
@@ -651,6 +738,46 @@ class KafkaSim:
         if not (repl_ok is None or bool(np.all(repl_ok))):
             return "matmul"
         return "union_nem" if self._fp_active else "union"
+
+    def union_footprint(self, *, block: "int | None | str" = "resolved",
+                        donated: bool = True) -> dict:
+        """Audited analytic footprint of one faulted ``union_nem``
+        round (engine.analytic_peak_bytes — the BENCH_PR5 OOM-boundary
+        formula, pinned at a known shape by tests/test_engine.py):
+
+        - state: the donated pytree held live across the round
+          (presence + log content + cells + HWM cache + origin bits);
+        - operands: the FaultPlan leaves (traced, never donated);
+        - slab: the transient replication temps — the coin-mask slab
+          (``block`` × N·S uint32 coins; the whole (rows, N·S) tensor
+          on the materialized path) plus the (rows, K, Wc) delivery
+          carry.
+
+        ``block="resolved"`` uses this sim's resolved slab;
+        ``block=None`` prices the MATERIALIZED path (what provably
+        cannot fit once rows·N·S·4 alone exceeds a chip's HBM)."""
+        rows = self._rows_local
+        if block == "resolved":
+            block = self._ub
+        eff = rows if block is None else int(block)
+        n, k, wc = self.n_nodes, self.n_keys, self.n_pwords
+        state = (n * k * wc * 4                  # present
+                 + k * self.capacity * 4        # log_vals
+                 + k * 4                         # kv_val
+                 + n * k * 4                     # local_committed
+                 + (n * k * wc * 4 if self._push else 0))
+        coin = eff * n * self.max_sends * 4
+        deliver = rows * k * wc * 4
+        plan_b = (operand_bytes(self.fault_plan)
+                  if self.fault_plan is not None else 0)
+        out = analytic_peak_bytes(state_bytes=state,
+                                  operand_bytes=plan_b,
+                                  slab_bytes=coin + deliver,
+                                  donated=donated)
+        out.update(block=eff if block is not None else None,
+                   coin_slab_bytes=coin, deliver_carry_bytes=deliver,
+                   materialized=block is None)
+        return out
 
     def _step_prog(self, repl_mode: str):
         """The one-round program, keyed by the (static) replication

@@ -1907,7 +1907,12 @@ class StructuredNemesis(NamedTuple):
       caveat of :func:`make_delayed` apply.
     - ``exchange(take, lv)`` / ``src_pc(d, pc)``: full-axis delivery
       and count-relocation closures (see :func:`_nem_closures`);
-      ``sharded_*`` are the halo twins (None → all_gather fallback)."""
+      ``sharded_*`` are the halo twins (None → all_gather fallback).
+    - ``sync_diff(recv, rows)`` / ``sharded_sync_diff``: the masked
+      per-edge diff closures over the DEGREE contract (the same
+      :func:`_masked_diffs` accounting the partition-only bundles
+      carry) — the LOSS-ONLY srv ledger's sync-wave term, fed the
+      both-coin rows from faults.wm_srv_rows."""
 
     arrs: "faults.WMNemesisArrays"
     dir_delays: tuple | None
@@ -1916,6 +1921,8 @@ class StructuredNemesis(NamedTuple):
     src_pc: Callable
     sharded_exchange: Callable | None
     sharded_src_pc: Callable | None
+    sync_diff: Callable | None
+    sharded_sync_diff: Callable | None
 
 
 def make_nemesis(topology: str, n: int, spec: "faults.NemesisSpec",
@@ -1957,6 +1964,10 @@ def make_nemesis(topology: str, n: int, spec: "faults.NemesisSpec",
         deg_exists=jnp.asarray(deg_src >= 0),
         deg_same=jnp.asarray(_same_groups(g, deg_src, deg_dst, n)),
         deg_down_pair=jnp.asarray(deg_down_pair),
+        deg_src=jnp.asarray(np.clip(deg_src, 0, n - 1)
+                            .astype(np.uint32)),
+        deg_dst=jnp.asarray(np.clip(deg_dst, 0, n - 1)
+                            .astype(np.uint32)),
         down_cols=jnp.asarray(faults.crash_down_rows(spec, idx)))
     if dir_delays is not None:
         dd = tuple(int(x) for x in dir_delays)
@@ -1973,4 +1984,11 @@ def make_nemesis(topology: str, n: int, spec: "faults.NemesisSpec",
                                 axis_name=axis_name, **kw)
     ex, spc, sex, sspc = _nem_closures(topology, n, n_shards,
                                        axis_name, halo, **kw)
-    return StructuredNemesis(arrs, dd, ring, ex, spc, sex, sspc)
+    # the masked per-edge diff closures (one accounting definition per
+    # topology, shared with make_faulted/make_delayed_faulted): the
+    # loss-only srv ledger's sync term, over the deg-contract rows
+    diffs = _masked_diffs(topology, n, n_shards,
+                          axis_name=axis_name, halo=halo, **kw)
+    df, sdf = diffs if diffs is not None else (None, None)
+    return StructuredNemesis(arrs, dd, ring, ex, spc, sex, sspc,
+                             df, sdf)

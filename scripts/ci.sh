@@ -22,9 +22,11 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
 # dedicated nemesis tests.  (CPU, seconds.)
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python scripts/fault_smoke.py || rc=1
-# Kafka scale smoke (PR 4): 4-device sharded-kafka parity (union +
-# faulted origin-union, no all-gather in the sharded step HLO) + the
-# kafka mesh-takeover at a small shape on the 8-way virtual mesh.
+# Kafka scale smoke (PR 4 + PR 5): 4-device sharded-kafka parity
+# (union + faulted origin-union + the BLOCKED streaming union, with
+# no all-gather in either sharded step HLO — the blocked step's
+# metadata rides a ring ppermute) + the kafka mesh-takeover at a
+# small shape on the 8-way virtual mesh.
 # (CPU, seconds.)  Outer budget > the smoke's inner 600 s subprocess
 # timeout so a wedged takeover surfaces its diagnostic dict instead
 # of a bare SIGTERM.

@@ -12,6 +12,11 @@
 - **kafka mesh-takeover smoke**: benchmarks/mesh_takeover.py kafka
   mode at a small shape (subprocess: its own 8-device virtual mesh)
   must allocate every send and report ok.
+- **blocked-union bit-exactness leg (PR 5)**: the streaming
+  destination-slab union (union_block) must be bit-identical to the
+  materialized union_nem on the 4-device mesh, and the BLOCKED sharded
+  step HLO must contain no all-gather (the per-send metadata rides a
+  ring ppermute instead of the materialized path's widen).
 """
 
 from __future__ import annotations
@@ -71,8 +76,27 @@ def parity_4dev() -> None:
     for a, b, name in zip(t1, t2, t1._fields):
         assert (np.asarray(a) == np.asarray(b)).all(), \
             f"faulted 4-dev mismatch: {name}"
-    print("kafka 4-device sharded parity OK (union + union_nem, "
-          "no all-gather)")
+    # blocked-union leg (PR 5): streaming slabs bit-exact with the
+    # materialized union_nem above, and the blocked sharded step HLO
+    # stays all-gather-free (ring-ppermute metadata circuit)
+    b_shd = KafkaSim(n, k, capacity=cap, max_sends=s,
+                     fault_plan=spec.compile(), mesh=mesh,
+                     union_block=1)
+    assert b_shd._ub == 1
+    t3 = b_shd.run_rounds(b_shd.init_state(), fs, fv, fc)
+    for a, b, name in zip(t1, t3, t1._fields):
+        assert (np.asarray(a) == np.asarray(b)).all(), \
+            f"blocked 4-dev mismatch: {name}"
+    bprog = b_shd._step_prog("union_nem")
+    bargs = [jnp.full((n, s), -1, jnp.int32),
+             jnp.zeros((n, s), jnp.int32),
+             jnp.full((n, k), -1, jnp.int32), b_shd.kv_sched,
+             b_shd.fault_plan]
+    bhlo = bprog.lower(b_shd.init_state(), *bargs).compile().as_text()
+    assert "all-gather" not in bhlo, \
+        "blocked sharded union_nem step regained an all-gather"
+    print("kafka 4-device sharded parity OK (union + union_nem + "
+          "blocked union, no all-gather)")
 
 
 def takeover_smoke() -> None:

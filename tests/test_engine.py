@@ -360,6 +360,108 @@ def test_counter_wide_sharded_step_hlo_has_no_all_gather():
         assert (np.asarray(x) == np.asarray(y)).all()
 
 
+# -- streaming-coin blocked replication (ISSUE 5) -----------------------
+
+
+def test_scan_blocks_and_resolve_block():
+    # the blocked driver: slab-wise body sweeps reproduce the whole
+    # axis exactly, and the block pick honors the env/explicit/auto
+    # contract (divisor-clamped, materialized below the budget)
+    x = jnp.arange(24, dtype=jnp.int32)
+
+    def body(carry, lo):
+        sl = jax.lax.dynamic_slice_in_dim(x, lo, 6)
+        return jax.lax.dynamic_update_slice_in_dim(carry, sl * 2, lo,
+                                                   axis=0)
+
+    out = engine.scan_blocks(body, jnp.zeros((24,), jnp.int32), 24, 6)
+    assert (np.asarray(out) == np.arange(24) * 2).all()
+    with pytest.raises(ValueError, match="divide"):
+        engine.scan_blocks(body, x, 24, 7)
+    # explicit ints clamp to divisors; <= 0 and "materialized" pin the
+    # unblocked path; auto blocks only past the budget
+    assert engine.resolve_block(24, 6) == 6
+    assert engine.resolve_block(24, 7) == 6        # largest divisor <= 7
+    assert engine.resolve_block(24, 100) == 24
+    assert engine.resolve_block(24, 0) is None
+    assert engine.resolve_block(24, "materialized") is None
+    assert engine.resolve_block(
+        1024, "auto", per_row_bytes=1, budget_bytes=1 << 20) is None
+    assert engine.resolve_block(
+        1024, "auto", per_row_bytes=1 << 12, budget_bytes=1 << 20) == 256
+
+
+def test_kafka_union_footprint_formula_pinned():
+    # the ONE audited analytic OOM-boundary formula (BENCH_PR5 rows):
+    # state + FaultPlan operand + coin slab + delivery carry, pinned
+    # number by number at a known shape
+    from gossip_glomers_tpu.tpu_sim import faults as F
+    n, k, cap, s, b = 256, 16, 32, 8, 32
+    spec = F.NemesisSpec(n_nodes=n, seed=1, crash=((1, 3, (0, 5)),),
+                         loss_rate=0.1, loss_until=4)
+    sim = KafkaSim(n, k, capacity=cap, max_sends=s,
+                   fault_plan=spec.compile(), union_block=b)
+    fp = sim.union_footprint()
+    state = n * k * 1 * 4 + k * cap * 4 + k * 4 + n * k * 4
+    plan = 4 + 4 + n * 1 + 4 + 4 + 4 + 4 + 4   # FaultPlan leaves
+    assert fp["block"] == b
+    assert fp["coin_slab_bytes"] == b * n * s * 4
+    assert fp["deliver_carry_bytes"] == n * k * 1 * 4
+    assert fp["state_bytes"] == state
+    assert fp["operand_bytes"] == plan
+    assert fp["peak_live_bytes"] == (state + plan + b * n * s * 4
+                                     + n * k * 4)
+    # the materialized pricing of the same sim: the (rows, N·S) coin
+    # tensor the blocked path exists to avoid
+    fm = sim.union_footprint(block=None)
+    assert fm["materialized"] and fm["coin_slab_bytes"] == n * n * s * 4
+
+
+def test_kafka_blocked_union_memory_footprint_shrinks():
+    # XLA's buffer assignment confirms the formula's point: at a
+    # coin-dominated shape the blocked step's peak live bytes drop
+    # well below the materialized step's (the (rows, N·S) tensor gone)
+    from gossip_glomers_tpu.tpu_sim import faults as F
+    n, k, cap, s = 256, 16, 32, 8
+    spec = F.NemesisSpec(n_nodes=n, seed=1, crash=((1, 3, (0, 5)),),
+                         loss_rate=0.1, loss_until=4)
+    args = [jnp.full((n, s), -1, jnp.int32), jnp.zeros((n, s), jnp.int32),
+            jnp.full((n, k), -1, jnp.int32)]
+    sizes = {}
+    for name, ub in (("mat", "materialized"), ("blk", 16)):
+        sim = KafkaSim(n, k, capacity=cap, max_sends=s,
+                       fault_plan=spec.compile(), union_block=ub)
+        prog = sim._step_prog("union_nem")
+        m = engine.memory_footprint(prog, sim.init_state(), *args,
+                                    sim.kv_sched, sim.fault_plan)
+        if m is None:
+            pytest.skip("backend exposes no memory_analysis")
+        sizes[name] = m["peak_live_bytes"]
+    # materialized holds the full 256 x 2048 coin tensor (uint32
+    # hashes + masks, ~2-8 MB of temps); the 16-row slab holds 1/16th
+    assert sizes["blk"] < sizes["mat"] - n * n * s  # at least the bool
+
+
+def test_kafka_blocked_sharded_step_hlo_has_no_all_gather():
+    # the blocked-union sharded contract (ISSUE 5): each shard scans
+    # only its LOCAL destination rows and the per-send metadata rides
+    # a ring ppermute — the compiled faulted step has NO all-gather
+    # (the materialized union_nem widens the metadata instead)
+    from gossip_glomers_tpu.tpu_sim import faults as F
+    n, k, s = 16, 4, 2
+    spec = F.NemesisSpec(n_nodes=n, seed=5, crash=((2, 4, (1,)),),
+                         loss_rate=0.2, loss_until=6)
+    sim = KafkaSim(n, k, capacity=64, max_sends=s, mesh=mesh_1d(),
+                   fault_plan=spec.compile(), union_block=1)
+    prog = sim._step_prog("union_nem")
+    args = [jnp.full((n, s), -1, jnp.int32), jnp.zeros((n, s), jnp.int32),
+            jnp.full((n, k), -1, jnp.int32), sim.kv_sched,
+            sim.fault_plan]
+    hlo = prog.lower(sim.init_state(), *args).compile().as_text()
+    assert "all-gather" not in hlo
+    assert "collective-permute" in hlo
+
+
 # -- engine internals ---------------------------------------------------
 
 

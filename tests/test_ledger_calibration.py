@@ -132,12 +132,14 @@ def test_broadcast_srv_ledger_loss_only_matches_virtual_harness():
 
 def test_broadcast_srv_ledger_stays_off_beyond_loss_only():
     """Crash windows or a dup stream have no defined reference
-    accounting for the srv ledger — those plans (and the words-major
-    path) still force it off, loudly."""
+    accounting for the srv ledger — those plans still force it off,
+    loudly, on the gather path AND on the words-major nemesis path
+    (PR 5 enables only the loss-only regime there)."""
     import pytest
     from gossip_glomers_tpu.parallel.topology import (grid,
                                                       to_padded_neighbors)
     from gossip_glomers_tpu.tpu_sim import faults as F
+    from gossip_glomers_tpu.tpu_sim import structured as S
     from gossip_glomers_tpu.tpu_sim.broadcast import BroadcastSim
 
     nbrs = to_padded_neighbors(grid(16))
@@ -146,15 +148,87 @@ def test_broadcast_srv_ledger_stays_off_beyond_loss_only():
     loss = F.NemesisSpec(n_nodes=16, seed=0, loss_rate=0.2,
                          loss_until=4)
     for spec, on in ((crash, False), (dup, False), (loss, True)):
-        sim = BroadcastSim(nbrs, n_values=8,
-                           fault_plan=spec.compile())
-        state = sim.init_state(np.zeros((16, 1), np.uint32))
-        state = sim.step(state)
-        if on:
-            assert sim.server_msgs(state) >= 0
-        else:
-            with pytest.raises(ValueError, match="loss-only"):
-                sim.server_msgs(state)
+        for wm in (False, True):
+            kw = (dict(exchange=S.make_exchange("grid", 16),
+                       nemesis=S.make_nemesis("grid", 16, spec))
+                  if wm else {})
+            sim = BroadcastSim(nbrs, n_values=8,
+                               fault_plan=spec.compile(), **kw)
+            state = sim.init_state(np.zeros((16, 1), np.uint32))
+            state = sim.step(state)
+            if on:
+                assert sim.server_msgs(state) >= 0
+            else:
+                with pytest.raises(ValueError, match="loss-only"):
+                    sim.server_msgs(state)
+    # per-direction delays composed into the bundle force it off too
+    # (same stance as gather `delays`)
+    simd = BroadcastSim(nbrs, n_values=8, fault_plan=loss.compile(),
+                        exchange=S.make_exchange("grid", 16),
+                        nemesis=S.make_nemesis("grid", 16, loss,
+                                               dir_delays=(1, 2, 1, 1)))
+    state = simd.step(simd.init_state(np.zeros((16, 1), np.uint32)))
+    with pytest.raises(ValueError, match="loss-only"):
+        simd.server_msgs(state)
+
+
+def test_broadcast_srv_ledger_loss_only_words_major_matches_gather():
+    """The PR-5 words-major loss-only srv ledger (ROADMAP STILL OPEN
+    item): the structured nemesis bundle's deg-contract coin rows +
+    masked diff closures reproduce the gather path's calibrated
+    loss-only accounting BIT-EXACTLY, round by round — tree and grid,
+    single-device and halo-sharded, sync waves included.  The gather
+    ledger itself is calibrated message-for-message against the
+    virtual harness above
+    (test_broadcast_srv_ledger_loss_only_matches_virtual_harness), so
+    equality here carries the harness calibration over."""
+    import jax
+    from jax.sharding import Mesh
+    from gossip_glomers_tpu.parallel.topology import (grid,
+                                                      to_padded_neighbors,
+                                                      tree)
+    from gossip_glomers_tpu.tpu_sim import faults as F
+    from gossip_glomers_tpu.tpu_sim import structured as S
+    from gossip_glomers_tpu.tpu_sim.broadcast import (BroadcastSim,
+                                                      make_inject)
+
+    n, nv, rounds = 64, 48, 12
+    spec = F.NemesisSpec(n_nodes=n, seed=5, loss_rate=0.25,
+                         loss_until=10)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("nodes",))
+    for topo, build, halo in (
+            ("tree", lambda: to_padded_neighbors(tree(n, branching=4)),
+             True),
+            # the 8x8 grid has no halo decomposition at 8 shards
+            # (shift stride == block), so its sharded srv stays off —
+            # single-device parity only
+            ("grid", lambda: to_padded_neighbors(grid(n)), False)):
+        nbrs = build()
+        g = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                         fault_plan=spec.compile())
+        w = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                         fault_plan=spec.compile(),
+                         exchange=S.make_exchange(topo, n),
+                         nemesis=S.make_nemesis(topo, n, spec))
+        sims = [g, w]
+        if halo:
+            sims.append(BroadcastSim(
+                nbrs, n_values=nv, sync_every=4,
+                fault_plan=spec.compile(), mesh=mesh,
+                exchange=S.make_exchange(topo, n),
+                sharded_exchange=S.make_sharded_exchange(topo, n, 8),
+                nemesis=S.make_nemesis(topo, n, spec, n_shards=8)))
+        inject = make_inject(n, nv)
+        states = [s.init_state(inject) for s in sims]
+        for t in range(rounds):
+            states = [s.step(st) for s, st in zip(sims, states)]
+            srv = [s.server_msgs(st) for s, st in zip(sims, states)]
+            assert len(set(srv)) == 1, (topo, t, srv)
+            assert len({int(st.msgs) for st in states}) == 1
+        recs = [s.received_node_major(st)
+                for s, st in zip(sims, states)]
+        for r in recs[1:]:
+            assert (recs[0] == r).all(), topo
 
 
 # -- counter ------------------------------------------------------------
@@ -223,6 +297,59 @@ def test_counter_ledger_matches_harness_polls():
 
     assert harness_msgs == 2 * n * q
     assert int(st.msgs) == harness_msgs
+
+
+def test_counter_kv_retries_lossy_harness_ledger_calibration():
+    """The ROADMAP open item from PR 3: recalibrate ``kv_retries > 0``
+    under a LOSSY virtual harness, message for message.  One flush
+    whose first read request is dropped in flight: with transport
+    retries the backed-off re-issue completes the SAME attempt, so the
+    wire carries exactly one extra message per drop —
+
+        dropped read (charged at send, like every ledger here)
+        + retry read + read_ok + cas + cas_ok            = 5 messages
+
+    versus the fault-free flush's 4.  The sim twin keeps the
+    reference-parity fault-free ledger (CounterSim charges 4 per
+    flush), so the retry regime calibrates as ``harness ==
+    sim + ledger.dropped`` — each transport drop costs exactly its one
+    dead request, nothing else changes (no second CAS, no abandoned
+    attempt), and the KV lands the identical value."""
+    n = 1
+    cfg = CounterConfig(flush_interval=1.0, kv_op_timeout=0.1,
+                        kv_retries=2, kv_backoff_base=0.05,
+                        kv_backoff_cap=0.2, poll_interval=1e6)
+    net = _counter_net(n, cfg)
+    client = net.client("c1")
+    client.rpc("n0", {"type": "add", "delta": 7})
+    net.run_for(0.0)
+    base = net.ledger.server_to_server
+    assert base == 0
+    # drop exactly the FIRST n0 -> seq-kv request (the flush's read);
+    # the retry and everything after delivers
+    state = {"drops": 0}
+
+    def drop(src, dest, now):
+        if src == "n0" and dest == "seq-kv" and state["drops"] < 1:
+            state["drops"] += 1
+            return True
+        return False
+
+    net.drop_fn = drop
+    # flush tick at t=1.0; timeout 0.1 + jittered backoff <= 0.2 + the
+    # retried attempt — quiescent well before the next idle tick
+    net.run_for(1.8)
+    harness_msgs = net.ledger.server_to_server - base
+    assert net.ledger.dropped == 1
+
+    sim = CounterSim(n, mode="cas", poll_every=0)
+    st = sim.add(sim.init_state(), np.array([7], np.int32))
+    st = sim.run(st, 1)
+
+    assert harness_msgs == 5                      # enumerated above
+    assert int(st.msgs) + net.ledger.dropped == harness_msgs
+    assert (int(sim.kv_value(st))
+            == net.services["seq-kv"].store[cfg.kv_key] == 7)
 
 
 # -- kafka --------------------------------------------------------------

@@ -335,6 +335,156 @@ def test_kafka_faulted_scan_matches_stepwise_and_mesh():
         assert (np.asarray(a) == np.asarray(b)).all(), name
 
 
+# -- streaming-coin blocked replication (ISSUE 5) -----------------------
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_kafka_blocked_union_three_way_parity(use_mesh):
+    # the PR-5 tentpole contract: blocked streaming union vs the
+    # materialized union_nem oracle vs the repl_fast=False matmul
+    # oracle, bit-identical state AND ledger under crash+loss+dup, on
+    # {single-device, 8-way virtual mesh} x {stepwise, donated fused}
+    spec = F.NemesisSpec(n_nodes=16, seed=11, crash=((3, 7, (1, 4)),),
+                         loss_rate=0.25, loss_until=10,
+                         dup_rate=0.1, dup_until=10)
+    n, k, cap, s, r = 16, 4, 64, 2, 10
+    sks, svs, crs = nemesis.stage_kafka_ops(spec, r, n_keys=k,
+                                            max_sends=s)
+    mesh = mesh_1d() if use_mesh else None
+    sims = {
+        "blocked": KafkaSim(n, k, capacity=cap, max_sends=s, mesh=mesh,
+                            fault_plan=spec.compile(), union_block=1),
+        "materialized": KafkaSim(n, k, capacity=cap, max_sends=s,
+                                 mesh=mesh, fault_plan=spec.compile(),
+                                 union_block="materialized"),
+        "matmul": KafkaSim(n, k, capacity=cap, max_sends=s, mesh=mesh,
+                           fault_plan=spec.compile(), repl_fast=False),
+    }
+    assert sims["blocked"]._ub == 1
+    assert sims["materialized"]._ub is None
+    # donated fused driver
+    fused = {name: sim.run_fused(sim.init_state(), sks, svs, crs)
+             for name, sim in sims.items()}
+    # stepwise driver (separate program cache)
+    stepw = {}
+    for name, sim in sims.items():
+        st = sim.init_state()
+        for t in range(r):
+            st = sim.step(st, sks[t], svs[t], crs[t])
+        stepw[name] = st
+    ref = fused["materialized"]
+    for name in sims:
+        for drv, out in (("fused", fused[name]), ("step", stepw[name])):
+            for a, b, f in zip(ref, out, ref._fields):
+                assert (np.asarray(a) == np.asarray(b)).all(), \
+                    f"{name}/{drv}: {f}"
+
+
+def test_kafka_blocked_union_seed_replay_across_block_sizes():
+    # seed-replay determinism: B=64, B=whole-axis (one slab), and the
+    # materialized path must be bit-identical on the same seed — the
+    # coins are stateless hashes, blocking cannot perturb them — and a
+    # second run of the same (spec, seed) replays bit-exactly
+    spec = F.NemesisSpec(n_nodes=128, seed=23, crash=((2, 5, (3, 77)),),
+                         loss_rate=0.2, loss_until=8)
+    n, k, cap, s, r = 128, 8, 64, 1, 8
+    sks, svs, crs = nemesis.stage_kafka_ops(spec, r, n_keys=k,
+                                            max_sends=s,
+                                            workload_seed=4)
+    outs = {}
+    for ub in (64, 128, "materialized"):
+        sim = KafkaSim(n, k, capacity=cap, max_sends=s,
+                       fault_plan=spec.compile(), union_block=ub)
+        outs[ub] = sim.run_fused(sim.init_state(), sks, svs, crs)
+    replay = KafkaSim(n, k, capacity=cap, max_sends=s,
+                      fault_plan=spec.compile(), union_block=64)
+    outs["replay"] = replay.run_fused(replay.init_state(), sks, svs,
+                                      crs)
+    ref = outs["materialized"]
+    for name in (64, 128, "replay"):
+        for a, b, f in zip(ref, outs[name], ref._fields):
+            assert (np.asarray(a) == np.asarray(b)).all(), (name, f)
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_counter_blocked_fault_gate_matches_materialized(use_mesh):
+    # the counter's faulted allreduce on the same scan_blocks driver:
+    # the per-node liveness + KV-loss gate evaluated slab by slab is
+    # bit-identical to the materialized gate, stepwise and fused
+    spec = F.NemesisSpec(n_nodes=16, seed=9, crash=((2, 6, (1, 8)),),
+                         loss_rate=0.2, loss_until=12)
+    mesh = mesh_1d() if use_mesh else None
+    deltas = np.arange(1, 17, dtype=np.int32)
+    mat = CounterSim(16, mode="allreduce", poll_every=2,
+                     fault_plan=spec.compile(), mesh=mesh,
+                     union_block="materialized")
+    blk = CounterSim(16, mode="allreduce", poll_every=2,
+                     fault_plan=spec.compile(), mesh=mesh,
+                     union_block=2)
+    s1 = mat.run_fused(mat.add(mat.init_state(), deltas), 20)
+    s2 = blk.run_fused(blk.add(blk.init_state(), deltas), 20)
+    t2 = blk.add(blk.init_state(), deltas)
+    for _ in range(20):
+        t2 = blk.step(t2)
+    for a, b, c in zip(s1, s2, t2):
+        assert (np.asarray(a) == np.asarray(b)).all()
+        assert (np.asarray(a) == np.asarray(c)).all()
+
+
+@pytest.mark.parametrize("topo", ["full_mesh", "star"])
+def test_broadcast_blocked_gather_matches_materialized(topo):
+    # the gather path's O(N²) faulted shapes (full mesh: every node
+    # degree N-1; star: the hub's coin row is O(N)) streamed over
+    # destination slabs — received sets, rounds, and the msgs ledger
+    # bit-identical to the materialized round, stepwise and donated
+    # fused, under crash+loss+dup composed with a partition window
+    from gossip_glomers_tpu.parallel.topology import tree
+    n, nv = 24, 20
+    if topo == "full_mesh":
+        nbrs = np.stack([[j for j in range(n) if j != i]
+                         for i in range(n)]).astype(np.int32)
+    else:
+        nbrs = to_padded_neighbors(tree(n, branching=n - 1))
+    spec = F.NemesisSpec(n_nodes=n, seed=3, crash=((2, 6, (1, 5)),),
+                         loss_rate=0.2, loss_until=8,
+                         dup_rate=0.1, dup_until=8)
+    inject = make_inject(n, nv)
+    kw = dict(n_values=nv, sync_every=4, srv_ledger=False,
+              parts=_parts(n), fault_plan=spec.compile())
+    mat = BroadcastSim(nbrs, union_block="materialized", **kw)
+    blk = BroadcastSim(nbrs, union_block=8, **kw)
+    assert blk._ub == 8 and mat._ub is None
+    r1, n1 = mat.run(inject, max_rounds=100)
+    r2, n2 = blk.run(inject, max_rounds=100)
+    assert n1 == n2
+    assert (np.asarray(r1.received) == np.asarray(r2.received)).all()
+    assert int(r1.msgs) == int(r2.msgs)
+    # donated fused while-runner on the blocked program
+    f2, nf = blk.run_fused(inject, max_rounds=100)
+    assert nf == n1
+    assert (np.asarray(f2.received) == np.asarray(r1.received)).all()
+    assert int(f2.msgs) == int(r1.msgs)
+
+
+def test_broadcast_blocked_gather_guards():
+    # loud rejections: blocked rounds are gather-path-only and keep no
+    # srv ledger (the loss-only ledger needs the materialized masks)
+    n = 16
+    nbrs = to_padded_neighbors(grid(n))
+    loss = F.NemesisSpec(n_nodes=n, seed=0, loss_rate=0.2,
+                         loss_until=4)
+    with pytest.raises(ValueError, match="gather-free"):
+        BroadcastSim(nbrs, n_values=8, union_block=4,
+                     exchange=make_exchange("grid", n))
+    with pytest.raises(ValueError, match="srv"):
+        BroadcastSim(nbrs, n_values=8, union_block=4,
+                     fault_plan=loss.compile())
+    # srv_ledger=False makes the same construction fine
+    sim = BroadcastSim(nbrs, n_values=8, union_block=4,
+                       srv_ledger=False, fault_plan=loss.compile())
+    assert sim._ub == 4
+
+
 # -- fault composition on the gather path -------------------------------
 
 
