@@ -11,8 +11,6 @@ contract (survey §7 "hard parts", last bullet).
 from __future__ import annotations
 
 from collections import Counter
-from typing import Any
-
 
 def check_echo(pairs: list[tuple[dict, dict]]) -> tuple[bool, dict]:
     """Every reply must be the request body with type rewritten to

@@ -38,7 +38,7 @@ from collections import Counter
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable
 
-from ..protocol import (KEY_DOES_NOT_EXIST, PRECONDITION_FAILED, Message,
+from ..protocol import (KEY_DOES_NOT_EXIST, PRECONDITION_FAILED,
                         RPCError)
 from .network import is_server_msg
 

@@ -13,8 +13,6 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
-from typing import Any
-
 from ..models import (BroadcastProgram, CounterProgram, EchoProgram,
                       KafkaProgram, UniqueIdsProgram)
 from ..parallel import grid as grid_topology

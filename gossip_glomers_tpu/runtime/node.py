@@ -21,7 +21,7 @@ import json
 import random
 import sys
 import threading
-from typing import Any, Callable
+from typing import Callable
 
 from ..protocol import Message, RPCError, TIMEOUT, decode_line, encode_line
 

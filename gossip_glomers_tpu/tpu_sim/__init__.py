@@ -35,6 +35,11 @@ Modules:
   duplicate delivery, compiled to a ``FaultPlan`` operand every
   stateful sim threads through its fused drivers (see ARCHITECTURE.md
   "Nemesis").
+- :mod:`.audit` — the program-contract auditor (PR 6): static
+  HLO/jaxpr analysis (collective census, donation alias table, host
+  boundary, memory contract) over a declarative per-driver
+  ``ProgramContract`` registry, plus the AST determinism lint (see
+  ARCHITECTURE.md "Static contracts").
 """
 
 from .broadcast import (BroadcastSim, BroadcastState, Partitions,

@@ -1,0 +1,721 @@
+"""Program-contract auditor: static analysis over compiled HLO plus an
+AST determinism lint — the repo's invariants as ONE mechanical check.
+
+The properties that broke (or nearly broke) past PRs are all *static*
+properties of the compiled programs, yet until PR 6 each was enforced by
+a one-off artifact: three regex tests pinned "no all-gather in the
+sharded step", one pinned test audited the analytic memory formula at
+one shape, and nothing at all watched for silently-dropped buffer
+donation (XLA drops ``donate_argnums`` on any layout/dtype mismatch
+without failing), host callbacks sneaking into a round, or a
+nondeterminism source landing in traced code.  This module turns each
+property into a declarative **checker** over the compiled HLO text /
+buffer assignment, and a :class:`ProgramContract` **registry** lets
+every driver the engine builds state its contract once:
+
+- **collective census** — which collective ops (``all-gather``,
+  ``all-reduce``, ``collective-permute``, ``all-to-all``, ...) the
+  compiled program may contain, with per-op count caps.  The PR 4/5
+  no-all-gather gates are the special case "cap 0".
+- **donation contract** — the argnums a driver donates must actually
+  appear in the compiled ``input_output_alias`` table and alias at
+  least the declared state bytes.  This is the checker that makes a
+  silently-dropped donation loud.
+- **host boundary** — no host callbacks (``custom-call`` with a
+  callback target), no infeed/outfeed/send/recv, and no XLA rng ops
+  (traced randomness must come from the repo's stateless counter
+  hashes) anywhere inside a round or fused-run program.
+- **memory contract** — the compiled ``memory_analysis()`` peak must
+  sit within a stated ratio band of the driver's
+  ``engine.analytic_peak_bytes`` claim, auditing the ONE audited
+  formula automatically for every registered driver instead of via a
+  single pinned test.
+
+The registry lives with the drivers: each stateful sim module exports
+``audit_contracts()`` (broadcast gather / words-major halo, counter
+wide, kafka union / faulted-union materialized + blocked / matmul
+oracle, plus the donated fused drivers), and :func:`default_registry`
+collects them.  ``scripts/audit.py`` runs the registry on the CPU
+8-way virtual mesh and emits the ``AUDIT_PR*.json`` artifact; the
+tier-1 tests prove every checker *falsifiable* with deliberately
+broken programs (tests/test_audit.py).
+
+The determinism lint (:func:`lint_paths`) is the static half of a race
+detector for this codebase: seed-replay and resume bit-exactness
+require that traced round code never consults a nondeterminism source.
+It walks the package AST and flags, inside TRACED scope only (see
+``_TRACED_ROOTS``): ``np.random``/``random.``/``time.`` calls and
+argless ``datetime.now()``; iteration over ``set``/``dict`` (order
+leaks into traced constants); and Python ``if``/``while`` on traced
+values (host control flow on device data breaks under ``jit`` and
+forks replay).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Mapping, NamedTuple
+
+from . import engine
+
+# -- HLO text analysis ---------------------------------------------------
+
+# the collective family the census tracks: anything in this tuple that
+# a contract does not explicitly allow is forbidden (cap 0)
+COLLECTIVE_OPS = ("all-gather", "all-reduce", "reduce-scatter",
+                  "collective-permute", "all-to-all",
+                  "collective-broadcast")
+
+# ops that cross the host/device boundary or draw XLA-stateful
+# randomness — never allowed inside a round/fused-run program
+_HOST_OPS = ("infeed", "outfeed", "send", "recv",
+             "rng", "rng-bit-generator", "rng-get-and-update-state")
+_CALLBACK_TARGET = re.compile(r"callback|py_func|python", re.I)
+
+_METADATA = re.compile(r"metadata=\{[^{}]*\}")
+
+
+def _strip_metadata(hlo: str) -> str:
+    """Drop ``metadata={...}`` spans (op_name/source_file strings can
+    contain arbitrary text that would false-positive the op regexes)."""
+    return _METADATA.sub("", hlo)
+
+
+def _count_op(hlo: str, op: str) -> int:
+    """Occurrences of instruction opcode ``op`` in HLO text: the opcode
+    token directly followed by its operand list.  Async pairs count the
+    ``-start`` half only (``-done`` carries no new communication)."""
+    return len(re.findall(rf"(?<![\w-]){re.escape(op)}(?:-start)?\(",
+                          hlo))
+
+
+def collective_census(hlo: str) -> dict[str, int]:
+    """Count the collective ops in one compiled module's text (every
+    computation included — fused/while bodies too).  Returns only the
+    ops present."""
+    hlo = _strip_metadata(hlo)
+    out = {}
+    for op in COLLECTIVE_OPS:
+        n = _count_op(hlo, op)
+        if n:
+            out[op] = n
+    return out
+
+
+class AliasEntry(NamedTuple):
+    """One ``input_output_alias`` row: output tuple index <- (parameter
+    number, parameter tuple index)."""
+
+    output_index: tuple
+    param_number: int
+    param_index: tuple
+    kind: str
+
+
+def _brace_span(text: str, start: int) -> str:
+    """The contents of the brace group opening at ``text[start] == '{'``
+    (nested braces balanced)."""
+    depth, i = 0, start
+    while i < len(text):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start + 1:i]
+        i += 1
+    raise ValueError("unbalanced braces in HLO header")
+
+
+_ALIAS_ROW = re.compile(
+    r"\{([\d,\s]*)\}:\s*\((\d+),\s*\{([\d,\s]*)\}(?:,\s*([\w-]+))?\)")
+
+
+def parse_io_aliases(hlo: str) -> list[AliasEntry]:
+    """The compiled module's ``input_output_alias`` table, parsed from
+    the HloModule header.  EMPTY when XLA dropped every donation — the
+    silent failure mode this parser exists to make loud: jax only warns
+    (once) when a donated buffer cannot alias, and the program silently
+    keeps input + output copies live."""
+    key = "input_output_alias="
+    pos = hlo.find(key)
+    if pos < 0:
+        return []
+    body = _brace_span(hlo, pos + len(key))
+    out = []
+    for m in _ALIAS_ROW.finditer(body):
+        oidx = tuple(int(x) for x in m.group(1).split(",") if x.strip())
+        pidx = tuple(int(x) for x in m.group(3).split(",") if x.strip())
+        out.append(AliasEntry(oidx, int(m.group(2)), pidx,
+                              m.group(4) or "may-alias"))
+    return out
+
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+_SHAPE = re.compile(r"([a-z]+\d*)\[([\d,]*)\]")
+
+
+def _split_top(s: str) -> list[str]:
+    """Split on top-level commas (shape layouts carry nested
+    ``{1,0}``/``[8,4]`` groups)."""
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "[({":
+            depth += 1
+        elif ch in "])}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def _shape_bytes(token: str) -> int:
+    m = _SHAPE.search(token)
+    if not m or m.group(1) not in _DTYPE_BYTES:
+        return 0
+    n = 1
+    for d in m.group(2).split(","):
+        if d.strip():
+            n *= int(d)
+    return n * _DTYPE_BYTES[m.group(1)]
+
+
+def entry_param_bytes(hlo: str) -> list[int]:
+    """Byte size of each entry parameter, parsed from the
+    ``entry_computation_layout`` header (jax flattens pytree args, so
+    every leaf is its own parameter)."""
+    m = re.search(r"entry_computation_layout=\{\((.*?)\)->", hlo,
+                  re.S)
+    if not m:
+        return []
+    body = re.sub(r"/\*.*?\*/", "", m.group(1))
+    return [_shape_bytes(tok) for tok in _split_top(body)]
+
+
+def donated_alias_bytes(hlo: str) -> int:
+    """Total bytes the alias table covers, computed STATICALLY from
+    the HLO header (alias rows × parameter shapes).  This — not
+    ``memory_analysis().alias_size_in_bytes`` — is the donation
+    checker's source of truth: an executable deserialized from the
+    persistent compilation cache keeps its header but reports
+    ``alias_size_in_bytes == 0``, which would fail (and mis-price)
+    every donated contract on a warm cache."""
+    sizes = entry_param_bytes(hlo)
+    seen: set[int] = set()
+    total = 0
+    for e in parse_io_aliases(hlo):
+        if e.param_number in seen:
+            continue
+        seen.add(e.param_number)
+        if e.param_number < len(sizes):
+            total += sizes[e.param_number]
+    return total
+
+
+def host_boundary_violations(hlo: str) -> list[str]:
+    """Everything in the module that crosses the host/device boundary
+    or draws XLA-stateful randomness: infeed/outfeed/send/recv ops,
+    rng ops, and ``custom-call``s whose target is a host callback
+    (``jax.pure_callback`` / ``io_callback`` / debug prints compile to
+    these).  A round program must return an empty list."""
+    stripped = _strip_metadata(hlo)
+    out = []
+    for op in _HOST_OPS:
+        n = _count_op(stripped, op)
+        if n:
+            out.append(f"{op} x{n}")
+    for m in re.finditer(r'custom_call_target="([^"]+)"', hlo):
+        if _CALLBACK_TARGET.search(m.group(1)):
+            out.append(f'custom-call target "{m.group(1)}"')
+    return out
+
+
+# -- program contracts ---------------------------------------------------
+
+
+class AuditProgram(NamedTuple):
+    """What a contract's ``build`` hands the auditor: the jitted
+    program plus example arguments to lower it with, and the two
+    declared expectations the HLO cannot state for itself."""
+
+    jitted: Callable
+    args: tuple
+    # donation contract: the state bytes that must appear in the alias
+    # table (0 = this program donates nothing)
+    donated_bytes: int = 0
+    # memory contract: the driver's engine.analytic_peak_bytes claim
+    # for this exact shape (None = no memory check)
+    analytic_peak_bytes: int | None = None
+
+
+@dataclass(frozen=True)
+class ProgramContract:
+    """One driver's declared static contract (module docstring).
+
+    ``collectives`` maps allowed op -> max count (None = unbounded);
+    any :data:`COLLECTIVE_OPS` member not listed is FORBIDDEN — the
+    no-all-gather gates are simply contracts that omit ``all-gather``.
+    ``mem_lo``/``mem_hi`` bound compiled_peak / analytic_peak when the
+    built program declares an analytic claim: ``mem_hi`` is the loud
+    failure for an analytic-peak *lie* (claimed formula far below what
+    XLA actually holds live), ``mem_lo`` catches the inverse (formula
+    wildly over-claims, i.e. prices buffers the program no longer
+    has)."""
+
+    name: str
+    build: Callable[[object], AuditProgram]   # mesh (or None) -> built
+    collectives: Mapping[str, int | None] = field(default_factory=dict)
+    donation: bool = False
+    host_clean: bool = True
+    mem_lo: float = 0.0
+    mem_hi: float | None = None
+    needs_mesh: bool = True
+    notes: str = ""
+
+
+def _check_census(contract: ProgramContract, hlo: str) -> dict:
+    census = collective_census(hlo)
+    errors = []
+    for op, n in census.items():
+        cap = contract.collectives.get(op, 0)
+        if cap is not None and n > cap:
+            errors.append(
+                f"{op}: {n} in compiled HLO, contract allows "
+                f"{cap}")
+    return {"ok": not errors, "counts": census, "errors": errors,
+            "allowed": {k: v for k, v in contract.collectives.items()}}
+
+
+def _check_donation(contract: ProgramContract, hlo: str,
+                    built: AuditProgram) -> dict:
+    aliases = parse_io_aliases(hlo)
+    alias_bytes = donated_alias_bytes(hlo)
+    res = {"entries": len(aliases), "alias_bytes": alias_bytes,
+           "expected_bytes": built.donated_bytes}
+    if not contract.donation:
+        res["ok"] = True
+        return res
+    errors = []
+    if not aliases:
+        errors.append(
+            "donated program compiled with an EMPTY input_output_alias "
+            "table — XLA dropped the donation (layout/dtype mismatch?)")
+    elif alias_bytes < built.donated_bytes:
+        errors.append(
+            f"alias table covers {alias_bytes} bytes, the donated "
+            f"state is {built.donated_bytes} — some state buffers no "
+            "longer alias in place")
+    res.update(ok=not errors, errors=errors)
+    return res
+
+
+def _check_host(contract: ProgramContract, hlo: str) -> dict:
+    violations = host_boundary_violations(hlo)
+    ok = not (contract.host_clean and violations)
+    return {"ok": ok, "violations": violations}
+
+
+def _check_memory(contract: ProgramContract, built: AuditProgram,
+                  footprint) -> dict:
+    if contract.mem_hi is None or built.analytic_peak_bytes is None:
+        return {"ok": True, "checked": False}
+    if footprint is None:
+        # backend exposes no memory_analysis — record, don't fail
+        return {"ok": True, "checked": False,
+                "note": "no memory_analysis on this backend"}
+    peak = footprint["peak_live_bytes"]
+    ratio = peak / max(1, built.analytic_peak_bytes)
+    ok = contract.mem_lo <= ratio <= contract.mem_hi
+    return {"ok": ok, "checked": True,
+            "analytic_peak_bytes": built.analytic_peak_bytes,
+            "compiled_peak_bytes": peak,
+            "ratio": round(ratio, 4),
+            "band": [contract.mem_lo, contract.mem_hi]}
+
+
+def audit_contract(contract: ProgramContract, mesh=None) -> dict:
+    """Compile one contract's program and run every checker.  Returns
+    the verdict dict (the per-contract row of ``AUDIT_PR*.json``)."""
+    built = contract.build(mesh if contract.needs_mesh else None)
+    compiled = built.jitted.lower(*built.args).compile()
+    hlo = compiled.as_text()
+    footprint = engine._footprint_of(compiled)
+    if footprint is not None:
+        # an executable deserialized from the persistent compilation
+        # cache reports alias_size_in_bytes == 0 while its header
+        # keeps the alias table — re-derive the alias term statically
+        # so the peak (args + outs + temps − aliases) prices donation
+        # identically cold and warm (see donated_alias_bytes)
+        static_alias = donated_alias_bytes(hlo)
+        if static_alias > footprint["alias_bytes"]:
+            footprint["peak_live_bytes"] -= (static_alias
+                                             - footprint["alias_bytes"])
+            footprint["alias_bytes"] = static_alias
+    checks = {
+        "collectives": _check_census(contract, hlo),
+        "donation": _check_donation(contract, hlo, built),
+        "host_boundary": _check_host(contract, hlo),
+        "memory": _check_memory(contract, built, footprint),
+    }
+    return {"name": contract.name, "notes": contract.notes,
+            "ok": all(c["ok"] for c in checks.values()),
+            "checks": checks}
+
+
+def default_registry() -> list[ProgramContract]:
+    """Every registered driver contract, collected from the sims (each
+    stateful sim module owns its own ``audit_contracts()``)."""
+    from . import broadcast, counter, kafka
+    out: list[ProgramContract] = []
+    for mod in (broadcast, counter, kafka):
+        out.extend(mod.audit_contracts())
+    names = [c.name for c in out]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate contract names: {sorted(names)}")
+    return out
+
+
+def run_audit(mesh, contracts=None) -> dict:
+    """Audit the whole registry on ``mesh``.  Never raises on a failed
+    contract — the report carries per-contract verdicts and a global
+    ``ok`` (scripts/audit.py turns that into the exit code)."""
+    contracts = (default_registry() if contracts is None
+                 else list(contracts))
+    rows = [audit_contract(c, mesh) for c in contracts]
+    return {"ok": all(r["ok"] for r in rows),
+            "n_contracts": len(rows),
+            "contracts": rows}
+
+
+# -- determinism lint ----------------------------------------------------
+#
+# TRACED scope = the code that runs inside jit/shard_map at round time,
+# where a nondeterminism source or host branch on device data breaks
+# seed replay.  Three detection mechanisms, all static:
+#
+#   1. per-file name patterns for the known traced roots (the round
+#      functions and the device-side fault evaluators);
+#   2. any function whose decorator list mentions jit / shard_map;
+#   3. any `def` nested inside a traced root OR inside a program
+#      BUILDER (the `_build_*`/`_step_prog`/`make_*` methods whose
+#      nested `def`s become the jitted program bodies — their enclosing
+#      method runs on host, the nested defs do not).
+#
+# Host-side code (drivers, staging, benchmarks, spec builders like
+# faults.random_spec) is deliberately out of scope: np.random there is
+# fine and often the point.
+
+def _faults_roots() -> str:
+    # faults.py DECLARES its own host/device split
+    # (faults.TRACED_EVALUATORS; totality pinned by tests/test_audit.py)
+    from . import faults
+    return ("^(" + "|".join(re.escape(n)
+                            for n in faults.TRACED_EVALUATORS) + ")$")
+
+
+_TRACED_ROOTS: dict[str, str] = {
+    "tpu_sim/broadcast.py":
+        r"^(_round|flood_step$|_wm_round_single$|_sharded_round"
+        r"|_live_rows$|_edge_live$|_popcount$|_flood_loop$"
+        r"|_flood_ledger$)",
+    "tpu_sim/counter.py": r"^(_round$|_reach$)",
+    "tpu_sim/kafka.py": r"^(_round$|_rank_within_key$)",
+    "tpu_sim/faults.py": _faults_roots(),
+    "tpu_sim/engine.py":
+        r"^(sharded_roll$|sharded_shift$|collectives$|fori_rounds$"
+        r"|windows_fold$|scan_blocks$|scan_rounds$|while_converge$)",
+    # structured.py's traced code is entirely nested inside its make_*
+    # builders — covered by the _BUILDERS mechanism below
+}
+
+# builder methods whose nested `def`s are traced program bodies
+_BUILDERS = re.compile(
+    r"^(_build_\w+|_step_prog|_run_prog|run_rounds|build_fixed"
+    r"|poll_batch_program|alloc_offsets)$")
+# structured.py's exchange/diff/nemesis factories — its make_* arm is
+# scoped to THAT file only: host-side make_* factories elsewhere
+# (harness staging, wire helpers) may nest closures that legitimately
+# use rngs/clocks
+_STRUCTURED_BUILDERS = re.compile(r"^make_\w+$")
+
+
+def _is_builder(name: str, relpath: str) -> bool:
+    if _BUILDERS.match(name):
+        return True
+    return bool(relpath.endswith("tpu_sim/structured.py")
+                and _STRUCTURED_BUILDERS.match(name))
+
+_JIT_DECORATOR = re.compile(r"\b(jit|shard_map)\b")
+
+# rng / clock modules that must never be consulted in traced scope
+_BANNED_CALL = re.compile(
+    r"^(np|numpy)\.random\.|^random\.|^time\."
+    r"|^(datetime\.)?datetime\.(now|utcnow|today)$")
+
+
+@dataclass(frozen=True)
+class LintFinding:
+    path: str
+    line: int
+    rule: str        # "rng-or-clock" | "set-dict-order" | "traced-branch"
+    func: str        # the traced function the finding is inside
+    msg: str
+
+    def as_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "func": self.func, "msg": self.msg}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """`a.b.c` attribute chains as a dotted string (None for anything
+    dynamic)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "_fields"}
+_TRACED_CALL_ROOTS = {"jnp", "lax", "jax", "faults"}
+
+
+def _is_static_test(test: ast.AST) -> bool:
+    """`x is None` / `x is not None` tests (and and/or/not compositions
+    of them) are structural — pytree SHAPE branches like "is the ledger
+    leaf present", decided at trace time — not value branches."""
+    if isinstance(test, ast.BoolOp):
+        return all(_is_static_test(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_static_test(test.operand)
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops))
+
+
+class _TracedNames(ast.NodeVisitor):
+    """Names in one traced function that hold device values: assigned
+    from jnp./lax./jax./faults. call chains, or propagated from other
+    traced names.  Two passes reach a fixpoint for the simple
+    straight-line flows rounds are written in."""
+
+    def __init__(self) -> None:
+        self.names: set[str] = set()
+        self._changed = False
+
+    def run(self, fn: ast.AST) -> set[str]:
+        for _ in range(3):
+            self._changed = False
+            self.visit(fn)
+            if not self._changed:
+                break
+        return self.names
+
+    def _expr_traced(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                root = _dotted(sub.func)
+                if root and root.split(".")[0] in _TRACED_CALL_ROOTS:
+                    return True
+            if isinstance(sub, ast.Name) and sub.id in self.names:
+                return True
+        return False
+
+    def _bind(self, target: ast.AST) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name) and sub.id not in self.names:
+                self.names.add(sub.id)
+                self._changed = True
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._expr_traced(node.value):
+            for t in node.targets:
+                self._bind(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self._expr_traced(node.value):
+            self._bind(node.target)
+        self.generic_visit(node)
+
+
+class _TracedScopeLinter(ast.NodeVisitor):
+    """Apply the three rules inside ONE traced function (nested traced
+    `def`s are linted by their own instances — skip them here)."""
+
+    def __init__(self, path: str, fn: ast.FunctionDef,
+                 findings: list[LintFinding]) -> None:
+        self.path = path
+        self.fn = fn
+        self.findings = findings
+        self.traced = _TracedNames().run(fn)
+        # the state pytree param: rounds are written state-first
+        args = fn.args
+        names = [a.arg for a in args.posonlyargs + args.args
+                 if a.arg != "self"]
+        self.state_param = names[0] if names else None
+
+    def _flag(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append(LintFinding(
+            self.path, getattr(node, "lineno", 0), rule,
+            self.fn.name, msg))
+
+    # rule 1: rng / clock calls -------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = _dotted(node.func)
+        if dotted and _BANNED_CALL.search(dotted):
+            self._flag(node, "rng-or-clock",
+                       f"{dotted}() inside traced `{self.fn.name}` — "
+                       "traced code must draw from the stateless "
+                       "counter hashes (faults._edge_hash family), "
+                       "never a host rng/clock")
+        self.generic_visit(node)
+
+    # rule 2: set/dict iteration ------------------------------------
+    def _iter_unordered(self, it: ast.AST) -> str | None:
+        if isinstance(it, (ast.Set, ast.SetComp, ast.DictComp)):
+            return "set/dict literal"
+        if isinstance(it, ast.Dict):
+            return "dict literal"
+        if isinstance(it, ast.Call):
+            dotted = _dotted(it.func)
+            if dotted in ("set", "frozenset", "dict"):
+                return f"{dotted}()"
+            if dotted and dotted.split(".")[-1] in ("keys", "values",
+                                                    "items"):
+                return f".{dotted.split('.')[-1]}()"
+        return None
+
+    def _check_iter(self, node: ast.AST, it: ast.AST) -> None:
+        what = self._iter_unordered(it)
+        if what:
+            self._flag(node, "set-dict-order",
+                       f"iteration over {what} inside traced "
+                       f"`{self.fn.name}`: insertion/hash order leaks "
+                       "into traced constants — wrap in sorted()")
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_iter(node, node.iter)
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node: ast.comprehension) -> None:
+        self._check_iter(node, node.iter)
+        self.generic_visit(node)
+
+    # rule 3: Python branch on traced values ------------------------
+    def _test_on_traced(self, test: ast.AST) -> str | None:
+        if _is_static_test(test):
+            return None
+
+        def scan(node: ast.AST) -> str | None:
+            # `x.shape[0] > 4`-style tests are static: prune the whole
+            # subtree under a static attribute access
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in _STATIC_ATTRS):
+                return None
+            if (isinstance(node, ast.Attribute)
+                    and node.attr not in _STATIC_ATTRS
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == self.state_param):
+                return f"{self.state_param}.{node.attr}"
+            if isinstance(node, ast.Name) and node.id in self.traced:
+                return node.id
+            for child in ast.iter_child_nodes(node):
+                hit = scan(child)
+                if hit:
+                    return hit
+            return None
+
+        return scan(test)
+
+    def _check_branch(self, node: ast.AST, kind: str) -> None:
+        hit = self._test_on_traced(node.test)
+        if hit:
+            self._flag(node, "traced-branch",
+                       f"Python {kind} on traced value `{hit}` inside "
+                       f"`{self.fn.name}`: host control flow on device "
+                       "data — use jnp.where / lax.cond")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_branch(node, "conditional expression")
+        self.generic_visit(node)
+
+    # nested defs get their own linter instance — do not descend
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is not self.fn:
+            return
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _root_pattern_for(relpath: str) -> re.Pattern | None:
+    for suffix, pat in _TRACED_ROOTS.items():
+        if relpath.endswith(suffix):
+            return re.compile(pat)
+    return None
+
+
+def _has_jit_decorator(fn: ast.FunctionDef) -> bool:
+    return any(_JIT_DECORATOR.search(ast.unparse(d))
+               for d in fn.decorator_list)
+
+
+def lint_source(src: str, relpath: str) -> list[LintFinding]:
+    """Run the determinism lint over one module's source.  ``relpath``
+    picks the traced-root name patterns (module docstring)."""
+    tree = ast.parse(src, filename=relpath)
+    pat = _root_pattern_for(relpath)
+    findings: list[LintFinding] = []
+
+    def walk(node: ast.AST, in_traced: bool, in_builder: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                traced = (in_traced or in_builder
+                          or bool(pat and pat.match(child.name))
+                          or _has_jit_decorator(child))
+                if traced:
+                    _TracedScopeLinter(relpath, child,
+                                       findings).visit(child)
+                walk(child, traced, _is_builder(child.name, relpath))
+            else:
+                walk(child, in_traced, in_builder)
+
+    walk(tree, False, False)
+    return findings
+
+
+def lint_paths(root: "str | Path") -> list[LintFinding]:
+    """Determinism lint over every ``.py`` under ``root`` (the
+    ``gossip_glomers_tpu/`` package in CI)."""
+    root = Path(root)
+    findings: list[LintFinding] = []
+    for path in sorted(root.rglob("*.py")):
+        # POSIX-normalized so the _TRACED_ROOTS suffix match holds on
+        # every host os
+        rel = path.relative_to(root.parent).as_posix()
+        findings.extend(lint_source(path.read_text(), rel))
+    return findings

@@ -1629,6 +1629,11 @@ class BroadcastSim:
         return ((faults.plan_specs(),), (self.fault_plan,))
 
     def _build_step(self):
+        """Build the one-round driver.  Each branch also stashes the
+        raw jitted program + its full-operand builder in
+        ``self._audit_step`` — the contract auditor
+        (:meth:`audit_step_program`) lowers the EXACT object
+        :meth:`step` executes, never a re-built twin that could drift."""
         parts, sync_every = self.parts, self.sync_every
 
         if self.mesh is None:
@@ -1639,6 +1644,8 @@ class BroadcastSim:
                     return self._wm_round_single(state, deg,
                                                  masks or None)
                 extra = self._wm_extra_args()
+                self._audit_step = (
+                    step_wm, lambda state: (state, self.deg) + extra)
                 return lambda state, nbrs, nbr_mask: step_wm(
                     state, self.deg, *extra)
 
@@ -1654,6 +1661,9 @@ class BroadcastSim:
                                   plan=fp[0] if fp else None,
                                   dup_on=self._fp_dup,
                                   union_block=self._ub)
+            self._audit_step = (
+                step, lambda state: (state, self.nbrs,
+                                     self.nbr_mask) + fp_args)
             return lambda state, nbrs, nbr_mask: step(
                 state, nbrs, nbr_mask, *fp_args)
 
@@ -1673,6 +1683,9 @@ class BroadcastSim:
                         *masks) -> BroadcastState:
                 return self._sharded_round_wm(state, deg, masks or None)
 
+            self._audit_step = (
+                step_wm,
+                lambda state: (state, self.deg) + extra_args)
             return lambda state, nbrs, nbr_mask: step_wm(
                 state, self.deg, *extra_args)
 
@@ -1692,6 +1705,10 @@ class BroadcastSim:
                                            delays,
                                            fp[0] if fp else None)
 
+            self._audit_step = (
+                step_d,
+                lambda state: (state, self.nbrs, self.nbr_mask,
+                               self.parts, self.delays) + fp_args)
             return lambda state, nbrs, nbr_mask: step_d(
                 state, nbrs, nbr_mask, self.parts, self.delays,
                 *fp_args)
@@ -1708,11 +1725,23 @@ class BroadcastSim:
             return self._sharded_round(state, nbrs, nbr_mask, parts,
                                        None, fp[0] if fp else None)
 
+        self._audit_step = (
+            step, lambda state: (state, self.nbrs, self.nbr_mask,
+                                 self.parts) + fp_args)
         return lambda state, nbrs, nbr_mask: step(state, nbrs, nbr_mask,
                                                   self.parts, *fp_args)
 
     def step(self, state: BroadcastState) -> BroadcastState:
         return self._step(state, self.nbrs, self.nbr_mask)
+
+    def audit_step_program(self):
+        """(jitted, args_fn) of this sim's one-round step program — the
+        EXACT jitted object :meth:`step` executes (stashed by
+        :meth:`_build_step`, never a re-built twin that could drift)
+        plus an ``args_fn(state) -> operand tuple``, for the contract
+        auditor (tpu_sim/audit.py): the driver lambdas hide the jitted
+        handle, and HLO/alias analysis is per-program."""
+        return self._audit_step
 
     def _build_fused(self, max_rounds: int, donate: bool):
         """Whole-convergence runner as ONE device program: the engine's
@@ -2197,3 +2226,136 @@ class BroadcastSim:
                     word ^= b
             out.append(vals)
         return out
+
+
+# -- program contracts (tpu_sim/audit.py registry) -----------------------
+
+
+def audit_contracts():
+    """The broadcast drivers' :class:`~.audit.ProgramContract` rows:
+    the gather path's bounded widen census (fault-free AND under a
+    crash/loss plan), the words-major round's zero-collective and
+    halo-sharded ppermute-only contracts, and the donated pure-flood
+    loop's donation + memory contract."""
+    from ..parallel.topology import to_padded_neighbors, tree
+    from .audit import AuditProgram, ProgramContract
+    from .engine import analytic_peak_bytes
+    from .structured import make_exchange, make_sharded_exchange
+
+    n, nv = 64, 64
+
+    def _nbrs():
+        return to_padded_neighbors(tree(n, branching=4))
+
+    def _built(sim):
+        prog, args_fn = sim.audit_step_program()
+        state, _ = sim.stage(make_inject(n, nv))
+        return prog, args_fn(state)
+
+    def gather_step(mesh):
+        sim = BroadcastSim(_nbrs(), n_values=nv, srv_ledger=False,
+                           mesh=mesh)
+        return AuditProgram(*_built(sim))
+
+    def gather_step_nem(mesh):
+        spec = faults.NemesisSpec(n_nodes=n, seed=7,
+                                  crash=((1, 3, (0, 5)),),
+                                  loss_rate=0.1, loss_until=4,
+                                  dup_rate=0.1, dup_until=4)
+        sim = BroadcastSim(_nbrs(), n_values=nv, srv_ledger=False,
+                           mesh=mesh, fault_plan=spec.compile())
+        return AuditProgram(*_built(sim))
+
+    def wm_sim(mesh):
+        sharded = (make_sharded_exchange("tree", n, 8, branching=4)
+                   if mesh is not None else None)
+        return BroadcastSim(
+            _nbrs(), n_values=nv, sync_every=1 << 20,
+            srv_ledger=False, mesh=mesh,
+            exchange=make_exchange("tree", n, branching=4),
+            sharded_exchange=sharded)
+
+    def wm_step(mesh):
+        return AuditProgram(*_built(wm_sim(mesh)))
+
+    def wm_nem_step(mesh):
+        from .structured import make_nemesis
+        spec = faults.NemesisSpec(n_nodes=n, seed=9,
+                                  crash=((1, 3, (0, 5)),),
+                                  loss_rate=0.15, loss_until=5,
+                                  dup_rate=0.1, dup_until=5)
+        nem = make_nemesis("tree", n, spec, n_shards=8, branching=4)
+        sim = BroadcastSim(
+            _nbrs(), n_values=nv, sync_every=4, srv_ledger=False,
+            mesh=mesh, exchange=make_exchange("tree", n, branching=4),
+            fault_plan=spec.compile(), nemesis=nem)
+        return AuditProgram(*_built(sim))
+
+    def flood_donated(mesh):
+        del mesh
+        n2, nv2 = 1024, 4096                 # W = 128: state-dominated
+        nbrs = to_padded_neighbors(tree(n2, branching=4))
+        sim = BroadcastSim(nbrs, n_values=nv2, sync_every=1 << 20,
+                           srv_ledger=False,
+                           exchange=make_exchange("tree", n2,
+                                                  branching=4))
+        loop_fn, _finish = sim.build_fixed(4, donate=True)
+        state, _ = sim.stage(make_inject(n2, nv2))
+        state_bytes = 2 * n2 * (nv2 // 32) * 4   # received + frontier
+        analytic = analytic_peak_bytes(state_bytes=state_bytes,
+                                       donated=True)
+        return AuditProgram(loop_fn, (state.received, state.frontier),
+                            donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    return [
+        ProgramContract(
+            name="broadcast/sharded-step-gather",
+            build=gather_step,
+            collectives={"all-gather": 1, "all-reduce": None},
+            notes="gather path: ONE payload widen per round (received "
+                  "never moves), ledger scalars psum"),
+        ProgramContract(
+            name="broadcast/sharded-step-gather-nem",
+            build=gather_step_nem,
+            collectives={"all-gather": 2, "all-reduce": None},
+            notes="gather path under crash+loss+dup: the payload "
+                  "widen plus the dup stream's source-set widen — the "
+                  "plan must add no further gathers"),
+        ProgramContract(
+            name="broadcast/step-words-major",
+            build=lambda mesh: wm_step(None),
+            collectives={},
+            needs_mesh=False,
+            notes="single-device words-major round: ZERO collective "
+                  "ops of any kind"),
+        ProgramContract(
+            name="broadcast/sharded-step-halo-wm",
+            build=wm_step,
+            collectives={"all-reduce": None,
+                         "collective-permute": None},
+            notes="halo-sharded words-major round: O(block) ppermute "
+                  "halo exchanges only — NO all-gather (the "
+                  "structured-path scale contract)"),
+        ProgramContract(
+            name="broadcast/sharded-step-halo-wm-nem",
+            build=wm_nem_step,
+            collectives={"all-reduce": None,
+                         "collective-permute": None},
+            notes="halo-sharded words-major round under the FULL "
+                  "nemesis (crash+loss+dup, structured.make_nemesis): "
+                  "the node-sharded mask decomposition adds ZERO "
+                  "gathers — the PR 3 structured-path contract"),
+        ProgramContract(
+            name="broadcast/fused-donated-flood",
+            build=flood_donated,
+            collectives={},
+            donation=True,
+            mem_lo=0.2, mem_hi=3.0,
+            needs_mesh=False,
+            notes="donated pure-flood fixed loop at W=128: the "
+                  "(received, frontier) carry aliases in place; "
+                  "compiled peak within band of 1x state + exchange "
+                  "temps"),
+    ]

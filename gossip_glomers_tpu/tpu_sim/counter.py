@@ -174,6 +174,9 @@ class CounterSim:
         self._ub = resolve_block(max(1, n_nodes // n_sh), union_block,
                                  per_row_bytes=8)
         self._node_spec = P("nodes") if mesh is not None else None
+        # raw jitted run-program handles by donate flag — the contract
+        # auditor (tpu_sim/audit.py) lowers these directly
+        self._run_progs: dict = {}
         self._step = self._build_step()
         self._run_n = self._build_run_n(donate=False)
         # the donated twin: same traced rounds, state buffers consumed
@@ -403,6 +406,8 @@ class CounterSim:
                     lambda s: self._round(s, coll, self.kv_sched),
                     state, n)
             prog0 = jit_program(run_n, donate_argnums=dn)
+            self._run_progs[donate] = (
+                prog0, lambda state, n: (state, n) + fp_args)
             return lambda state, n: prog0(state, n, *fp_args)
 
         sched_spec = KVReach(P(), P(), P(None, None))
@@ -421,6 +426,9 @@ class CounterSim:
             run_n, mesh=mesh,
             in_specs=(self._state_spec(), sched_spec, P()) + fp_specs,
             out_specs=self._state_spec(), donate_argnums=dn)
+        self._run_progs[donate] = (
+            prog,
+            lambda state, n: (state, self.kv_sched, n) + fp_args)
         return lambda state, n: prog(state, self.kv_sched, n, *fp_args)
 
     def step(self, state: CounterState) -> CounterState:
@@ -447,3 +455,69 @@ class CounterSim:
 
     def kv_value(self, state: CounterState) -> int:
         return int(state.kv)
+
+    def audit_run_program(self, *, donate: bool = True,
+                          rounds: int = 8):
+        """(jitted, example_args) of the fused multi-round driver —
+        the handle the contract auditor lowers to check the donation
+        alias table of the EXACT program :meth:`run_fused` runs."""
+        prog, args_fn = self._run_progs[donate]
+        return prog, args_fn(self.init_state(), jnp.int32(rounds))
+
+
+# -- program contracts (tpu_sim/audit.py registry) -----------------------
+
+
+def audit_contracts():
+    """The counter drivers' :class:`~.audit.ProgramContract` rows: the
+    wide two-pmin winner's sharded step (collective-based end to end,
+    no all-gather — the PR 4 gate) and the donated fused driver's
+    donation + memory contract."""
+    from .audit import AuditProgram, ProgramContract
+    from .engine import analytic_peak_bytes
+
+    def wide_step(mesh):
+        sim = CounterSim(32, mode="cas", poll_every=2,
+                         winner_key="wide", mesh=mesh)
+        sched_spec = KVReach(P(), P(), P(None, None))
+
+        def step(state, sched):
+            coll = collectives(state.pending.shape[0], mesh)
+            return sim._round(state, coll, sched)
+
+        prog = jit_program(step, mesh=mesh,
+                           in_specs=(sim._state_spec(), sched_spec),
+                           out_specs=sim._state_spec())
+        return AuditProgram(prog, (sim.init_state(), sim.kv_sched))
+
+    def fused_donated(mesh):
+        del mesh
+        n = 4096
+        sim = CounterSim(n, mode="cas", poll_every=2)
+        prog, args = sim.audit_run_program(donate=True)
+        state_bytes = 2 * n * 4           # pending + cached (+ scalars)
+        analytic = analytic_peak_bytes(state_bytes=state_bytes,
+                                       donated=True)
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    return [
+        ProgramContract(
+            name="counter/sharded-step-wide",
+            build=wide_step,
+            collectives={"all-reduce": None},
+            notes="wide two-pmin winner: psum/pmin collectives only — "
+                  "NO all-gather, no ppermute needed (the PR 4 "
+                  "counter gate)"),
+        ProgramContract(
+            name="counter/fused-donated",
+            build=fused_donated,
+            collectives={},
+            donation=True,
+            mem_lo=0.2, mem_hi=4.0,
+            needs_mesh=False,
+            notes="donated fori driver: the (pending, cached) node "
+                  "rows alias in place; compiled peak within band of "
+                  "1x state + hash/select temps"),
+    ]

@@ -340,6 +340,18 @@ def _divisors(n: int) -> list:
     return sorted(out)
 
 
+def _env_int(name: str, raw: str) -> int:
+    """Parse an integer env-var value with a loud error NAMING the
+    variable — ``int()``'s bare "invalid literal" at some later sim
+    construction is undebuggable from a sweep log."""
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer (nor a recognized "
+            "keyword)") from None
+
+
 def resolve_block(rows: int, setting=None, *, per_row_bytes: int = 1,
                   budget_bytes: int | None = None) -> int | None:
     """Static destination-slab size for :func:`scan_blocks`, or None
@@ -358,15 +370,34 @@ def resolve_block(rows: int, setting=None, *, per_row_bytes: int = 1,
       ``GG_UNION_BLOCK_BUDGET_MB``, 512 MB — small shapes keep the
       measured-and-pinned unblocked programs), else the largest
       divisor of ``rows`` whose slab stays inside the budget.
+
+    Env parsing is LOUD (ISSUE 6 satellite): a ``GG_UNION_BLOCK``
+    value that is neither ``auto``/``materialized`` nor an integer, or
+    an integer that does not divide this sim's ``rows`` destination
+    axis, raises a ``ValueError`` naming the variable — a global env
+    knob silently divisor-clamped per sim would make two sims stream
+    DIFFERENT slab sizes than asked.  (Values above ``rows`` still
+    clamp to the whole axis: a single whole-axis slab is the
+    materialized evaluation order, bit-identical.)  Programmatic
+    ``setting`` ints keep the documented divisor clamp — the caller
+    named a specific sim.  ``GG_UNION_BLOCK_BUDGET_MB`` must be a
+    non-negative integer, same loud contract.
     """
+    env_src = None
     if setting is None:
-        setting = os.environ.get("GG_UNION_BLOCK", "auto")
+        env_src = "GG_UNION_BLOCK"
+        setting = os.environ.get(env_src, "auto")
     if setting == "materialized":
         return None
     if setting == "auto":
         if budget_bytes is None:
-            budget_bytes = int(os.environ.get(
-                "GG_UNION_BLOCK_BUDGET_MB", "512")) * 1_000_000
+            name = "GG_UNION_BLOCK_BUDGET_MB"
+            mb = _env_int(name, os.environ.get(name, "512"))
+            if mb < 0:
+                raise ValueError(
+                    f"{name}={mb} must be a non-negative slab budget "
+                    "in MB")
+            budget_bytes = mb * 1_000_000
         if rows * per_row_bytes <= budget_bytes:
             return None
         # a single row's mask can itself exceed the budget at extreme
@@ -374,7 +405,22 @@ def resolve_block(rows: int, setting=None, *, per_row_bytes: int = 1,
         # construction the streaming path exists to serve
         return max((d for d in _divisors(rows)
                     if d * per_row_bytes <= budget_bytes), default=1)
-    b = int(setting)
+    if env_src is not None:
+        b = _env_int(env_src, setting)
+        if 0 < b < rows and rows % b != 0:
+            near = [d for d in _divisors(rows) if d <= b]
+            raise ValueError(
+                f"{env_src}={b} does not divide the {rows}-row "
+                f"destination axis (scan_blocks needs even slabs); "
+                f"use a divisor (e.g. {near[-1] if near else 1}), "
+                f"'auto', or 'materialized'")
+    else:
+        try:
+            b = int(setting)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"union_block setting {setting!r} is not 'auto', "
+                "'materialized', or an integer") from None
     if b <= 0:
         return None
     if b >= rows:

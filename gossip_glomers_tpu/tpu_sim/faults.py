@@ -40,10 +40,29 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .engine import windows_fold
+
+# The module's host/device split, DECLARED (PR 6): the determinism
+# lint (tpu_sim/audit.py) treats exactly TRACED_EVALUATORS as traced
+# scope — device-side mask/coin evaluation where an rng/clock call or
+# a host branch on traced data would fork seed replay.  Everything in
+# HOST_SIDE runs before tracing (spec construction, compilation, op
+# staging, the numpy mirrors) and may use numpy rngs freely —
+# random_spec seeding a campaign is the point, not a bug.
+# tests/test_audit.py pins the split TOTAL: a new module-level
+# function must be added to one of these tuples (or be a class) or
+# the test fails, so the lint can never silently skip new traced
+# code here.
+TRACED_EVALUATORS = (
+    "node_up", "amnesia", "_mix32", "_edge_hash", "edge_drop",
+    "edge_dup", "coin_block", "kv_drop", "wm_up_cols", "wm_live_rows",
+    "wm_live_del", "wm_srv_rows")
+HOST_SIDE = (
+    "plan_specs", "wm_specs", "_rate_to_num", "random_spec",
+    "crash_down_rows", "_mix32_np", "host_node_up", "host_edge_drop",
+    "host_kv_ok")
 
 # distinct stream salts: loss and dup draw independent coins from the
 # same (seed, t, src, dst) counter

@@ -815,40 +815,19 @@ class KafkaSim:
             self._step_progs[repl_mode] = prog
         return self._step_progs[repl_mode]
 
-    def run_rounds(self, state: KafkaState, send_key: np.ndarray,
-                   send_val: np.ndarray,
-                   commit_req: np.ndarray | None = None,
-                   repl_ok: np.ndarray | None = None, *,
-                   donate: bool = False) -> KafkaState:
-        """R pre-staged rounds as ONE device program (``lax.scan``):
-        send_key/send_val are (R, N, S), commit_req (R, N, K).  One
-        dispatch instead of R — per-round dispatch latency dominates the
-        stepwise driver on small rounds.  On a mesh the scan body is the
-        same sharded round as step() (scan under shard_map), so
-        benchmark config 5 runs multi-device with identical results.
-
-        ``donate``: consume the input state's buffers (the
-        :meth:`run_fused` driver) — the scan then updates the ~O(N*K)
-        presence/HWM state in place instead of holding input + output
-        copies live."""
-        # commit-free runs (the benchmark's send-heavy regime) build
-        # the all--1 commit_req INSIDE the traced program: an (R, N, K)
-        # host array would be ~330 MB at the sweep's 1k-node shape,
-        # re-transferred over the tunnel on every chained timing call
-        # (measured: it dominated the round time ~100x); as a traced
-        # broadcast constant, `want = req >= 1` folds to False and XLA
-        # dead-codes the whole commit pipeline.
-        has_commits = commit_req is not None
-        repl_mode = self._repl_mode(repl_ok)
-        matmul = repl_mode == "matmul"
-        if matmul and repl_ok is None:
-            repl_ok = np.ones((self.n_nodes, self.n_nodes), bool)
+    def _run_prog(self, has_commits: bool, repl_mode: str,
+                  donate: bool):
+        """Build (and cache) the R-round ``lax.scan`` driver program —
+        extracted from :meth:`run_rounds` so the contract auditor
+        (tpu_sim/audit.py) can lower the EXACT jitted object the
+        drivers execute (donation/alias tables are per-program)."""
         key = (has_commits, repl_mode, donate)
         if key not in self._run_rounds:
             k_dim = self.n_keys
             mesh = self.mesh
             dn = donate_argnums_for(donate, 0)
             fp = self._fp_active
+            matmul = repl_mode == "matmul"
 
             def run(state, sks, svs, *rest):
                 rest = list(rest)
@@ -883,6 +862,36 @@ class KafkaSim:
                                    out_specs=state_spec,
                                    check_vma=False, donate_argnums=dn)
             self._run_rounds[key] = prog
+        return self._run_rounds[key]
+
+    def run_rounds(self, state: KafkaState, send_key: np.ndarray,
+                   send_val: np.ndarray,
+                   commit_req: np.ndarray | None = None,
+                   repl_ok: np.ndarray | None = None, *,
+                   donate: bool = False) -> KafkaState:
+        """R pre-staged rounds as ONE device program (``lax.scan``):
+        send_key/send_val are (R, N, S), commit_req (R, N, K).  One
+        dispatch instead of R — per-round dispatch latency dominates the
+        stepwise driver on small rounds.  On a mesh the scan body is the
+        same sharded round as step() (scan under shard_map), so
+        benchmark config 5 runs multi-device with identical results.
+
+        ``donate``: consume the input state's buffers (the
+        :meth:`run_fused` driver) — the scan then updates the ~O(N*K)
+        presence/HWM state in place instead of holding input + output
+        copies live."""
+        # commit-free runs (the benchmark's send-heavy regime) build
+        # the all--1 commit_req INSIDE the traced program: an (R, N, K)
+        # host array would be ~330 MB at the sweep's 1k-node shape,
+        # re-transferred over the tunnel on every chained timing call
+        # (measured: it dominated the round time ~100x); as a traced
+        # broadcast constant, `want = req >= 1` folds to False and XLA
+        # dead-codes the whole commit pipeline.
+        has_commits = commit_req is not None
+        repl_mode = self._repl_mode(repl_ok)
+        matmul = repl_mode == "matmul"
+        if matmul and repl_ok is None:
+            repl_ok = np.ones((self.n_nodes, self.n_nodes), bool)
         args = [jnp.asarray(send_key, jnp.int32),
                 jnp.asarray(send_val, jnp.int32)]
         if has_commits:
@@ -895,7 +904,8 @@ class KafkaSim:
         args.append(self.kv_sched)
         if self._fp_active:
             args.append(self.fault_plan)
-        return self._run_rounds[key](state, *args)
+        prog = self._run_prog(has_commits, repl_mode, donate)
+        return prog(state, *args)
 
     def run_fused(self, state: KafkaState, send_key: np.ndarray,
                   send_val: np.ndarray,
@@ -1050,3 +1060,123 @@ class KafkaSim:
         paths share the key (see module docstring)."""
         c = np.asarray(state.kv_val)
         return {k: int(c[k]) for k in range(self.n_keys) if c[k] > 0}
+
+
+# -- program contracts (tpu_sim/audit.py registry) -----------------------
+
+
+def _audit_spec(n):
+    from . import faults as F
+    return F.NemesisSpec(n_nodes=n, seed=5, crash=((2, 4, (1,)),),
+                         loss_rate=0.2, loss_until=6)
+
+
+def _step_args(sim):
+    """The one-round program's example operands (mirrors
+    :meth:`KafkaSim.step`'s arg assembly, matmul mask excluded)."""
+    n, s, k = sim.n_nodes, sim.max_sends, sim.n_keys
+    args = [jnp.full((n, s), -1, jnp.int32),
+            jnp.zeros((n, s), jnp.int32),
+            jnp.full((n, k), -1, jnp.int32)]
+    if sim.mesh is not None:
+        sh = NamedSharding(sim.mesh, P("nodes", None))
+        args = [jax.device_put(a, sh) for a in args]
+    return args
+
+
+def audit_contracts():
+    """The kafka drivers' :class:`~.audit.ProgramContract` rows —
+    sharded-presence census gates for all four replication paths (the
+    PR 4/5 no-all-gather contracts and the bounded widens of the
+    materialized/matmul oracles) plus the donated blocked-union fused
+    driver's donation + memory contract (the BENCH_PR5 analytic
+    formula, audited against XLA's buffer assignment)."""
+    from .audit import AuditProgram, ProgramContract
+
+    def union_step(mesh):
+        sim = KafkaSim(8, 4, capacity=64, max_sends=2, mesh=mesh)
+        prog = sim._step_prog("union")
+        return AuditProgram(prog, tuple([sim.init_state()]
+                                        + _step_args(sim)
+                                        + [sim.kv_sched]))
+
+    def nem_step(mesh, union_block):
+        n = 16
+        sim = KafkaSim(n, 4, capacity=64, max_sends=2, mesh=mesh,
+                       fault_plan=_audit_spec(n).compile(),
+                       union_block=union_block)
+        prog = sim._step_prog("union_nem")
+        return AuditProgram(prog, tuple([sim.init_state()]
+                                        + _step_args(sim)
+                                        + [sim.kv_sched,
+                                           sim.fault_plan]))
+
+    def matmul_step(mesh):
+        n = 8
+        sim = KafkaSim(n, 4, capacity=64, max_sends=2, mesh=mesh,
+                       repl_fast=False)
+        prog = sim._step_prog("matmul")
+        repl = jnp.asarray(np.ones((n, n), bool))
+        return AuditProgram(prog, tuple([sim.init_state()]
+                                        + _step_args(sim)
+                                        + [repl, sim.kv_sched]))
+
+    def fused_donated(mesh):
+        del mesh                       # single-device memory contract
+        n, k, cap, s, b, r = 256, 16, 32, 8, 32, 2
+        sim = KafkaSim(n, k, capacity=cap, max_sends=s,
+                       fault_plan=_audit_spec(n).compile(),
+                       union_block=b)
+        prog = sim._run_prog(False, "union_nem", True)
+        sks = jnp.full((r, n, s), -1, jnp.int32)
+        svs = jnp.zeros((r, n, s), jnp.int32)
+        fp = sim.union_footprint(donated=True)
+        staged = int(operand_bytes((sks, svs)))
+        return AuditProgram(
+            prog, (sim.init_state(), sks, svs, sim.kv_sched,
+                   sim.fault_plan),
+            donated_bytes=fp["state_bytes"],
+            analytic_peak_bytes=fp["peak_live_bytes"] + staged)
+
+    return [
+        ProgramContract(
+            name="kafka/sharded-step-union",
+            build=union_step,
+            collectives={"all-reduce": None, "collective-permute": None},
+            notes="fault-free sharded round: blocked psum-of-OR + "
+                  "ppermute prefix scan — NO all-gather (the PR 4 "
+                  "gate)"),
+        ProgramContract(
+            name="kafka/sharded-step-union-nem-blocked",
+            build=lambda mesh: nem_step(mesh, 1),
+            collectives={"all-reduce": None, "collective-permute": None},
+            notes="blocked streaming faulted union: per-send metadata "
+                  "rides a ring ppermute — NO all-gather (the PR 5 "
+                  "gate)"),
+        ProgramContract(
+            name="kafka/sharded-step-union-nem-materialized",
+            build=lambda mesh: nem_step(mesh, "materialized"),
+            collectives={"all-reduce": None, "collective-permute": None,
+                         "all-gather": 3},
+            notes="materialized faulted union (blocking oracle): "
+                  "exactly the 3 per-send metadata widens (bit, key, "
+                  "word), presence never moves"),
+        ProgramContract(
+            name="kafka/sharded-step-matmul-oracle",
+            build=matmul_step,
+            collectives={"all-reduce": None, "collective-permute": None,
+                         "all-gather": 1},
+            notes="link-mask matmul oracle: the one own_words widen "
+                  "is the oracle's documented full operand"),
+        ProgramContract(
+            name="kafka/fused-donated-union-nem-blocked",
+            build=fused_donated,
+            collectives={},
+            donation=True,
+            mem_lo=0.2, mem_hi=3.0,
+            needs_mesh=False,
+            notes="donated blocked-union scan driver at the "
+                  "union_footprint test shape: state aliases in "
+                  "place, compiled peak within band of the BENCH_PR5 "
+                  "analytic formula + staged send operands"),
+    ]

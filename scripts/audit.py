@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Run the program-contract auditor (tpu_sim/audit.py) — the CI audit
+leg and the ``AUDIT_PR*.json`` artifact writer.
+
+Audits every registered driver contract on the CPU 8-way virtual mesh
+(the same SPMD partitioner and collectives as real chips — what the
+tier-1 suite runs on) and runs the determinism lint over the package.
+Exit status is nonzero on ANY failed contract or lint finding, so a
+refactor that re-grows an all-gather, silently drops a donation,
+sneaks a host callback into a round, breaks the analytic memory
+formula, or lands a nondeterminism source in traced code fails the
+push — not the next hand-run benchmark.
+
+Usage: ``python scripts/audit.py [--out AUDIT.json]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gossip_glomers_tpu.parallel.mesh import force_virtual_devices  # noqa: E402
+
+force_virtual_devices(8)
+
+import jax                                                  # noqa: E402
+import numpy as np                                          # noqa: E402
+from jax.sharding import Mesh                               # noqa: E402
+
+from gossip_glomers_tpu.tpu_sim import audit                # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="write the report JSON here (e.g. "
+                         "AUDIT_PR6.json)")
+    args = ap.parse_args()
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("nodes",))
+    report = audit.run_audit(mesh)
+    findings = audit.lint_paths(REPO / "gossip_glomers_tpu")
+    report["determinism_lint"] = {
+        "ok": not findings,
+        "n_findings": len(findings),
+        "findings": [f.as_dict() for f in findings],
+    }
+    report["ok"] = report["ok"] and not findings
+    report["mesh"] = {"backend": jax.default_backend(),
+                      "n_devices": 8, "axis": "nodes"}
+
+    for row in report["contracts"]:
+        cs = row["checks"]
+        cen = cs["collectives"]["counts"]
+        mem = cs["memory"]
+        extra = (f" mem-ratio {mem['ratio']}"
+                 if mem.get("checked") else "")
+        print(f"[{'ok' if row['ok'] else 'FAIL'}] {row['name']}: "
+              f"collectives {cen or '{}'}"
+              f" aliases {cs['donation']['entries']}{extra}")
+        if not row["ok"]:
+            print(json.dumps(cs, indent=2))
+    lint = report["determinism_lint"]
+    print(f"[{'ok' if lint['ok'] else 'FAIL'}] determinism lint: "
+          f"{lint['n_findings']} findings")
+    for f in findings:
+        print(f"  {f.path}:{f.line} [{f.rule}] {f.msg}")
+
+    if args.out:
+        out = pathlib.Path(args.out)
+        out.write_text(json.dumps(report, indent=1) + "\n")
+        print(f"wrote {out}")
+    print("audit", "OK" if report["ok"] else "FAILED",
+          f"({report['n_contracts']} contracts)")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

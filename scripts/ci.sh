@@ -32,4 +32,16 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 # of a bare SIGTERM.
 timeout -k 10 900 env JAX_PLATFORMS=cpu \
     python scripts/kafka_smoke.py || rc=1
+# Program-contract audit (PR 6): every registered driver contract
+# (collective census, donation alias table, host boundary, memory
+# band) on the CPU 8-way virtual mesh, plus the AST determinism lint
+# over the package — the static gates behind the HLO/donation/memory
+# guarantees.  (CPU, ~2 min.)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/audit.py || rc=1
+# Standard-lint leg (the pinned [tool.ruff] config in pyproject.toml);
+# the custom determinism lint above never depends on it.
+if command -v ruff >/dev/null 2>&1; then
+    ruff check gossip_glomers_tpu tests scripts benchmarks || rc=1
+fi
 exit $rc

@@ -36,8 +36,23 @@ import numpy as np                                          # noqa: E402
 from jax.sharding import Mesh                               # noqa: E402
 
 from gossip_glomers_tpu.harness import nemesis              # noqa: E402
+from gossip_glomers_tpu.tpu_sim import audit                # noqa: E402
 from gossip_glomers_tpu.tpu_sim import faults as F          # noqa: E402
 from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim       # noqa: E402
+
+
+def _assert_gather_free(prog, args, what: str) -> None:
+    """The no-all-gather HLO gate via the PR-6 contract checkers (the
+    same census/boundary walk the registered contracts run — here at
+    the smoke's 4-device shard count)."""
+    hlo = prog.lower(*args).compile().as_text()
+    census = audit.collective_census(hlo)
+    assert census.get("all-gather", 0) == 0, \
+        f"{what} regained an all-gather: {census}"
+    assert census.get("collective-permute", 0) >= 1, \
+        f"{what} lost its ppermute circuit: {census}"
+    host = audit.host_boundary_violations(hlo)
+    assert not host, f"{what} crossed the host boundary: {host}"
 
 
 def parity_4dev() -> None:
@@ -59,9 +74,8 @@ def parity_4dev() -> None:
     args = [jnp.full((n, s), -1, jnp.int32),
             jnp.zeros((n, s), jnp.int32),
             jnp.full((n, k), -1, jnp.int32), shd.kv_sched]
-    hlo = prog.lower(shd.init_state(), *args).compile().as_text()
-    assert "all-gather" not in hlo, \
-        "sharded kafka step regained an all-gather"
+    _assert_gather_free(prog, [shd.init_state()] + args,
+                        "4-dev sharded kafka union step")
     spec = F.NemesisSpec(n_nodes=n, seed=5, crash=((2, 4, (1,)),),
                          loss_rate=0.2, loss_until=6)
     fs, fv, fc = nemesis.stage_kafka_ops(spec, 6, n_keys=k,
@@ -92,9 +106,8 @@ def parity_4dev() -> None:
              jnp.zeros((n, s), jnp.int32),
              jnp.full((n, k), -1, jnp.int32), b_shd.kv_sched,
              b_shd.fault_plan]
-    bhlo = bprog.lower(b_shd.init_state(), *bargs).compile().as_text()
-    assert "all-gather" not in bhlo, \
-        "blocked sharded union_nem step regained an all-gather"
+    _assert_gather_free(bprog, [b_shd.init_state()] + bargs,
+                        "4-dev blocked sharded union_nem step")
     print("kafka 4-device sharded parity OK (union + union_nem + "
           "blocked union, no all-gather)")
 

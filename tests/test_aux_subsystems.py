@@ -10,7 +10,6 @@ import numpy as np
 
 from gossip_glomers_tpu.harness import tracing
 from gossip_glomers_tpu.harness.network import VirtualNetwork
-from gossip_glomers_tpu.harness.workloads import run_broadcast
 from gossip_glomers_tpu.models import BroadcastProgram
 from gossip_glomers_tpu.parallel.topology import (to_name_map, tree,
                                                   to_padded_neighbors)

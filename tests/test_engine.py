@@ -315,43 +315,44 @@ def test_kafka_faulted_union_matches_matmul_oracle(use_mesh):
         assert (np.asarray(a) == np.asarray(b)).all(), name
 
 
+def _registered_contract(name: str):
+    from gossip_glomers_tpu.tpu_sim import audit
+    by_name = {c.name: c for c in audit.default_registry()}
+    return by_name[name]
+
+
 def test_kafka_sharded_step_hlo_has_no_all_gather():
     # the sharded-presence contract: the fault-free sharded round's
     # replication reduce is a blocked psum-of-OR over ICI (ppermute
     # recursive doubling) and the offset linearization is a ppermute
-    # prefix scan — no all-gather anywhere in the compiled step
-    n, k, s = 8, 4, 2
-    sim = KafkaSim(n, k, capacity=64, max_sends=s, mesh=mesh_1d())
-    st = sim.init_state()
-    prog = sim._step_prog("union")
-    args = [jnp.full((n, s), -1, jnp.int32), jnp.zeros((n, s), jnp.int32),
-            jnp.full((n, k), -1, jnp.int32), sim.kv_sched]
-    hlo = prog.lower(st, *args).compile().as_text()
-    assert "all-gather" not in hlo
-    assert "collective-permute" in hlo
+    # prefix scan — no all-gather anywhere in the compiled step.
+    # Since PR 6 the gate IS the registered ProgramContract (the
+    # census forbids all-gather entirely); this test pins that the
+    # contract passes and that the permutes are really there.
+    from gossip_glomers_tpu.tpu_sim import audit
+    res = audit.audit_contract(
+        _registered_contract("kafka/sharded-step-union"), mesh_1d())
+    assert res["ok"], res
+    counts = res["checks"]["collectives"]["counts"]
+    assert counts.get("all-gather", 0) == 0
+    assert counts.get("collective-permute", 0) >= 1
 
 
 def test_counter_wide_sharded_step_hlo_has_no_all_gather():
     # counter's wide two-pmin winner on the same sharded driver: the
     # whole round is collective-based (psum/pmin), so the compiled
-    # sharded step carries no all-gather either
-    from gossip_glomers_tpu.tpu_sim.counter import KVReach
-    from jax.sharding import PartitionSpec as P
+    # sharded step carries no all-gather either — the registered
+    # contract allows all-reduce ONLY
+    from gossip_glomers_tpu.tpu_sim import audit
     mesh = mesh_1d()
+    res = audit.audit_contract(
+        _registered_contract("counter/sharded-step-wide"), mesh)
+    assert res["ok"], res
+    counts = res["checks"]["collectives"]["counts"]
+    assert set(counts) == {"all-reduce"}
+    # parity of the wide winner on the mesh vs single-device
     sim = CounterSim(32, mode="cas", poll_every=2, winner_key="wide",
                      mesh=mesh)
-    sched_spec = KVReach(P(), P(), P(None, None))
-
-    def step(state, sched):
-        coll = engine.collectives(state.pending.shape[0], mesh)
-        return sim._round(state, coll, sched)
-
-    prog = engine.jit_program(step, mesh=mesh,
-                              in_specs=(sim._state_spec(), sched_spec),
-                              out_specs=sim._state_spec())
-    hlo = prog.lower(sim.init_state(), sim.kv_sched).compile().as_text()
-    assert "all-gather" not in hlo
-    # parity of the wide winner on the mesh vs single-device
     ref = CounterSim(32, mode="cas", poll_every=2, winner_key="wide")
     deltas = np.arange(1, 33, dtype=np.int32)
     a = ref.run_fused(ref.add(ref.init_state(), deltas), 12)
@@ -389,6 +390,41 @@ def test_scan_blocks_and_resolve_block():
         1024, "auto", per_row_bytes=1, budget_bytes=1 << 20) is None
     assert engine.resolve_block(
         1024, "auto", per_row_bytes=1 << 12, budget_bytes=1 << 20) == 256
+
+
+def test_resolve_block_env_parsing_is_loud(monkeypatch):
+    # the GG_UNION_BLOCK env contract (ISSUE 6 satellite): malformed
+    # or non-divisor env values raise a ValueError NAMING the variable
+    # instead of int()'s bare "invalid literal" (or a silent per-sim
+    # divisor clamp a global knob never asked for)
+    monkeypatch.setenv("GG_UNION_BLOCK", "banana")
+    with pytest.raises(ValueError, match="GG_UNION_BLOCK"):
+        engine.resolve_block(24)
+    monkeypatch.setenv("GG_UNION_BLOCK", "7")          # not a divisor
+    with pytest.raises(ValueError, match="GG_UNION_BLOCK"):
+        engine.resolve_block(24)
+    monkeypatch.setenv("GG_UNION_BLOCK", "6")
+    assert engine.resolve_block(24) == 6
+    monkeypatch.setenv("GG_UNION_BLOCK", "100")        # >= rows: whole
+    assert engine.resolve_block(24) == 24              # axis, one slab
+    monkeypatch.setenv("GG_UNION_BLOCK", "-3")         # <= 0: pin the
+    assert engine.resolve_block(24) is None            # oracle
+    # the budget env gets the same loud contract
+    monkeypatch.setenv("GG_UNION_BLOCK", "auto")
+    monkeypatch.setenv("GG_UNION_BLOCK_BUDGET_MB", "lots")
+    with pytest.raises(ValueError, match="GG_UNION_BLOCK_BUDGET_MB"):
+        engine.resolve_block(24)
+    monkeypatch.setenv("GG_UNION_BLOCK_BUDGET_MB", "-1")
+    with pytest.raises(ValueError, match="GG_UNION_BLOCK_BUDGET_MB"):
+        engine.resolve_block(24)
+    # a sim constructor surfaces the env error too (no int() fallout
+    # buried in a sweep log)
+    monkeypatch.setenv("GG_UNION_BLOCK", "oops")
+    with pytest.raises(ValueError, match="GG_UNION_BLOCK"):
+        CounterSim(16, mode="allreduce")
+    # programmatic ints keep the documented divisor clamp — the caller
+    # named a specific sim (pinned by test_scan_blocks_and_resolve_block)
+    assert engine.resolve_block(24, 7) == 6
 
 
 def test_kafka_union_footprint_formula_pinned():
@@ -446,20 +482,22 @@ def test_kafka_blocked_sharded_step_hlo_has_no_all_gather():
     # the blocked-union sharded contract (ISSUE 5): each shard scans
     # only its LOCAL destination rows and the per-send metadata rides
     # a ring ppermute — the compiled faulted step has NO all-gather
-    # (the materialized union_nem widens the metadata instead)
-    from gossip_glomers_tpu.tpu_sim import faults as F
-    n, k, s = 16, 4, 2
-    spec = F.NemesisSpec(n_nodes=n, seed=5, crash=((2, 4, (1,)),),
-                         loss_rate=0.2, loss_until=6)
-    sim = KafkaSim(n, k, capacity=64, max_sends=s, mesh=mesh_1d(),
-                   fault_plan=spec.compile(), union_block=1)
-    prog = sim._step_prog("union_nem")
-    args = [jnp.full((n, s), -1, jnp.int32), jnp.zeros((n, s), jnp.int32),
-            jnp.full((n, k), -1, jnp.int32), sim.kv_sched,
-            sim.fault_plan]
-    hlo = prog.lower(sim.init_state(), *args).compile().as_text()
-    assert "all-gather" not in hlo
-    assert "collective-permute" in hlo
+    # (the materialized union_nem widens the metadata instead).  Both
+    # halves are registered contracts now: the blocked census forbids
+    # all-gather, the materialized oracle's caps it at exactly its 3
+    # metadata widens.
+    from gossip_glomers_tpu.tpu_sim import audit
+    mesh = mesh_1d()
+    res = audit.audit_contract(_registered_contract(
+        "kafka/sharded-step-union-nem-blocked"), mesh)
+    assert res["ok"], res
+    counts = res["checks"]["collectives"]["counts"]
+    assert counts.get("all-gather", 0) == 0
+    assert counts.get("collective-permute", 0) >= 1
+    mat = audit.audit_contract(_registered_contract(
+        "kafka/sharded-step-union-nem-materialized"), mesh)
+    assert mat["ok"], mat
+    assert mat["checks"]["collectives"]["counts"]["all-gather"] == 3
 
 
 # -- engine internals ---------------------------------------------------

@@ -229,7 +229,7 @@ def test_linearize_check_bites_on_stale_cas_bug(monkeypatch):
     # injection is seeded and service-side only; nodes are untouched.
     import random as _random
 
-    from gossip_glomers_tpu.harness import services, workloads
+    from gossip_glomers_tpu.harness import workloads
     from gossip_glomers_tpu.harness.services import KVService
     from gossip_glomers_tpu.harness.workloads import run_kafka_faults
 
