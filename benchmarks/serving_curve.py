@@ -1,0 +1,217 @@
+"""Serving curves (PR 7): latency-vs-offered-load for the three
+stateful sims under the open-loop traffic engine, plus the crash+loss
+fault overlay — the ``BENCH_PR7.json`` artifact.
+
+For each sim the sweep records rows at the 1,024- and 65,536-node
+sweep points — single-device at the small point, the 8-way virtual
+mesh at the big one (the same SPMD partitioner and collectives as real
+chips; ``backend`` is recorded honestly) — across a ladder of offered
+loads (per-client arrival rates; the drivers compile ONE program per
+shape and the rate rides the traced TrafficPlan).  Each row is a
+certified ``harness.serving.run_serving`` verdict: p50/p99/max op
+latency in rounds, sustained ops/round and ops/sec, deferred-arrival
+backpressure counts, and the zero-lost-acked-ops drain check.
+
+The fault-overlay rows run crash+loss WHILE traffic flows and record
+the per-round completion series — the throughput cliff inside the
+fault window and the recovery after it clears — plus the same
+certification (broadcast and kafka must certify: their acked ops are
+recoverable by anti-entropy/resync; the counter overlay reports its
+verdict honestly — a cas-mode amnesia row CAN take acked-but-unflushed
+deltas with it, which is the reference's ack-before-durability risk,
+so its row runs the every-round-flush allreduce mode).
+
+Usage::
+
+    python benchmarks/serving_curve.py [--out BENCH_PR7.json] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gossip_glomers_tpu.parallel.mesh import force_virtual_devices  # noqa: E402
+
+force_virtual_devices(8)
+
+import jax                                                  # noqa: E402
+import numpy as np                                          # noqa: E402
+from jax.sharding import Mesh                               # noqa: E402
+
+from gossip_glomers_tpu.harness import serving              # noqa: E402
+from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec   # noqa: E402
+from gossip_glomers_tpu.tpu_sim.traffic import TrafficSpec  # noqa: E402
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(8), ("nodes",))
+
+
+def _slim(row: dict) -> dict:
+    out = {k: v for k, v in row.items()
+           if k not in ("issued_by_round", "completed_by_round")}
+    return out
+
+
+def curve(kind: str, tspec: TrafficSpec, loads, *, mesh=None,
+          sim_kw=None, **kw) -> list:
+    t0 = time.time()
+    rows = serving.run_serving_curve(kind, tspec, loads, mesh=mesh,
+                                     sim_kw=sim_kw, **kw)
+    for r in rows:
+        tag = "mesh8" if mesh is not None else "1dev"
+        print(f"  {kind:9s} {tag} n={r['n_nodes']:6d} "
+              f"offered={r['offered_per_round']:8.2f}/rd "
+              f"sustained={r['sustained_per_round']:8.2f}/rd "
+              f"p50={r['lat_p50']} p99={r['lat_p99']} "
+              f"deferred={r['deferred']} ok={r['ok']}  "
+              f"[{time.time() - t0:.1f}s]")
+    return [_slim(r) for r in rows]
+
+
+def overlay(kind: str, tspec: TrafficSpec, spec: NemesisSpec,
+            **kw) -> dict:
+    row = serving.run_serving(kind, tspec, nemesis=spec, series=True,
+                              **kw)
+    cliff = row.get("cliff", {})
+    print(f"  overlay {kind:9s} ok={row['ok']} "
+          f"lost={row['n_lost_writes']} p99={row['lat_p99']} "
+          f"cliff={cliff.get('faulted_completions_per_round')}"
+          f"->{cliff.get('recovery_completions_per_round')}/rd "
+          f"recovery={row['recovery_rounds']}")
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_PR7.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes (CI smoke), same row structure")
+    args = ap.parse_args()
+    q = args.quick
+    small = 64 if q else 1024
+    big = 256 if q else 65536
+    until_s = 16 if q else 48
+    mesh = _mesh()
+    report: dict = {
+        "meta": {"backend": jax.default_backend(),
+                 "jax": jax.__version__, "n_devices_mesh": 8,
+                 "quick": q,
+                 "note": "open-loop serving curves: offered load = "
+                         "rate x clients ops/round; latency in "
+                         "ROUNDS (1 round == 1 network hop == "
+                         "Maelstrom's 100 ms); ops/sec is wall-clock "
+                         "on THIS backend"},
+        "curves": {}, "fault_overlay": []}
+
+    # ops_per_client sizes each client's op-slot capacity ABOVE the
+    # heaviest load's expected arrivals (rate_max x until), so the
+    # curves measure latency, not slot backpressure
+    print(f"== broadcast (words-major tree, {small} + {big} nodes)")
+    t_b_small = TrafficSpec(
+        n_nodes=small, n_clients=min(256, small),
+        ops_per_client=until_s, until=until_s, rate=0.1, seed=101)
+    report["curves"]["broadcast_small_1dev"] = curve(
+        "broadcast", t_b_small, [0.05, 0.2, 0.5],
+        sim_kw=dict(topology="tree", structured=True, sync_every=4))
+    t_b_big = TrafficSpec(
+        n_nodes=big, n_clients=512 if not q else 64,
+        ops_per_client=until_s, until=until_s, rate=0.1, seed=102)
+    report["curves"]["broadcast_big_mesh8"] = curve(
+        "broadcast", t_b_big, [0.1, 0.5], mesh=mesh,
+        sim_kw=dict(topology="tree", structured=True, sync_every=4))
+
+    print(f"== counter (cas queueing @ {small} 1dev, "
+          f"allreduce @ {big} mesh)")
+    t_c_small = TrafficSpec(
+        n_nodes=small, n_clients=small, ops_per_client=4,
+        until=96 if not q else 24, rate=0.001, seed=103)
+    # offered 0.5 / 1 / 2 ops per round vs the cas drain rate of ~one
+    # node's pending per round: the open-loop queueing curve
+    c_loads = [r / small for r in (0.5, 1.0, 2.0)]
+    report["curves"]["counter_small_1dev"] = curve(
+        "counter", t_c_small, c_loads,
+        sim_kw=dict(mode="cas", poll_every=2),
+        max_recovery_rounds=384)
+    t_c_big = TrafficSpec(
+        n_nodes=big, n_clients=512 if not q else 64,
+        ops_per_client=16, until=32 if not q else 12, rate=0.1,
+        seed=104)
+    report["curves"]["counter_big_mesh8"] = curve(
+        "counter", t_c_big, [0.1, 0.3], mesh=mesh,
+        sim_kw=dict(mode="allreduce", poll_every=2))
+
+    print(f"== kafka (origin-union, {small} 1dev + {big} mesh)")
+    t_k_small = TrafficSpec(
+        n_nodes=small, n_clients=min(256, small),
+        ops_per_client=until_s, until=until_s, rate=0.1, seed=105)
+    report["curves"]["kafka_small_1dev"] = curve(
+        "kafka", t_k_small, [0.05, 0.2, 0.5],
+        sim_kw=dict(n_keys=64 if not q else 16, max_sends=4))
+    t_k_big = TrafficSpec(
+        n_nodes=big, n_clients=512 if not q else 64,
+        ops_per_client=16, until=32 if not q else 12, rate=0.1,
+        seed=106)
+    report["curves"]["kafka_big_mesh8"] = curve(
+        "kafka", t_k_big, [0.1, 0.3], mesh=mesh,
+        sim_kw=dict(n_keys=64 if not q else 16, max_sends=4))
+
+    print("== fault overlay: crash+loss while traffic flows")
+    n_f = small
+    # every 5th node (20%): stride 5 is coprime to the grid's column
+    # count, so no crashing node loses a NEIGHBOR to the same window —
+    # a value injected one round before the window always has >= 2
+    # live flood targets (one lossy edge cannot orphan it; stride 4
+    # aliases with the 32-wide grid and strands row-edge nodes on a
+    # single lossy out-edge)
+    down = tuple(range(0, n_f, 5))
+    f_lo, f_hi = (until_s // 3, 2 * until_s // 3)
+    fault = NemesisSpec(
+        n_nodes=n_f, seed=107, crash=((f_lo, f_hi, down),),
+        loss_rate=0.1, loss_until=f_hi + 4)
+    t_f = TrafficSpec(
+        n_nodes=n_f, n_clients=min(256, n_f),
+        ops_per_client=until_s, until=until_s, rate=0.2, seed=108)
+    # grid for the broadcast overlay: min degree 2, so one lossy edge
+    # cannot orphan a value injected at an about-to-crash leaf (a
+    # tree leaf's single parent edge makes that a ~loss_rate event
+    # per such arrival — the ack-before-durability exposure the
+    # certifier exists to flag)
+    report["fault_overlay"].append(overlay(
+        "broadcast", t_f, fault,
+        sim_kw=dict(topology="grid", structured=True, sync_every=4),
+        max_recovery_rounds=192))
+    report["fault_overlay"].append(overlay(
+        "kafka", t_f, fault,
+        sim_kw=dict(n_keys=64 if not q else 16, max_sends=4,
+                    resync_every=4), max_recovery_rounds=192))
+    # counter overlay: every-round-flush allreduce minimizes (but
+    # cannot eliminate) the ack-before-durability exposure — the row
+    # records its verdict honestly either way
+    report["fault_overlay"].append(overlay(
+        "counter", t_f, fault,
+        sim_kw=dict(mode="allreduce", poll_every=2),
+        max_recovery_rounds=192))
+
+    certified = [r for r in report["fault_overlay"]
+                 if r["ok"] and r["spec"]["crash"]
+                 and r["spec"]["loss_rate"] > 0]
+    report["meta"]["n_overlay_certified"] = len(certified)
+    if not certified:
+        print("FAIL: no fault-overlay row certified", file=sys.stderr)
+        return 1
+
+    out = pathlib.Path(args.out)
+    out.write_text(json.dumps(report, indent=1) + "\n")
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,6 +73,7 @@ def check_recovery(*, clear_round: int, converged_round: int | None,
                    max_recovery_rounds: int, lost_writes: list,
                    msgs_at_clear: int | None = None,
                    msgs_at_converged: int | None = None,
+                   latency: dict | None = None,
                    ) -> tuple[bool, dict]:
     """Recovery certification under a nemesis plan (the tpu_sim
     counterpart of Maelstrom's post-heal availability/validity checks):
@@ -83,13 +84,25 @@ def check_recovery(*, clear_round: int, converged_round: int | None,
       observed; None = never), and
     - lose NO acknowledged writes (``lost_writes``: the workload's
       evidence list — broadcast values absent from every node, counter
-      delta shortfall, kafka allocated slots missing everywhere).
+      delta shortfall, kafka allocated slots missing everywhere, an
+      open-loop serving run's forever-in-flight acked ops).
 
     Reports ``recovery_rounds`` (rounds from clear to convergence) and
-    the ``degraded_throughput`` summary: messages per round spent while
-    faults were active vs during recovery (>= 1 means the fault phase
-    burned more traffic per round than the repair phase — retries,
-    re-floods and duplicates at work).
+    the ``degraded_throughput`` summary.  **Units**: both phases are
+    measured in *messages per round* — ``msgs_per_round_faulted`` is
+    ``msgs_at_clear / clear_round`` (total messages sent while faults
+    were active, averaged over the faulted rounds) and
+    ``msgs_per_round_recovery`` is the recovery phase's increment
+    averaged over its rounds; ``degraded_throughput`` is their
+    DIMENSIONLESS ratio (faulted-phase msgs/round over recovery-phase
+    msgs/round — >= 1 means the fault phase burned more traffic per
+    round than the repair phase: retries, re-floods and duplicates at
+    work).
+
+    ``latency`` (PR 7): an open-loop run's tracker summary
+    (tpu_sim/traffic.py ``latency_summary``) — its ``lat_p50`` /
+    ``lat_p99`` / ``lat_max`` per-op latency keys (rounds) surface
+    through this details dict, next to the recovery keys.
     """
     recovery = (None if converged_round is None
                 else converged_round - clear_round)
@@ -113,7 +126,50 @@ def check_recovery(*, clear_round: int, converged_round: int | None,
             details["msgs_per_round_recovery"] = rec_rate
             if rec_rate > 0:
                 details["degraded_throughput"] = faulted / rec_rate
+    if latency is not None:
+        for key in ("lat_p50", "lat_p99", "lat_max"):
+            if key in latency:
+                details[key] = latency[key]
     return ok, details
+
+
+def check_op_latency(summary: dict, *, p99_max_rounds: float,
+                     max_rounds: int | None = None,
+                     min_completed: int = 1) -> tuple[bool, dict]:
+    """Per-op latency bound over an open-loop tracker summary
+    (tpu_sim/traffic.py ``latency_summary``): the run fails when its
+    p99 op latency (rounds) exceeds ``p99_max_rounds``, when its max
+    exceeds ``max_rounds`` (if given), when fewer than
+    ``min_completed`` ops completed, or when the tracker's
+    conservation invariant (arrived == issued + deferred) broke.  A
+    deliberately-delayed op must fail the bound —
+    tests/test_traffic.py proves it (a checker that cannot fail is
+    decoration)."""
+    completed = summary.get("completed", 0)
+    problems: list[str] = []
+    if not summary.get("conserved", True):
+        problems.append("conservation broke: arrived != issued + "
+                        "deferred (a silently-dropped arrival)")
+    if completed < min_completed:
+        problems.append(
+            f"only {completed} ops completed (< {min_completed})")
+    elif completed > 0:        # min_completed=0: an empty run is
+        if summary["lat_p99"] > p99_max_rounds:  # vacuously in bound
+            problems.append(
+                f"p99 latency {summary['lat_p99']} rounds > bound "
+                f"{p99_max_rounds}")
+        if max_rounds is not None and summary["lat_max"] > max_rounds:
+            problems.append(
+                f"max latency {summary['lat_max']} rounds > bound "
+                f"{max_rounds}")
+    return not problems, {
+        "completed": completed,
+        "lat_p50": summary.get("lat_p50"),
+        "lat_p99": summary.get("lat_p99"),
+        "lat_max": summary.get("lat_max"),
+        "p99_max_rounds": p99_max_rounds,
+        "max_rounds": max_rounds,
+        "problems": problems}
 
 
 def check_kafka(send_acks: list[tuple[str, int, int]],

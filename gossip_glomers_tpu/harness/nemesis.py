@@ -53,7 +53,8 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                           parts: Partitions | None = None,
                           max_recovery_rounds: int = 96,
                           mesh=None,
-                          structured: "bool | str" = False) -> dict:
+                          structured: "bool | str" = False,
+                          traffic=None) -> dict:
     """Broadcast under the full nemesis (crash/loss/dup from ``spec``,
     plus an optional partition schedule): values injected round-robin
     at round 0, convergence = every node holds every value.  A lost
@@ -67,10 +68,36 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
     backend and words count (structured.faulted_path_pick): structured
     everywhere on TPU, gather on CPU above the measured
     ``NEM_GATHER_MIN_W`` words crossover — the resolution of the
-    BENCH_PR3 n_values=2048 (W=64) regression row."""
+    BENCH_PR3 n_values=2048 (W=64) regression row.
+
+    ``traffic`` (PR 7): a :class:`~..tpu_sim.traffic.TrafficSpec` —
+    run the campaign OPEN-LOOP instead: client values keep arriving
+    while the faults play out, and the verdict is the serving
+    certifier (harness/serving.py): bounded drain after
+    ``clear_round``, zero lost acked ops, p50/p99 op latency in the
+    details.  Fault campaigns and serving load compose in one fused
+    device program (the (TrafficPlan, FaultPlan) operand pair)."""
     from ..tpu_sim import structured as S
     n = spec.n_nodes
     nv = n_values if n_values is not None else 2 * n
+    if traffic is not None:
+        from . import serving
+        if parts is not None:
+            raise ValueError(
+                "traffic= composes with the FaultPlan nemesis; "
+                "partition schedules are not wired into the serving "
+                "runners yet")
+        if structured == "auto":
+            structured = (S.faulted_path_pick(
+                (traffic.n_clients * traffic.ops_per_client + 31)
+                // 32) == "structured")
+        sim_kw = dict(topology=topology, sync_every=sync_every,
+                      structured=bool(structured))
+        if n_values is not None:
+            sim_kw["n_values"] = nv
+        return serving.run_serving(
+            "broadcast", traffic, nemesis=spec, mesh=mesh,
+            max_recovery_rounds=max_recovery_rounds, sim_kw=sim_kw)
     if structured == "auto":
         structured = (S.faulted_path_pick((nv + 31) // 32)
                       == "structured")
@@ -121,13 +148,25 @@ def run_counter_nemesis(spec: NemesisSpec, *,
                         mode: str = "cas", poll_every: int = 2,
                         max_recovery_rounds: int = 64,
                         union_block: "int | str | None" = None,
-                        mesh=None) -> dict:
+                        mesh=None, traffic=None) -> dict:
     """G-counter under the nemesis: per-node deltas acked at round 0,
     convergence = pending fully drained AND every node's cached read
     equals the KV.  Lost acknowledged writes = the final shortfall
     ``acked_sum - kv`` — exactly the pending deltas that died in
     amnesia rows before the flush loop drained them (the reference's
-    ack-before-durability risk made measurable)."""
+    ack-before-durability risk made measurable).
+
+    ``traffic`` (PR 7): open-loop composition — adds keep arriving
+    through the fault windows and the serving certifier takes over
+    (see :func:`run_broadcast_nemesis`); ``deltas`` is ignored (each
+    traffic op adds 1)."""
+    if traffic is not None:
+        from . import serving
+        return serving.run_serving(
+            "counter", traffic, nemesis=spec, mesh=mesh,
+            max_recovery_rounds=max_recovery_rounds,
+            sim_kw=dict(mode=mode, poll_every=poll_every,
+                        union_block=union_block))
     n = spec.n_nodes
     if deltas is None:
         deltas = np.arange(1, n + 1, dtype=np.int32)
@@ -217,7 +256,7 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
                       union_block: "int | str | None" = None,
                       commits: bool = True,
                       send_prob: float = 0.7,
-                      mesh=None) -> dict:
+                      mesh=None, traffic=None) -> dict:
     """Replicated log under the nemesis: seeded send/commit traffic at
     live nodes through the faulted phase, then quiescent recovery.
     Convergence = every node's presence bitset identical (the periodic
@@ -240,7 +279,23 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
     blocked path that carries faulted campaigns past the materialized
     coin tensor's N² wall); ``commits=False`` stages a send-only
     campaign (vectorized, no O(R·N·K) commit array — the large-N
-    rows)."""
+    rows).
+
+    ``traffic`` (PR 7): open-loop composition — sends keep arriving
+    through the fault windows via the sim's own send staging and the
+    serving certifier takes over (see :func:`run_broadcast_nemesis`);
+    the staged-campaign knobs (``workload_seed``/``commits``/
+    ``send_prob``/``rounds``/``repl_fast``) are inert in that mode."""
+    if traffic is not None:
+        from . import serving
+        return serving.run_serving(
+            "kafka", traffic, nemesis=spec, mesh=mesh,
+            max_recovery_rounds=max_recovery_rounds,
+            sim_kw=dict(n_keys=n_keys, capacity=capacity,
+                        max_sends=max_sends,
+                        resync_every=resync_every,
+                        resync_mode=resync_mode,
+                        union_block=union_block))
     n = spec.n_nodes
     clear = max(spec.clear_round, rounds or 0)
     sks, svs, crs = stage_kafka_ops(

@@ -1,0 +1,231 @@
+"""Serving-curve harness: closed-loop checkers over the open-loop
+traffic engine (tpu_sim/traffic.py) — latency-vs-offered-load curves
+and load/fault serving behavior, the PR-7 counterpart of the
+convergence benches.
+
+``run_serving`` drives ONE serving run: build the sim (optionally
+under a seeded crash/loss :class:`~..tpu_sim.faults.NemesisSpec` — the
+TrafficPlan and FaultPlan ride the same fused program), run the driven
+phase (``spec.until`` rounds of open-loop arrivals) as one donated
+dispatch, let any fault horizon clear, then DRAIN: keep running
+arrival-free rounds until every issued op is globally visible or the
+budget runs out.  The verdict is ``checkers.check_recovery`` over the
+tracker — bounded drain, ZERO lost acked ops (an op still in flight
+after the drain is an acknowledged write the system lost — e.g. a
+counter delta that died in an amnesia row), with the p50/p99/max op
+latency surfaced through the same details path.
+
+``run_serving_curve`` sweeps offered load (the spec's per-client rate)
+and returns one row per load — the latency-vs-offered-load table; with
+a nemesis the per-round completion series records the throughput CLIFF
+inside the fault window and the recovery after it clears (the serving
+generalization of ``check_recovery``'s ``degraded_throughput``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from ..parallel.topology import grid, to_padded_neighbors, tree
+from ..tpu_sim import traffic
+from ..tpu_sim.broadcast import BroadcastSim
+from ..tpu_sim.counter import CounterSim
+from ..tpu_sim.faults import NemesisSpec
+from ..tpu_sim.kafka import KafkaSim
+from .checkers import check_recovery
+
+_TOPOLOGIES = {"grid": grid, "tree": tree}
+
+
+def make_serving_sim(kind: str, tspec: "traffic.TrafficSpec", *,
+                     nemesis: NemesisSpec | None = None, mesh=None,
+                     **sim_kw):
+    """Build the sim a serving run drives, plus its empty state.
+
+    ``sim_kw`` (per kind): broadcast — ``topology`` ("grid"/"tree"),
+    ``structured`` (words-major path; required for the big node
+    scales), ``sync_every``, ``n_values``; counter — ``mode``,
+    ``poll_every``, ``union_block``; kafka — ``n_keys``, ``capacity``,
+    ``max_sends``, ``resync_every``, ``resync_mode``, ``union_block``.
+    """
+    n = tspec.n_nodes
+    if nemesis is not None and nemesis.n_nodes != n:
+        raise ValueError(
+            f"NemesisSpec is for {nemesis.n_nodes} nodes, traffic "
+            f"for {n}")
+    plan = nemesis.compile() if nemesis is not None else None
+
+    if kind == "broadcast":
+        from ..tpu_sim import structured as S
+        topology = sim_kw.pop("topology", "grid")
+        structured = bool(sim_kw.pop("structured", False))
+        sync_every = sim_kw.pop("sync_every", 4)
+        n_values = sim_kw.pop(
+            "n_values", tspec.n_clients * tspec.ops_per_client)
+        kw = dict(sync_every=sync_every, srv_ledger=False, mesh=mesh,
+                  fault_plan=plan, **sim_kw)
+        if structured:
+            n_sh = (int(mesh.shape["nodes"]) if mesh is not None
+                    else None)
+            kw["exchange"] = S.make_exchange(topology, n)
+            if nemesis is not None:
+                kw["nemesis"] = S.make_nemesis(topology, n, nemesis,
+                                               n_shards=n_sh)
+            elif n_sh is not None:
+                kw["sharded_exchange"] = S.make_sharded_exchange(
+                    topology, n, n_sh)
+        try:
+            build = _TOPOLOGIES[topology]
+        except KeyError:
+            raise ValueError(
+                f"unknown topology {topology!r}; "
+                f"one of {sorted(_TOPOLOGIES)}") from None
+        sim = BroadcastSim(to_padded_neighbors(build(n)),
+                           n_values=n_values, **kw)
+        state = sim.init_state(
+            np.zeros((n, sim.n_words), np.uint32))
+    elif kind == "counter":
+        sim = CounterSim(n, mode=sim_kw.pop("mode", "cas"),
+                         poll_every=sim_kw.pop("poll_every", 2),
+                         fault_plan=plan, mesh=mesh, **sim_kw)
+        state = sim.init_state()
+    elif kind == "kafka":
+        # default capacity: ~2x the expected per-key op volume, so the
+        # fault-free curve measures latency, not capacity backpressure
+        expect = tspec.rate * tspec.n_clients * tspec.until
+        n_keys = sim_kw.pop("n_keys", 16)
+        cap = sim_kw.pop("capacity",
+                         max(64, int(2 * expect / n_keys + 32)))
+        sim = KafkaSim(n, n_keys, capacity=cap,
+                       max_sends=sim_kw.pop("max_sends", 4),
+                       fault_plan=plan,
+                       resync_every=sim_kw.pop("resync_every", 4),
+                       mesh=mesh, **sim_kw)
+        state = sim.init_state()
+    else:
+        raise ValueError(f"unknown serving workload {kind!r}")
+    return sim, state
+
+
+def _fresh_state(kind: str, sim):
+    if kind == "broadcast":
+        return sim.init_state(
+            np.zeros((sim.n_nodes, sim.n_words), np.uint32))
+    return sim.init_state()
+
+
+def run_serving(kind: str, tspec: "traffic.TrafficSpec", *,
+                nemesis: NemesisSpec | None = None, mesh=None,
+                sim_kw: dict | None = None,
+                max_recovery_rounds: int = 96,
+                drain_every: int = 8,
+                series: bool = False, sim=None) -> dict:
+    """One open-loop serving run, certified (module docstring).
+
+    Returns the merged ``check_recovery`` details dict: ``ok`` (bounded
+    drain AND zero lost acked ops AND conservation), the tracker
+    summary (arrived/issued/deferred/completed/in_flight,
+    lat_p50/lat_p99/lat_max in rounds), offered vs sustained load, and
+    — with ``series`` — the per-round issue/completion counts (the
+    throughput-cliff evidence under a nemesis).
+
+    ``sim``: a prebuilt sim to reuse (the curve sweep passes one so
+    every load shares ONE compiled traffic program — the drivers cache
+    by ``TrafficSpec.program_key``, and rate rides the traced plan)."""
+    if sim is None:
+        sim, state = make_serving_sim(kind, tspec, nemesis=nemesis,
+                                      mesh=mesh, **(sim_kw or {}))
+    else:
+        state = _fresh_state(kind, sim)
+    ts = sim.traffic_state(tspec)
+    t0 = time.perf_counter()
+    state, ts = sim.run_traffic(state, ts, tspec, tspec.until,
+                                donate=True)
+    jax.block_until_ready(ts.completed)
+    driven_s = time.perf_counter() - t0
+    clear = max(tspec.until,
+                nemesis.clear_round if nemesis is not None else 0)
+    if clear > tspec.until:
+        # faults outlast the traffic horizon: keep the system running
+        # (arrival coins are off past `until`) until the plan clears
+        state, ts = sim.run_traffic(state, ts, tspec,
+                                    clear - tspec.until, donate=True)
+    msgs_at_clear = int(state.msgs)
+    drained = 0
+    while (int(ts.completed) < int(np.asarray(ts.issued_k).sum())
+           and drained < max_recovery_rounds):
+        step = min(drain_every, max_recovery_rounds - drained)
+        state, ts = sim.run_traffic(state, ts, tspec, step,
+                                    donate=True)
+        drained += step
+    total_s = time.perf_counter() - t0
+    summ = traffic.latency_summary(ts)
+    done_r = np.asarray(ts.done_round)
+    if summ["issued"] == 0:
+        converged_round = clear
+    elif summ["in_flight"] == 0:
+        converged_round = max(clear, int(done_r.max()))
+    else:
+        converged_round = None
+    lost = ([{"open_ops": summ["in_flight"]}]
+            if summ["in_flight"] else [])
+    ok, details = check_recovery(
+        clear_round=clear, converged_round=converged_round,
+        max_recovery_rounds=max_recovery_rounds, lost_writes=lost,
+        msgs_at_clear=msgs_at_clear, msgs_at_converged=int(state.msgs),
+        latency=summ)
+    ok = ok and summ["conserved"]
+    total_rounds = clear + drained
+    details.update(
+        workload=kind, n_nodes=tspec.n_nodes, mesh=(
+            None if mesh is None else int(mesh.shape["nodes"])),
+        traffic=tspec.to_meta(), **summ,
+        offered_per_round=traffic.offered_per_round(tspec),
+        sustained_per_round=summ["completed"] / max(1, total_rounds),
+        ops_per_sec=summ["completed"] / max(1e-9, total_s),
+        driven_rounds=tspec.until, total_rounds=total_rounds,
+        driven_s=round(driven_s, 4), total_s=round(total_s, 4),
+        msgs_total=int(state.msgs))
+    if nemesis is not None:
+        details["spec"] = nemesis.to_meta()
+    if series or nemesis is not None:
+        sr = traffic.per_round_series(ts, total_rounds)
+        if series:
+            details.update(sr)
+        if nemesis is not None and nemesis.crash:
+            # the serving cliff: completions/round inside the fault
+            # window vs after it clears (the open-loop generalization
+            # of check_recovery's degraded_throughput ratio)
+            comp = np.asarray(sr["completed_by_round"], np.float64)
+            f_lo = min(s for s, _e, _n in nemesis.crash)
+            faulted = comp[f_lo:clear]
+            after = comp[clear:]
+            details["cliff"] = {
+                "fault_window": [f_lo, clear],
+                "faulted_completions_per_round": (
+                    float(faulted.mean()) if faulted.size else None),
+                "recovery_completions_per_round": (
+                    float(after.mean()) if after.size else None),
+            }
+    return {"ok": ok, **details}
+
+
+def run_serving_curve(kind: str, tspec: "traffic.TrafficSpec",
+                      loads, *, nemesis: NemesisSpec | None = None,
+                      mesh=None, sim_kw: dict | None = None,
+                      **kw) -> list:
+    """Latency-vs-offered-load table: one :func:`run_serving` row per
+    per-client ``rate`` in ``loads`` (same seed, same shape — only the
+    offered load moves).  Builds the sim ONCE (capacity defaults sized
+    at the heaviest load) and reuses it, so the whole sweep compiles
+    one traffic program."""
+    sim, _ = make_serving_sim(kind, tspec.with_rate(float(max(loads))),
+                              nemesis=nemesis, mesh=mesh,
+                              **(sim_kw or {}))
+    return [run_serving(kind, tspec.with_rate(float(r)),
+                        nemesis=nemesis, mesh=mesh, sim_kw=sim_kw,
+                        sim=sim, **kw)
+            for r in loads]

@@ -40,6 +40,12 @@ Modules:
   boundary, memory contract) over a declarative per-driver
   ``ProgramContract`` registry, plus the AST determinism lint (see
   ARCHITECTURE.md "Static contracts").
+- :mod:`.traffic` — the open-loop client-traffic engine (PR 7):
+  seeded Poisson/constant/burst arrival schedules over a client axis
+  (stateless (round, client) hash coins, a ``TrafficPlan`` operand
+  next to the FaultPlan), the per-op completion-round tracker behind
+  the p50/p99 serving-latency reports, and the loud backpressure
+  accounting (see ARCHITECTURE.md "Open-loop traffic").
 """
 
 from .broadcast import (BroadcastSim, BroadcastState, Partitions,
@@ -51,6 +57,7 @@ from .kafka import KafkaSim, KafkaState
 from .structured import (FaultedDelayed, StructuredDelays,
                          StructuredFaults, make_delayed,
                          make_delayed_faulted, make_faulted)
+from .traffic import TrafficPlan, TrafficSpec, TrafficState
 from .unique_ids import UniqueIdsSim, UniqueIdsState
 
 __all__ = ["BroadcastSim", "BroadcastState", "Partitions", "make_inject",
@@ -60,5 +67,6 @@ __all__ = ["BroadcastSim", "BroadcastState", "Partitions", "make_inject",
            "StructuredFaults", "make_faulted",
            "StructuredDelays", "make_delayed",
            "FaultedDelayed", "make_delayed_faulted",
+           "TrafficSpec", "TrafficPlan", "TrafficState",
            "UniqueIdsSim", "UniqueIdsState",
            "EchoSim", "EchoState"]

@@ -424,14 +424,24 @@ def _faults_roots() -> str:
                             for n in faults.TRACED_EVALUATORS) + ")$")
 
 
+def _traffic_roots() -> str:
+    # traffic.py declares its split the same way (PR 7; totality
+    # pinned by tests/test_traffic.py)
+    from . import traffic
+    return ("^(" + "|".join(re.escape(n)
+                            for n in traffic.TRACED_EVALUATORS) + ")$")
+
+
 _TRACED_ROOTS: dict[str, str] = {
     "tpu_sim/broadcast.py":
         r"^(_round|flood_step$|_wm_round_single$|_sharded_round"
         r"|_live_rows$|_edge_live$|_popcount$|_flood_loop$"
-        r"|_flood_ledger$)",
-    "tpu_sim/counter.py": r"^(_round$|_reach$)",
-    "tpu_sim/kafka.py": r"^(_round$|_rank_within_key$)",
+        r"|_flood_ledger$|_traffic_inject$|_traffic_done$)",
+    "tpu_sim/counter.py": r"^(_round$|_reach$|_traffic_round$)",
+    "tpu_sim/kafka.py":
+        r"^(_round$|_rank_within_key$|_alloc$|_traffic_round$)",
     "tpu_sim/faults.py": _faults_roots(),
+    "tpu_sim/traffic.py": _traffic_roots(),
     "tpu_sim/engine.py":
         r"^(sharded_roll$|sharded_shift$|collectives$|fori_rounds$"
         r"|windows_fold$|scan_blocks$|scan_rounds$|while_converge$)",

@@ -43,10 +43,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import faults
-from .engine import (donate_argnums_for, fori_rounds, resolve_block,
-                     scan_blocks, shard_map, stepwise_converge,
-                     while_converge, windows_fold)
+from . import faults, traffic
+from .engine import (collectives, donate_argnums_for, fori_rounds,
+                     jit_program, resolve_block, scan_blocks,
+                     shard_map, stepwise_converge, while_converge,
+                     windows_fold)
 from .structured import _take_delayed
 
 WORD = 32
@@ -1183,6 +1184,8 @@ class BroadcastSim:
         # (runner, flood parts | None) pair (fixed) — see _build_fixed
         self._fused = {}
         self._fixed = {}
+        # open-loop traffic drivers, keyed by (TrafficSpec, donate)
+        self._traffic_progs = {}
 
         nbr_mask = nbrs >= 0
         deg = nbr_mask.sum(axis=1).astype(np.uint32)
@@ -2038,6 +2041,272 @@ class BroadcastSim:
 
     # -- drivers -----------------------------------------------------------
 
+    # -- open-loop traffic (PR 7) -----------------------------------------
+
+    def _traffic_validate(self, tspec) -> None:
+        if tspec.n_nodes != self.n_nodes:
+            raise ValueError(
+                f"TrafficSpec is for {tspec.n_nodes} nodes, sim has "
+                f"{self.n_nodes}")
+        if self._srv_on:
+            raise ValueError(
+                "traffic drivers keep no server ledger (open-loop "
+                "ops have no reference srv accounting): build the "
+                "sim with srv_ledger=False")
+        if (self.delays is not None or self._delayed is not None
+                or self._edge is not None or self._nem_delayed):
+            raise ValueError(
+                "traffic drivers run the 1-hop gather and words-major "
+                "paths; delay-ring modes are not wired")
+        need = tspec.n_clients * tspec.ops_per_client
+        if need > self.n_values:
+            raise ValueError(
+                f"value universe too small: n_values={self.n_values} "
+                f"< n_clients*ops_per_client={need} (every op is its "
+                "own value bit)")
+        if self.mesh is not None:
+            if "words" in self.mesh.axis_names:
+                raise ValueError(
+                    "traffic drivers run on 1-D node meshes")
+            if tspec.n_clients % int(self.mesh.shape["nodes"]) != 0:
+                raise ValueError(
+                    f"n_clients={tspec.n_clients} must shard evenly "
+                    "over the node axis")
+
+    def _traffic_inject(self, state: BroadcastState, ts, tspec, tplan,
+                        plan, coll):
+        """Fold this round's arrivals into the node rows: op (client,
+        k) is value bit ``client * ops_per_client + k``, set at the
+        client's home node in ``received`` AND ``frontier`` so the
+        next exchange floods it (a mid-run client ``broadcast``).  All
+        scatters are shard-local (the client blocks align with the
+        node blocks); deferral classes — home node down, per-node
+        ``intake`` cap, op slots exhausted — are counted by
+        ``traffic.issue``, never dropped."""
+        wm = self.words_major
+        rows = (state.received.shape[1] if wm
+                else state.received.shape[0])
+        bc = rows * tspec.n_clients // self.n_nodes
+        p = coll.row_ids[0] // jnp.int32(rows)
+        ids = p * jnp.int32(bc) + jnp.arange(bc, dtype=jnp.int32)
+        arr = traffic.arrive(tplan, state.t, ids)
+        node_loc = traffic.local_node_cols(tspec, bc)
+        accept = (faults.node_up(plan, state.t,
+                                 coll.row_ids[0] + node_loc)
+                  if plan is not None else jnp.ones(arr.shape, bool))
+        if tspec.intake is not None:
+            accept = accept & (
+                traffic.intake_rank(arr, tspec.clients_per_node)
+                < tspec.intake)
+        ts, ok, kslot = traffic.issue(ts, arr, accept, state.t,
+                                      coll.reduce_sum)
+        v = ids * jnp.int32(tspec.ops_per_client) + kslot
+        w = jnp.where(ok, v // 32, jnp.int32(self.n_words))
+        bit = jnp.where(ok, jnp.uint32(1)
+                        << (v % 32).astype(jnp.uint32), jnp.uint32(0))
+        if wm:
+            received = state.received.at[w, node_loc].add(
+                bit, mode="drop")
+            frontier = state.frontier.at[w, node_loc].add(
+                bit, mode="drop")
+        else:
+            received = state.received.at[node_loc, w].add(
+                bit, mode="drop")
+            frontier = state.frontier.at[node_loc, w].add(
+                bit, mode="drop")
+        return state._replace(received=received,
+                              frontier=frontier), ts
+
+    def _traffic_done(self, s2: BroadcastState, ts, tspec, coll, ub):
+        """Per-op visibility: the op's value bit present at EVERY
+        node — an AND-fold over the local node axis combined by the
+        engine's ppermute-only ``reduce_and`` (no all-gather), read
+        back per op slot from the replicated (W,) all-nodes words."""
+        wm = self.words_major
+        rows = s2.received.shape[1] if wm else s2.received.shape[0]
+        local_and = lax.reduce(s2.received, jnp.uint32(0xFFFFFFFF),
+                               lax.bitwise_and, (1,) if wm else (0,))
+        all_words = coll.reduce_and(local_and)
+        bc = rows * tspec.n_clients // self.n_nodes
+        c0 = (coll.row_ids[0] // jnp.int32(rows)) * jnp.int32(bc)
+        n_k = tspec.ops_per_client
+
+        def bit_fn(lo, block):
+            idv = c0 + lo + jnp.arange(block, dtype=jnp.int32)
+            v = (idv[:, None] * jnp.int32(n_k)
+                 + jnp.arange(n_k, dtype=jnp.int32)[None, :])
+            return ((all_words[v // 32]
+                     >> (v % 32).astype(jnp.uint32))
+                    & jnp.uint32(1)) > 0
+
+        return traffic.done_scan(ts, bit_fn, s2.t, coll.reduce_sum,
+                                 ub)
+
+    def _build_traffic(self, tspec, donate: bool):
+        self._traffic_validate(tspec)
+        mesh = self.mesh
+        n_sh = 1 if mesh is None else int(mesh.shape["nodes"])
+        ub = traffic.traffic_block(tspec.n_clients // n_sh)
+        dn = donate_argnums_for(donate, 0, 1)
+        wm = self.words_major
+        has_nem = self._nem is not None
+
+        if mesh is None:
+            if wm:
+                extra = self._wm_extra_args()
+
+                def run_wm(state, ts, n, tplan, deg, *masks):
+                    coll = collectives(self.n_nodes)
+                    plan = masks[3] if has_nem else None
+
+                    def body(carry, op):
+                        s, t_ = self._traffic_inject(
+                            carry[0], carry[1], tspec, op, plan, coll)
+                        s2 = self._wm_round_single(s, deg,
+                                                   masks or None)
+                        return (s2, self._traffic_done(
+                            s2, t_, tspec, coll, ub))
+
+                    return fori_rounds(body, (state, ts), n,
+                                       operand=tplan)
+
+                prog = jit_program(run_wm, donate_argnums=dn)
+
+                def args_fn(state, ts, n, tplan):
+                    return (state, ts, n, tplan, self.deg) + extra
+            else:
+                fp_args = self._fp_mesh_extra()[1]
+
+                def run_g(state, ts, n, tplan, nbrs, nbr_mask, *fp):
+                    coll = collectives(self.n_nodes)
+                    plan = fp[0] if fp else None
+
+                    def body(carry, op):
+                        s, t_ = self._traffic_inject(
+                            carry[0], carry[1], tspec, op, plan, coll)
+                        s2 = flood_step(
+                            s, nbrs=nbrs, nbr_mask=nbr_mask,
+                            parts=self.parts,
+                            sync_every=self.sync_every, plan=plan,
+                            dup_on=self._fp_dup, union_block=self._ub)
+                        return (s2, self._traffic_done(
+                            s2, t_, tspec, coll, ub))
+
+                    return fori_rounds(body, (state, ts), n,
+                                       operand=tplan)
+
+                prog = jit_program(run_g, donate_argnums=dn)
+
+                def args_fn(state, ts, n, tplan):
+                    return (state, ts, n, tplan, self.nbrs,
+                            self.nbr_mask) + fp_args
+
+            runner = lambda state, ts, n, tplan: prog(
+                *args_fn(state, ts, n, tplan))
+            return prog, args_fn, runner
+
+        state_spec, node_spec, part_spec = self._specs()
+        t_specs = traffic.state_specs(True)
+
+        if wm:
+            extra_specs, extra_args = self._wm_mesh_extra()
+
+            def run_wm(state, ts, n, tplan, deg, *masks):
+                coll = collectives(state.received.shape[1], mesh)
+                plan = masks[3] if has_nem else None
+
+                def body(carry, op):
+                    s, t_ = self._traffic_inject(
+                        carry[0], carry[1], tspec, op, plan, coll)
+                    s2 = self._sharded_round_wm(s, deg, masks or None)
+                    return (s2, self._traffic_done(
+                        s2, t_, tspec, coll, ub))
+
+                return fori_rounds(body, (state, ts), n,
+                                   operand=tplan)
+
+            prog = jit_program(
+                run_wm, mesh=mesh,
+                in_specs=(state_spec, t_specs, P(),
+                          traffic.plan_specs(), P("nodes"))
+                + extra_specs,
+                out_specs=(state_spec, t_specs),
+                check_vma=False, donate_argnums=dn)
+
+            def args_fn(state, ts, n, tplan):
+                return (state, ts, n, tplan, self.deg) + extra_args
+        else:
+            fp_specs, fp_args = self._fp_mesh_extra()
+
+            def run_g(state, ts, n, tplan, nbrs, nbr_mask, parts,
+                      *fp):
+                coll = collectives(nbrs.shape[0], mesh)
+                plan = fp[0] if fp else None
+
+                def body(carry, op):
+                    s, t_ = self._traffic_inject(
+                        carry[0], carry[1], tspec, op, plan, coll)
+                    s2 = self._sharded_round(s, nbrs, nbr_mask, parts,
+                                             None, plan)
+                    return (s2, self._traffic_done(
+                        s2, t_, tspec, coll, ub))
+
+                return fori_rounds(body, (state, ts), n,
+                                   operand=tplan)
+
+            prog = jit_program(
+                run_g, mesh=mesh,
+                in_specs=(state_spec, t_specs, P(),
+                          traffic.plan_specs(), node_spec, node_spec,
+                          part_spec) + fp_specs,
+                out_specs=(state_spec, t_specs),
+                check_vma=False, donate_argnums=dn)
+
+            def args_fn(state, ts, n, tplan):
+                return (state, ts, n, tplan, self.nbrs, self.nbr_mask,
+                        self.parts) + fp_args
+
+        runner = lambda state, ts, n, tplan: prog(
+            *args_fn(state, ts, n, tplan))
+        return prog, args_fn, runner
+
+    def traffic_state(self, tspec) -> "traffic.TrafficState":
+        return traffic.init_state(tspec, self.mesh)
+
+    def run_traffic(self, state: BroadcastState, ts, tspec,
+                    n_rounds: int, *, donate: bool = False):
+        """Open-loop serving driver: ``n_rounds`` rounds as ONE device
+        program, each round injecting the spec's seeded client
+        arrivals (new values at their home nodes) before the flood/
+        anti-entropy round and advancing the per-op latency tracker
+        after it (tpu_sim/traffic.py).  The compiled TrafficPlan rides
+        as a traced operand next to the FaultPlan — fault campaigns
+        and serving load compose in one fused program, donation
+        preserved (``donate`` consumes BOTH the sim state and the
+        tracker).  Programs cache by ``TrafficSpec.program_key``, so a
+        load sweep reuses one compiled program across rates."""
+        key = (tspec.program_key, donate)
+        if key not in self._traffic_progs:
+            self._traffic_progs[key] = self._build_traffic(tspec,
+                                                           donate)
+        return self._traffic_progs[key][2](state, ts,
+                                           jnp.int32(n_rounds),
+                                           tspec.compile())
+
+    def audit_traffic_program(self, tspec, *, donate: bool = True):
+        """(jitted, example_args) of the traffic driver — the handle
+        the contract auditor lowers (census + donation of the EXACT
+        program :meth:`run_traffic` executes)."""
+        key = (tspec.program_key, donate)
+        if key not in self._traffic_progs:
+            self._traffic_progs[key] = self._build_traffic(tspec,
+                                                           donate)
+        prog, args_fn, _ = self._traffic_progs[key]
+        state = self.init_state(
+            np.zeros((self.n_nodes, self.n_words), np.uint32))
+        return prog, args_fn(state, self.traffic_state(tspec),
+                             jnp.int32(4), tspec.compile())
+
     def converged(self, state: BroadcastState,
                   target: jnp.ndarray) -> bool:
         t = target[:, None] if self.words_major else target[None, :]
@@ -2240,6 +2509,7 @@ def audit_contracts():
     from ..parallel.topology import to_padded_neighbors, tree
     from .audit import AuditProgram, ProgramContract
     from .engine import analytic_peak_bytes
+    from .engine import operand_bytes as engine_operand_bytes
     from .structured import make_exchange, make_sharded_exchange
 
     n, nv = 64, 64
@@ -2290,6 +2560,41 @@ def audit_contracts():
             mesh=mesh, exchange=make_exchange("tree", n, branching=4),
             fault_plan=spec.compile(), nemesis=nem)
         return AuditProgram(*_built(sim))
+
+    def traffic_wm_run(mesh):
+        # a shape big enough that state dominates the per-round temps,
+        # so the memory band audits the donated-footprint claim rather
+        # than XLA's toy-shape buffer alignment
+        nt, cl, k = 1024, 256, 8
+        nv = cl * k
+        tspec = traffic.TrafficSpec(
+            n_nodes=nt, n_clients=cl, ops_per_client=k, until=8,
+            rate=0.5, seed=11)
+        sharded = (make_sharded_exchange("tree", nt, 8, branching=4)
+                   if mesh is not None else None)
+        sim = BroadcastSim(
+            to_padded_neighbors(tree(nt, branching=4)), n_values=nv,
+            sync_every=4, srv_ledger=False, mesh=mesh,
+            exchange=make_exchange("tree", nt, branching=4),
+            sharded_exchange=sharded)
+        prog, args = sim.audit_traffic_program(tspec, donate=True)
+        # the compiled header carries PER-SHARD parameter shapes, so
+        # the declared donated bytes are the local blocks
+        n_sh = 1 if mesh is None else 8
+        w = nv // 32
+        state_bytes = (2 * nt * w * 4            # received + frontier
+                       + cl * 4 + 3 * cl * k * 4  # tracker leaves
+                       ) // n_sh
+        # claim: donated state + the traffic plan operand + one
+        # transient payload copy per round (the exchange/visibility
+        # temps); the band absorbs scheduling slack
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes,
+            operand_bytes=engine_operand_bytes(tspec.compile()),
+            slab_bytes=nt * w * 4 // n_sh)
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
 
     def flood_donated(mesh):
         del mesh
@@ -2347,6 +2652,18 @@ def audit_contracts():
                   "nemesis (crash+loss+dup, structured.make_nemesis): "
                   "the node-sharded mask decomposition adds ZERO "
                   "gathers — the PR 3 structured-path contract"),
+        ProgramContract(
+            name="broadcast/sharded-traffic-run-halo-wm",
+            build=traffic_wm_run,
+            collectives={"all-reduce": None,
+                         "collective-permute": None},
+            donation=True,
+            mem_lo=0.2, mem_hi=6.0,
+            notes="open-loop traffic driver on the halo words-major "
+                  "path (PR 7): shard-local injection + the ppermute "
+                  "reduce_and visibility fold add ZERO gathers, and "
+                  "the (state, tracker) pytrees alias in place — the "
+                  "injected-traffic census + donation contract"),
         ProgramContract(
             name="broadcast/fused-donated-flood",
             build=flood_donated,

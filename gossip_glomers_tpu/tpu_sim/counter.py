@@ -42,7 +42,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import faults
+from . import faults, traffic
 from .engine import (Collectives, collectives, donate_argnums_for,
                      fori_rounds, jit_program, resolve_block,
                      scan_blocks)
@@ -177,6 +177,8 @@ class CounterSim:
         # raw jitted run-program handles by donate flag — the contract
         # auditor (tpu_sim/audit.py) lowers these directly
         self._run_progs: dict = {}
+        # open-loop traffic drivers, keyed by (TrafficSpec, donate)
+        self._traffic_progs: dict = {}
         self._step = self._build_step()
         self._run_n = self._build_run_n(donate=False)
         # the donated twin: same traced rounds, state buffers consumed
@@ -446,6 +448,173 @@ class CounterSim:
         must not be used again afterwards."""
         return self._run_n_donated(state, jnp.int32(n_rounds))
 
+    # -- open-loop traffic (PR 7) -----------------------------------------
+
+    def _traffic_round(self, state: CounterState, ts, tspec, tplan,
+                       sched: KVReach, coll: Collectives, plan, ub):
+        """One traffic-injected round (traced): classify this round's
+        arrivals (home node down → deferred; per-node ``intake`` cap →
+        deferred; op slots exhausted → deferred), fold the accepted
+        adds into ``pending`` (each op adds delta 1 — ack before
+        durability, add.go:33-41), run the ordinary round, then
+        advance the per-op tracker:
+
+        - a node whose whole pending drained this round (the cas
+          winner / an allreduce flush) FLUSHES its clients' open ops —
+          each records ``op_aux = kv_after`` (the KV value its delta
+          is folded into).  An AMNESIA wipe is not a flush: the wipe
+          round itself is excluded by the liveness gate, and the
+          wiped ops are marked ``op_aux = -2`` (permanently lost —
+          their deltas died with the process), so a LATER flush at
+          the restarted node can never claim them: they stay in
+          flight forever and surface as lost acked writes;
+        - an op completes when every node's cached read has reached
+          its flush value (``min(cached) >= op_aux`` — the per-op form
+          of the counter convergence predicate "every cache equals the
+          KV"), so completion stalls while any crashed cache is empty
+          and recovers with the poll loop: the serving-curve cliff."""
+        rows = state.pending.shape[0]
+        bc = rows * tspec.n_clients // self.n_nodes
+        p = coll.row_ids[0] // jnp.int32(rows)
+        ids = p * jnp.int32(bc) + jnp.arange(bc, dtype=jnp.int32)
+        arr = traffic.arrive(tplan, state.t, ids)
+        node_loc = traffic.local_node_cols(tspec, bc)
+        node_glob = coll.row_ids[0] + node_loc
+        up_t = (faults.node_up(plan, state.t, coll.row_ids)
+                if plan is not None else jnp.ones((rows,), bool))
+        accept = (faults.node_up(plan, state.t, node_glob)
+                  if plan is not None else jnp.ones(arr.shape, bool))
+        if tspec.intake is not None:
+            accept = accept & (
+                traffic.intake_rank(arr, tspec.clients_per_node)
+                < tspec.intake)
+        ts, ok, _k = traffic.issue(ts, arr, accept, state.t,
+                                   coll.reduce_sum)
+        add = jnp.zeros((rows,), jnp.int32).at[node_loc].add(
+            ok.astype(jnp.int32))
+        state = state._replace(pending=state.pending + add)
+        if plan is not None:
+            # ops whose delta dies in this round's amnesia wipe are
+            # LOST, permanently (op_aux = -2): without the mark, a
+            # post-restart flush at the same node would claim them and
+            # the certifier would miss a lost acked write.  New
+            # arrivals cannot land at a wiping node (down ⇒ deferred).
+            cl_wiped = faults.amnesia(plan, state.t,
+                                      coll.row_ids)[node_loc]
+            ts = ts._replace(op_aux=jnp.where(
+                ((ts.issue_round >= 0) & (ts.op_aux == -1)
+                 & (ts.done_round < 0) & cl_wiped[:, None]),
+                jnp.int32(-2), ts.op_aux))
+        pend0 = state.pending
+        s2 = self._round(state, coll, sched, plan)
+        flushed = (pend0 > 0) & (s2.pending == 0) & up_t
+        cl_fl = flushed[node_loc]
+        open_unflushed = ((ts.issue_round >= 0) & (ts.op_aux == -1)
+                          & (ts.done_round < 0))
+        aux = jnp.where(open_unflushed & cl_fl[:, None], s2.kv,
+                        ts.op_aux)
+        ts = ts._replace(op_aux=aux)
+        min_cached = coll.reduce_min(jnp.min(s2.cached))
+
+        def bit_fn(lo, block):
+            a = lax.dynamic_slice_in_dim(aux, lo, block, axis=0)
+            return (a >= 0) & (min_cached >= a)
+
+        ts = traffic.done_scan(ts, bit_fn, s2.t, coll.reduce_sum, ub)
+        return s2, ts
+
+    def _build_traffic(self, tspec: "traffic.TrafficSpec",
+                       donate: bool):
+        if tspec.n_nodes != self.n_nodes:
+            raise ValueError(
+                f"TrafficSpec is for {tspec.n_nodes} nodes, sim has "
+                f"{self.n_nodes}")
+        mesh = self.mesh
+        n_sh = 1 if mesh is None else int(mesh.shape["nodes"])
+        if tspec.n_clients % n_sh != 0:
+            raise ValueError(
+                f"n_clients={tspec.n_clients} must shard evenly over "
+                f"the {n_sh}-way node axis")
+        ub = traffic.traffic_block(tspec.n_clients // n_sh)
+        dn = donate_argnums_for(donate, 0, 1)
+        fp_specs, fp_args = self._fp_extra()
+
+        if mesh is None:
+            def run(state, ts, n, tplan, sched, *fp):
+                coll = collectives(self.n_nodes)
+                plan = fp[0] if fp else None
+                return fori_rounds(
+                    lambda c, op: self._traffic_round(
+                        c[0], c[1], tspec, op, sched, coll, plan, ub),
+                    (state, ts), n, operand=tplan)
+
+            prog = jit_program(run, donate_argnums=dn)
+        else:
+            sched_spec = KVReach(P(), P(), P(None, None))
+            t_specs = traffic.state_specs(True)
+
+            def run(state, ts, n, tplan, sched, *fp):
+                coll = collectives(state.pending.shape[0], mesh)
+                plan = fp[0] if fp else None
+                return fori_rounds(
+                    lambda c, op: self._traffic_round(
+                        c[0], c[1], tspec, op, sched, coll, plan, ub),
+                    (state, ts), n, operand=tplan)
+
+            prog = jit_program(
+                run, mesh=mesh,
+                in_specs=(self._state_spec(), t_specs, P(),
+                          traffic.plan_specs(), sched_spec) + fp_specs,
+                out_specs=(self._state_spec(), t_specs),
+                check_vma=False, donate_argnums=dn)
+
+        def args_fn(state, ts, n, tplan):
+            return (state, ts, n, tplan, self.kv_sched) + fp_args
+
+        runner = lambda state, ts, n, tplan: prog(
+            *args_fn(state, ts, n, tplan))
+        return prog, args_fn, runner
+
+    def traffic_state(self, tspec) -> traffic.TrafficState:
+        return traffic.init_state(tspec, self.mesh)
+
+    def run_traffic(self, state: CounterState,
+                    ts: traffic.TrafficState, tspec, n_rounds: int, *,
+                    donate: bool = False):
+        """Open-loop serving driver: ``n_rounds`` rounds as ONE device
+        program, each round injecting the spec's seeded arrivals
+        before the ordinary flush/poll round and advancing the per-op
+        latency tracker after it (tpu_sim/traffic.py).  Arrivals ride
+        the compiled :class:`~.traffic.TrafficPlan` as a traced
+        operand next to the FaultPlan, so fault campaigns and serving
+        load compose in one fused program.  With ``donate`` both the
+        sim state and the tracker are consumed (updated in place).
+
+        Programs are cached by the spec's STATIC shape
+        (``TrafficSpec.program_key``): a serving-curve load sweep
+        reuses one compiled program across its rates — the plan rides
+        as a traced operand."""
+        key = (tspec.program_key, donate)
+        if key not in self._traffic_progs:
+            self._traffic_progs[key] = self._build_traffic(tspec,
+                                                           donate)
+        return self._traffic_progs[key][2](state, ts,
+                                           jnp.int32(n_rounds),
+                                           tspec.compile())
+
+    def audit_traffic_program(self, tspec, *, donate: bool = True):
+        """(jitted, example_args) of the traffic driver — the handle
+        the contract auditor lowers (census + donation of the EXACT
+        program :meth:`run_traffic` executes)."""
+        key = (tspec.program_key, donate)
+        if key not in self._traffic_progs:
+            self._traffic_progs[key] = self._build_traffic(tspec,
+                                                           donate)
+        prog, args_fn, _ = self._traffic_progs[key]
+        return prog, args_fn(self.init_state(),
+                             self.traffic_state(tspec), jnp.int32(4),
+                             tspec.compile())
+
     # -- reads -------------------------------------------------------------
 
     def reads(self, state: CounterState) -> np.ndarray:
@@ -475,6 +644,7 @@ def audit_contracts():
     donation + memory contract."""
     from .audit import AuditProgram, ProgramContract
     from .engine import analytic_peak_bytes
+    from .engine import operand_bytes as engine_operand_bytes
 
     def wide_step(mesh):
         sim = CounterSim(32, mode="cas", poll_every=2,
@@ -489,6 +659,32 @@ def audit_contracts():
                            in_specs=(sim._state_spec(), sched_spec),
                            out_specs=sim._state_spec())
         return AuditProgram(prog, (sim.init_state(), sim.kv_sched))
+
+    def traffic_run(mesh):
+        # big enough that state dominates the per-round temps (the
+        # memory band then audits the donated-footprint claim, not
+        # XLA's toy-shape buffer alignment)
+        n, k = 1024, 8
+        tspec = traffic.TrafficSpec(
+            n_nodes=n, n_clients=n, ops_per_client=k, until=8,
+            rate=0.5, seed=11)
+        spec = faults.NemesisSpec(n_nodes=n, seed=5,
+                                  crash=((2, 4, (1,)),),
+                                  loss_rate=0.1, loss_until=6)
+        sim = CounterSim(n, mode="cas", poll_every=2, mesh=mesh,
+                         fault_plan=spec.compile())
+        prog, args = sim.audit_traffic_program(tspec)
+        # per-shard parameter shapes in the compiled header
+        n_sh = 1 if mesh is None else 8
+        state_bytes = (2 * n * 4 + n * 4 + 3 * n * k * 4) // n_sh
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes,
+            operand_bytes=engine_operand_bytes(
+                (tspec.compile(), sim.fault_plan)),
+            slab_bytes=n * k * 4 // n_sh)   # tracker-scan temps
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
 
     def fused_donated(mesh):
         del mesh
@@ -510,6 +706,17 @@ def audit_contracts():
             notes="wide two-pmin winner: psum/pmin collectives only — "
                   "NO all-gather, no ppermute needed (the PR 4 "
                   "counter gate)"),
+        ProgramContract(
+            name="counter/sharded-traffic-run",
+            build=traffic_run,
+            collectives={"all-reduce": None},
+            donation=True,
+            mem_lo=0.2, mem_hi=6.0,
+            notes="open-loop traffic driver under crash+loss (PR 7): "
+                  "shard-local injection, flush tracking, and the "
+                  "pmin cache-visibility fold stay all-reduce-only — "
+                  "no gather, no ppermute; (state, tracker) alias in "
+                  "place"),
         ProgramContract(
             name="counter/fused-donated",
             build=fused_donated,

@@ -184,6 +184,10 @@ class Collectives(NamedTuple):
       of the per-shard partial: O(log shards) / O(shards) block moves
       over ICI, collective-permute only — never an all_gather of the
       operands being reduced.
+    - ``reduce_and``: bitwise-AND all-reduce over the node axis — the
+      complement twin (``~reduce_or(~x)``), same ppermute-only
+      exchange.  The traffic trackers' "bit present at EVERY node"
+      visibility predicate (PR 7) rides this.
     - ``exclusive_sum``: per-element sum of the operand over all LOWER
       shard indices (zeros on shard 0; identity off-mesh returns
       zeros) — the cross-shard exclusive prefix a global rank/offset
@@ -199,6 +203,7 @@ class Collectives(NamedTuple):
     reduce_max: Callable[[jnp.ndarray], jnp.ndarray]
     reduce_min: Callable[[jnp.ndarray], jnp.ndarray]
     reduce_or: Callable[[jnp.ndarray], jnp.ndarray]
+    reduce_and: Callable[[jnp.ndarray], jnp.ndarray]
     exclusive_sum: Callable[[jnp.ndarray], jnp.ndarray]
     local_cols: Callable[[jnp.ndarray], jnp.ndarray]
     axis_name: str | None
@@ -214,7 +219,7 @@ def collectives(block: int, mesh=None, *, axis: str = "nodes",
         return Collectives(
             row_ids=jnp.arange(block, dtype=jnp.int32),
             widen=ident, reduce_sum=ident, reduce_max=ident,
-            reduce_min=ident, reduce_or=ident,
+            reduce_min=ident, reduce_or=ident, reduce_and=ident,
             exclusive_sum=jnp.zeros_like,
             local_cols=ident, axis_name=None)
     axes = tuple(mesh.axis_names)
@@ -259,6 +264,7 @@ def collectives(block: int, mesh=None, *, axis: str = "nodes",
         reduce_max=lambda x: lax.pmax(x, axis),
         reduce_min=lambda x: lax.pmin(x, axis),
         reduce_or=reduce_or,
+        reduce_and=lambda x: ~reduce_or(~x),
         exclusive_sum=exclusive_sum,
         local_cols=lambda m: lax.dynamic_slice_in_dim(
             m, lax.axis_index(axis) * block, block, axis=1),

@@ -88,11 +88,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import faults
+from . import faults, traffic
 from .counter import KVReach, _reach
 from .engine import (analytic_peak_bytes, collectives,
-                     donate_argnums_for, jit_program, operand_bytes,
-                     resolve_block, scan_blocks, scan_rounds)
+                     donate_argnums_for, fori_rounds, jit_program,
+                     operand_bytes, resolve_block, scan_blocks,
+                     scan_rounds)
 
 
 class KafkaState(NamedTuple):
@@ -124,6 +125,41 @@ def _rank_within_key(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
         jnp.maximum, jnp.where(is_start, pos, 0))
     rank_sorted = pos - run_start
     return jnp.zeros((m,), jnp.int32).at[order].set(rank_sorted)
+
+
+def _alloc(kv_val, send_key, reach, up_rows, exclusive_sum, k_dim: int,
+           cap: int):
+    """The round's offset allocator (globally linearized in (node,
+    slot) order — the reference's lin-kv CAS loop, logmap.go:255-285),
+    extracted so the open-loop traffic tracker (PR 7) can mirror the
+    EXACT allocation the round performs: both evaluate this same pure
+    function of (kv_val, batch, gates), so the tracker's acked-op set
+    can never drift from the round's.
+
+    Returns ``(tried, valid, keys_c, rank, slot, ok)`` over the
+    flattened (rows*S,) batch: ``tried`` = a real op at an up node,
+    ``valid`` = tried and the KV was reachable, ``ok`` = valid and the
+    allocated slot fits capacity (the acked sends)."""
+    current = jnp.where(kv_val > 0, kv_val, 1)          # (K,)
+    s_dim = send_key.shape[1]
+    loc_key = send_key.reshape(-1)                      # (rows*S,)
+    tried = loc_key >= 0
+    if up_rows is not None:
+        # a down node submits nothing: its batch rows are dead ops,
+        # not charged-and-timed-out ones
+        tried = tried & jnp.repeat(up_rows, s_dim)
+    # a KV-blocked send never allocates: the read times out and the
+    # node aborts after one attempt (models/kafka.py alloc_offset)
+    valid = tried & jnp.repeat(reach, s_dim)
+    keys_c = jnp.clip(loc_key, 0, k_dim - 1)
+    cnt_valid = jnp.zeros((k_dim,), jnp.int32).at[keys_c].add(
+        valid.astype(jnp.int32))
+    rank = (_rank_within_key(keys_c, valid)
+            + exclusive_sum(cnt_valid)[keys_c])
+    offset = current[keys_c] + rank                     # (rows*S,)
+    slot = offset - 1
+    ok = valid & (slot < cap)
+    return tried, valid, keys_c, rank, slot, ok
 
 
 class KafkaSim:
@@ -264,6 +300,7 @@ class KafkaSim:
             per_row_bytes=n_nodes * max_sends * 4)
         self._run_rounds = {}
         self._step_progs = {}
+        self._traffic_progs = {}
         self._poll_batch_fn = None
         self._alloc_fn = None
 
@@ -363,26 +400,13 @@ class KafkaSim:
         #    shard-locally: global rank = local rank within the shard
         #    + exclusive prefix (over lower shards) of per-key valid
         #    counts — a ppermute scan of a (K,) vector, so the send
-        #    batch is never all_gather-ed.
+        #    batch is never all_gather-ed.  (:func:`_alloc` — shared
+        #    with the traffic tracker's mirror, PR 7.)
         current = jnp.where(state.kv_val > 0, state.kv_val, 1)  # (K,)
-        loc_key = send_key.reshape(-1)                   # (rows*S,)
         loc_val = send_val.reshape(-1)
-        tried = loc_key >= 0
-        if up_rows is not None:
-            # a down node submits nothing: its batch rows are dead ops,
-            # not charged-and-timed-out ones
-            tried = tried & jnp.repeat(up_rows, s_dim)
-        # a KV-blocked send never allocates: the read times out and the
-        # node aborts after one attempt (models/kafka.py alloc_offset)
-        valid = tried & jnp.repeat(reach, s_dim)
-        keys_c = jnp.clip(loc_key, 0, k_dim - 1)
-        cnt_valid = jnp.zeros((k_dim,), jnp.int32).at[keys_c].add(
-            valid.astype(jnp.int32))
-        rank = (_rank_within_key(keys_c, valid)
-                + exclusive_sum(cnt_valid)[keys_c])
-        offset = current[keys_c] + rank                  # (rows*S,)
-        slot = offset - 1
-        ok = valid & (slot < cap)
+        tried, valid, keys_c, rank, slot, ok = _alloc(
+            state.kv_val, send_key, reach, up_rows, exclusive_sum,
+            k_dim, cap)
 
         # -- append: content is global (offsets unique ⇒ no conflicts
         #    across shards), so the replicated log_vals update is a
@@ -945,6 +969,198 @@ class KafkaSim:
             args.append(self.fault_plan)
         return self._step_prog(repl_mode)(state, *args)
 
+    # -- open-loop traffic (PR 7) -----------------------------------------
+
+    def _traffic_round(self, state: KafkaState, ts, tspec, tplan,
+                       sched: KVReach, coll, plan, repl_mode: str,
+                       ub: int):
+        """One traffic-injected round (traced): stage this round's
+        arrivals as a shard-local send batch (op (client, k) sends a
+        seeded key with its op id as the value — globally unique, like
+        the staged campaigns), mirror the round's allocator
+        (:func:`_alloc` — the same pure function the round evaluates)
+        to learn which sends ACK, run the ordinary round, then advance
+        the tracker.  Deferral classes, all loud: home node down; node
+        intake saturated (more arrivals than ``max_sends`` batch slots
+        — or the spec's tighter ``intake``); op slots exhausted; and
+        the allocation itself failing (KV unreachable this round, or
+        key capacity overflow) — the client got an error reply, so the
+        op was never acked.  An op completes when its (key, slot)
+        presence bit is set at EVERY node (the per-op form of the
+        kafka convergence predicate), so crash windows stall
+        completions until the resync repairs presence: the serving
+        cliff."""
+        rows = coll.row_ids.shape[0]
+        bc = rows * tspec.n_clients // self.n_nodes
+        p = coll.row_ids[0] // jnp.int32(rows)
+        ids = p * jnp.int32(bc) + jnp.arange(bc, dtype=jnp.int32)
+        arr = traffic.arrive(tplan, state.t, ids)
+        node_loc = traffic.local_node_cols(tspec, bc)
+        up_cl = (faults.node_up(plan, state.t,
+                                coll.row_ids[0] + node_loc)
+                 if plan is not None else jnp.ones(arr.shape, bool))
+        s_dim = self.max_sends
+        cap_in = s_dim if tspec.intake is None \
+            else min(tspec.intake, s_dim)
+        rank = traffic.intake_rank(arr, tspec.clients_per_node)
+        cand = (arr & up_cl & (rank < cap_in)
+                & (ts.issued_k < tspec.ops_per_client))
+        kslot_pre = ts.issued_k
+        v = ids * jnp.int32(tspec.ops_per_client) + kslot_pre
+        kx = faults._mix32(
+            ids.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+            ^ kslot_pre.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+            ^ tplan.seed ^ jnp.uint32(traffic.SALT_KEY))
+        key_ck = (kx % jnp.uint32(self.n_keys)).astype(jnp.int32)
+        slot_idx = jnp.where(cand, rank, jnp.int32(s_dim))
+        send_key = jnp.full((rows, s_dim), -1, jnp.int32).at[
+            node_loc, slot_idx].set(key_ck, mode="drop")
+        send_val = jnp.zeros((rows, s_dim), jnp.int32).at[
+            node_loc, slot_idx].set(v, mode="drop")
+        # allocator mirror — bit-identical to the round's own
+        # evaluation (same pure function, same operands)
+        reach = _reach(state.t, coll.row_ids, sched)
+        up_rows = None
+        if plan is not None:
+            up_rows = faults.node_up(plan, state.t, coll.row_ids)
+            reach = reach & up_rows & ~faults.kv_drop(plan, state.t,
+                                                      coll.row_ids)
+        _t, _vd, _kc, _rk, slot, ok_flat = _alloc(
+            state.kv_val, send_key, reach, up_rows,
+            coll.exclusive_sum, self.n_keys, self.capacity)
+        fi = jnp.where(cand, node_loc * jnp.int32(s_dim) + rank, 0)
+        alloc_ok = cand & ok_flat[fi]
+        op_slot = slot[fi]
+        ts, ok, kslot = traffic.issue(
+            ts, arr, up_cl & (rank < cap_in) & alloc_ok, state.t,
+            coll.reduce_sum)
+        ts = traffic.record_aux(ts, ok, kslot, op_slot)
+        # commits ride as a traced all--1 constant: `want = req >= 1`
+        # folds to False and XLA dead-codes the commit pipeline (the
+        # run_rounds commit-free pattern)
+        commit_req = jnp.full((rows, self.n_keys), -1, jnp.int32)
+        s2 = self._round(state, send_key, send_val, commit_req, None,
+                         sched, coll, repl_mode=repl_mode, plan=plan)
+        # visibility: the (key, slot) bit at EVERY node — AND over the
+        # local presence rows, combined by the ppermute-only
+        # reduce_and (no all-gather), read per op slot
+        local_and = lax.reduce(s2.present, jnp.uint32(0xFFFFFFFF),
+                               lax.bitwise_and, (0,))
+        all_pres = coll.reduce_and(local_and)          # (K, Wc)
+        aux = ts.op_aux
+        n_k = tspec.ops_per_client
+
+        def bit_fn(lo, block):
+            idv = (p * jnp.int32(bc) + lo
+                   + jnp.arange(block, dtype=jnp.int32))
+            kk = jnp.arange(n_k, dtype=jnp.int32)
+            kx2 = faults._mix32(
+                idv[:, None].astype(jnp.uint32)
+                * jnp.uint32(0xC2B2AE35)
+                ^ kk[None, :].astype(jnp.uint32)
+                * jnp.uint32(0x9E3779B9)
+                ^ tplan.seed ^ jnp.uint32(traffic.SALT_KEY))
+            keys2 = (kx2 % jnp.uint32(self.n_keys)).astype(jnp.int32)
+            a = lax.dynamic_slice_in_dim(aux, lo, block, axis=0)
+            sl = jnp.maximum(a, 0)
+            bit = ((all_pres[keys2, sl // 32]
+                    >> (sl % 32).astype(jnp.uint32)) & jnp.uint32(1))
+            return (a >= 0) & (bit > 0)
+
+        ts = traffic.done_scan(ts, bit_fn, s2.t, coll.reduce_sum, ub)
+        return s2, ts
+
+    def _build_traffic(self, tspec, donate: bool):
+        if tspec.n_nodes != self.n_nodes:
+            raise ValueError(
+                f"TrafficSpec is for {tspec.n_nodes} nodes, sim has "
+                f"{self.n_nodes}")
+        repl_mode = self._repl_mode(None)
+        if repl_mode == "matmul":
+            raise ValueError(
+                "traffic drivers ride the origin-union replication "
+                "paths; repl_fast=False pins the matmul oracle — "
+                "compare blocked vs materialized via union_block "
+                "instead")
+        mesh = self.mesh
+        n_sh = 1 if mesh is None else int(mesh.shape["nodes"])
+        if tspec.n_clients % n_sh != 0:
+            raise ValueError(
+                f"n_clients={tspec.n_clients} must shard evenly over "
+                f"the {n_sh}-way node axis")
+        ub = traffic.traffic_block(tspec.n_clients // n_sh)
+        dn = donate_argnums_for(donate, 0, 1)
+        fp = self._fp_active
+
+        def run(state, ts, n, tplan, sched, *rest):
+            plan = rest[0] if fp else None
+            coll = collectives(
+                state.present.shape[0],
+                mesh)
+            return fori_rounds(
+                lambda c, op: self._traffic_round(
+                    c[0], c[1], tspec, op, sched, coll, plan,
+                    repl_mode, ub),
+                (state, ts), n, operand=tplan)
+
+        if mesh is None:
+            prog = jit_program(run, donate_argnums=dn)
+        else:
+            t_specs = traffic.state_specs(True)
+            state_spec = self._state_spec()
+            in_specs = ((state_spec, t_specs, P(),
+                         traffic.plan_specs(),
+                         KVReach(P(), P(), P(None, None)))
+                        + ((faults.plan_specs(),) if fp else ()))
+            prog = jit_program(run, mesh=mesh, in_specs=in_specs,
+                               out_specs=(state_spec, t_specs),
+                               check_vma=False, donate_argnums=dn)
+
+        fp_args = (self.fault_plan,) if fp else ()
+
+        def args_fn(state, ts, n, tplan):
+            return (state, ts, n, tplan, self.kv_sched) + fp_args
+
+        runner = lambda state, ts, n, tplan: prog(
+            *args_fn(state, ts, n, tplan))
+        return prog, args_fn, runner
+
+    def traffic_state(self, tspec) -> traffic.TrafficState:
+        return traffic.init_state(tspec, self.mesh)
+
+    def run_traffic(self, state: KafkaState, ts, tspec,
+                    n_rounds: int, *, donate: bool = False):
+        """Open-loop serving driver: ``n_rounds`` rounds as ONE device
+        program, each round staging the spec's seeded arrivals through
+        the existing send path (allocation, append, fire-and-forget
+        replication) and advancing the per-op latency tracker
+        (tpu_sim/traffic.py).  Composes with a FaultPlan — the
+        (tplan, plan) operands ride the same fused program, blocked
+        streaming union included.  ``donate`` consumes both the sim
+        state and the tracker.  Programs cache by
+        ``TrafficSpec.program_key``, so a load sweep reuses one
+        compiled program across rates."""
+        key = (tspec.program_key, donate)
+        if key not in self._traffic_progs:
+            self._traffic_progs[key] = self._build_traffic(tspec,
+                                                           donate)
+        return self._traffic_progs[key][2](state, ts,
+                                           jnp.int32(n_rounds),
+                                           tspec.compile())
+
+    def audit_traffic_program(self, tspec, *, donate: bool = True):
+        """(jitted, example_args) of the traffic driver — the handle
+        the contract auditor lowers (census + donation of the EXACT
+        program :meth:`run_traffic` executes)."""
+        key = (tspec.program_key, donate)
+        if key not in self._traffic_progs:
+            self._traffic_progs[key] = self._build_traffic(tspec,
+                                                           donate)
+        prog, args_fn, _ = self._traffic_progs[key]
+        return prog, args_fn(self.init_state(),
+                             self.traffic_state(tspec), jnp.int32(4),
+                             tspec.compile())
+
     # -- host-side reads (reference read semantics) ------------------------
 
     def alloc_offsets(self, state_before: KafkaState,
@@ -1121,6 +1337,35 @@ def audit_contracts():
                                         + _step_args(sim)
                                         + [repl, sim.kv_sched]))
 
+    def traffic_run(mesh):
+        # big enough that state dominates the per-round temps (the
+        # memory band then audits the donated-footprint claim)
+        n, keys, cap, k = 256, 64, 64, 4
+        tspec = traffic.TrafficSpec(
+            n_nodes=n, n_clients=n, ops_per_client=k, until=8,
+            rate=0.5, seed=11)
+        sim = KafkaSim(n, keys, capacity=cap, max_sends=2, mesh=mesh,
+                       fault_plan=_audit_spec(n).compile(),
+                       union_block=4)
+        prog, args = sim.audit_traffic_program(tspec)
+        # per-shard parameter shapes in the compiled header
+        n_sh = 1 if mesh is None else 8
+        wc = sim.n_pwords
+        state_bytes = (n * keys * wc * 4          # present
+                       + n * keys * 4              # local_committed
+                       + n * 4 + 3 * n * k * 4    # tracker leaves
+                       ) // n_sh
+        repl = keys * cap * 4 + keys * 4           # log_vals + kv_val
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes + repl,
+            operand_bytes=operand_bytes(
+                (tspec.compile(), sim.fault_plan)),
+            # deliver carry + coin slab + tracker-scan temps
+            slab_bytes=(n // n_sh) * keys * wc * 4 + n * k * 4)
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
     def fused_donated(mesh):
         del mesh                       # single-device memory contract
         n, k, cap, s, b, r = 256, 16, 32, 8, 32, 2
@@ -1168,6 +1413,19 @@ def audit_contracts():
                          "all-gather": 1},
             notes="link-mask matmul oracle: the one own_words widen "
                   "is the oracle's documented full operand"),
+        ProgramContract(
+            name="kafka/sharded-traffic-run-union-nem-blocked",
+            build=traffic_run,
+            collectives={"all-reduce": None, "collective-permute": None},
+            donation=True,
+            mem_lo=0.2, mem_hi=6.0,
+            notes="open-loop traffic driver under crash+loss on the "
+                  "BLOCKED streaming union (PR 7): shard-local send "
+                  "staging, the _alloc mirror's ppermute prefix scan, "
+                  "the metadata ring, and the reduce_and presence-"
+                  "visibility fold add ZERO gathers; (state, tracker) "
+                  "alias in place — the injected-traffic census + "
+                  "donation contract"),
         ProgramContract(
             name="kafka/fused-donated-union-nem-blocked",
             build=fused_donated,

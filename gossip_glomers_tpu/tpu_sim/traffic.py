@@ -1,0 +1,574 @@
+"""Open-loop client traffic for the vectorized backend: seeded arrival
+schedules over a client axis, per-op latency tracking, and loud
+backpressure accounting — Maelstrom's Layer-0 *rate-based workload
+generator* (PAPER.md §1), vectorized.
+
+Everything the repo measured before PR 7 was closed-loop: seed the
+state, iterate rounds to convergence, check.  This module is the other
+half of the harness — concurrent client ops arriving WHILE the system
+runs, so runs report steady-state serving behavior (p50/p99 op latency
+in rounds, sustained ops/round, backpressure) instead of
+rounds-to-convergence.  Three pieces, each following an existing
+design:
+
+- **`TrafficSpec`** (the `NemesisSpec` shape): a host-side seeded,
+  JSON-able spec over a *client axis* — Poisson (Bernoulli-per-round,
+  i.e. geometric inter-arrivals: the round-synchronous Poisson
+  process), constant-rate (a per-client fixed-point phase accumulator),
+  or burst (rate-multiplier windows over the Poisson stream) —
+  compiled to a tiny :class:`TrafficPlan` that rides through the fused
+  drivers as ONE replicated traced operand next to a
+  :class:`~.faults.FaultPlan`.
+- **arrival coins** (the `faults.coin_block` pattern): arrivals are
+  STATELESS hashes of ``(seed, round, client)``, evaluated per round on
+  device — an arbitrary horizon never materializes an (R, clients)
+  tensor, every shard sees the same coins, and a (spec, seed) pair
+  replays bit-exactly across stepwise/fused/donated drivers and any
+  client-slab blocking.
+- **`TrafficState`** (rides the DONATED state pytree, one entry per op
+  slot): each client owns ``ops_per_client`` op slots, so op identity
+  ``(client, k)`` is static and the tracker arrays shard with the node
+  axis (clients map to nodes by a block/stride rule that keeps each
+  client's home node on its own shard — injection is shard-local, like
+  the nemesis masks).  ``issue_round`` is recorded at injection;
+  ``done_round`` at the first round the op's effect is *globally
+  visible* (the workload's convergence predicate applied per op:
+  broadcast — the value bit at every node; counter — every cache ≥ the
+  KV value the op's flush landed in; kafka — the allocated (key, slot)
+  presence bit at every node).  Latency = done − issue, in rounds.
+
+**Backpressure is loud, never silent**: every arrival is classified
+exactly once — *issued* (acked and tracked) or *deferred* (client got
+backpressure: home node down, per-node intake saturated, op-slot
+capacity exhausted, or — kafka — the allocation itself failed).
+Conservation ``arrived == issued + deferred`` and ``issued ==
+completed + in_flight`` holds at every round and is pinned by
+tests/test_traffic.py; an op that can never complete (an acked write
+that died in an amnesia row) stays in flight forever and surfaces as a
+lost acked op in the serving certifier (harness/serving.py), exactly
+like `checkers.check_recovery`'s lost-writes evidence.
+
+The sims' injection hooks and fused ``run_traffic`` drivers live with
+the sims (broadcast/counter/kafka); this module owns the spec, the
+coins, and the tracker so the three share one accounting contract.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import faults
+from .engine import _env_int, scan_blocks, windows_fold
+
+# The module's host/device split, DECLARED (the PR-6 faults.py
+# pattern): the determinism lint (tpu_sim/audit.py) treats exactly
+# TRACED_EVALUATORS as traced scope.  tests/test_traffic.py pins the
+# split TOTAL, so a new module-level function must be added to one of
+# these tuples (or be a class) or the test fails — new traced traffic
+# code can never silently dodge the lint.
+TRACED_EVALUATORS = (
+    "arrive", "_arrival_num", "_client_hash", "local_node_cols",
+    "intake_rank", "issue", "record_aux", "done_scan")
+HOST_SIDE = (
+    "plan_specs", "state_specs", "init_state", "client_nodes",
+    "host_arrivals", "traffic_block", "latency_summary",
+    "per_round_series", "offered_per_round")
+
+# distinct stream salts off the shared (seed, t, id) counter family
+_SALT_ARRIVE = 0x1B873593
+_SALT_PHASE = 0xCC9E2D51
+# kafka per-op key assignment draws from this stream (key is a pure
+# function of (seed, client, slot) — recomputable at completion time)
+SALT_KEY = 0xA2C2A35D
+
+
+class TrafficPlan(NamedTuple):
+    """Compiled device form of a :class:`TrafficSpec` — tiny replicated
+    arrays threaded through drivers as a traced operand (never donated,
+    never a baked-in constant), exactly like a FaultPlan."""
+
+    kind: jnp.ndarray      # () int32 — 0 poisson, 1 constant
+    rate_num: jnp.ndarray  # () uint32 — arrive iff hash < rate_num
+    until: jnp.ndarray     # () int32 — arrivals for rounds [0, until)
+    b_starts: jnp.ndarray  # (B,) int32 — burst window start (incl)
+    b_ends: jnp.ndarray    # (B,) int32 — burst window end (excl)
+    b_num: jnp.ndarray     # (B,) uint32 — in-window rate threshold
+    seed: jnp.ndarray      # () uint32 — the replay key
+
+
+def plan_specs() -> TrafficPlan:
+    """shard_map in_specs for a :class:`TrafficPlan` operand: every
+    leaf replicated (coins are evaluated per shard on global ids)."""
+    return TrafficPlan(P(), P(), P(), P(None), P(None), P(None), P())
+
+
+_KINDS = ("poisson", "constant")
+
+
+@dataclass(frozen=True)
+class TrafficSpec:
+    """Host-side seeded open-loop traffic spec — JSON-able
+    (:meth:`to_meta`) and ``compile()``-able to the device
+    :class:`TrafficPlan`.
+
+    ``n_clients`` clients each issue at most ONE op per round (offered
+    load per client is capped at 1 op/round — ``rate`` is the mean
+    arrivals per client per round, so total offered load is
+    ``rate * n_clients`` ops/round).  Clients map to home nodes
+    statically: ``n_clients >= n_nodes`` packs ``n_clients/n_nodes``
+    clients per node (contiguous blocks), otherwise clients spread
+    every ``n_nodes/n_clients``-th node — either way a client block
+    lands on its home node's shard, so injection is shard-local.
+
+    ``ops_per_client`` bounds each client's op slots (the tracker
+    capacity): an arrival past it is DEFERRED loudly, never silently
+    dropped.  ``intake`` caps how many arrivals one NODE accepts per
+    round (None = no cap beyond the sims' own limits — kafka always
+    caps at its ``max_sends`` batch width).  ``burst`` windows
+    multiply the Poisson rate inside ``[start, end)`` rounds.
+    """
+
+    n_nodes: int
+    n_clients: int
+    ops_per_client: int
+    until: int
+    rate: float = 0.25
+    kind: str = "poisson"
+    burst: tuple = field(default_factory=tuple)   # ((start, end, mult),)
+    intake: int | None = None
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.n_nodes < 1 or self.n_clients < 1:
+            raise ValueError("need n_nodes >= 1 and n_clients >= 1")
+        if not (self.n_clients % self.n_nodes == 0
+                or self.n_nodes % self.n_clients == 0):
+            raise ValueError(
+                f"n_clients={self.n_clients} must divide or be "
+                f"divisible by n_nodes={self.n_nodes} (the static "
+                "client -> home-node map keeps injection shard-local)")
+        if self.ops_per_client < 1:
+            raise ValueError("ops_per_client must be >= 1")
+        if self.n_clients * self.ops_per_client >= 2 ** 31:
+            raise ValueError(
+                "n_clients * ops_per_client must fit int32 op ids")
+        if self.until < 1:
+            raise ValueError("until must be >= 1 round")
+        if not 0.0 < self.rate <= 1.0:
+            raise ValueError(
+                f"rate={self.rate} must be in (0, 1] — each client "
+                "issues at most one op per round")
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown kind {self.kind!r}; "
+                             f"one of {_KINDS}")
+        norm = []
+        for start, end, mult in self.burst:
+            if not 0 <= int(start) < int(end) <= self.until:
+                raise ValueError(
+                    f"bad burst window [{start}, {end}): windows "
+                    f"must lie inside the arrival horizon "
+                    f"[0, {self.until})")
+            if not 0.0 < float(mult) * self.rate <= 1.0:
+                raise ValueError(
+                    f"burst mult {mult} pushes the in-window rate "
+                    f"past 1 op/client/round (rate={self.rate})")
+            norm.append((int(start), int(end), float(mult)))
+        for (s1, e1, _m1), (s2, e2, _m2) in zip(
+                sorted(norm), sorted(norm)[1:]):
+            if s2 < e1:
+                raise ValueError(
+                    f"burst windows [{s1}, {e1}) and [{s2}, {e2}) "
+                    "overlap — the offered-load accounting (and the "
+                    "last-window-wins device fold) need disjoint "
+                    "windows")
+        object.__setattr__(self, "burst", tuple(norm))
+        if self.intake is not None and self.intake < 0:
+            raise ValueError("intake must be >= 0 (or None)")
+
+    # -- host mirrors ----------------------------------------------------
+
+    @property
+    def clients_per_node(self) -> int:
+        return max(1, self.n_clients // self.n_nodes)
+
+    @property
+    def node_stride(self) -> int:
+        return max(1, self.n_nodes // self.n_clients)
+
+    # -- compilation -----------------------------------------------------
+
+    def compile(self) -> TrafficPlan:
+        b = len(self.burst)
+        starts = np.zeros((b,), np.int32)
+        ends = np.zeros((b,), np.int32)
+        nums = np.zeros((b,), np.uint32)
+        for w, (start, end, mult) in enumerate(self.burst):
+            starts[w], ends[w] = start, end
+            nums[w] = faults._rate_to_num(min(1.0, self.rate * mult))
+        return TrafficPlan(
+            kind=jnp.int32(_KINDS.index(self.kind)),
+            rate_num=jnp.uint32(faults._rate_to_num(self.rate)),
+            until=jnp.int32(self.until),
+            b_starts=jnp.asarray(starts), b_ends=jnp.asarray(ends),
+            b_num=jnp.asarray(nums),
+            seed=jnp.uint32(self.seed & 0xFFFFFFFF))
+
+    # -- checkpoint / bench meta ----------------------------------------
+
+    def to_meta(self) -> dict:
+        return {"n_nodes": self.n_nodes, "n_clients": self.n_clients,
+                "ops_per_client": self.ops_per_client,
+                "until": self.until, "rate": self.rate,
+                "kind": self.kind,
+                "burst": [list(w) for w in self.burst],
+                "intake": self.intake, "seed": self.seed}
+
+    @staticmethod
+    def from_meta(meta: dict) -> "TrafficSpec":
+        return TrafficSpec(
+            n_nodes=int(meta["n_nodes"]),
+            n_clients=int(meta["n_clients"]),
+            ops_per_client=int(meta["ops_per_client"]),
+            until=int(meta["until"]), rate=float(meta["rate"]),
+            kind=str(meta.get("kind", "poisson")),
+            burst=tuple(tuple(w) for w in meta.get("burst", ())),
+            intake=meta.get("intake"), seed=int(meta.get("seed", 0)))
+
+    def with_rate(self, rate: float) -> "TrafficSpec":
+        """The serving-curve sweep knob: same spec, new offered load."""
+        return replace(self, rate=rate)
+
+    @property
+    def program_key(self) -> tuple:
+        """The STATIC (trace-relevant) part of the spec.  A traffic
+        driver compiled for one key runs ANY spec sharing it — rate,
+        seed, kind, horizon, and the burst window values all ride the
+        compiled :class:`TrafficPlan` as traced operands — so a
+        serving-curve load sweep reuses one compiled program across
+        its rates."""
+        return (self.n_nodes, self.n_clients, self.ops_per_client,
+                self.intake, len(self.burst))
+
+
+# -- device-side arrival evaluation --------------------------------------
+
+
+def _client_hash(plan: TrafficPlan, t, ids, salt: int) -> jnp.ndarray:
+    """uint32 counter-based stream h(seed, t, client, salt) — the
+    faults._edge_hash family over the client axis: stateless, so every
+    shard (and every replay, at any blocking) evaluates the same coin
+    for the same (round, client)."""
+    x = (jnp.asarray(ids).astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+         ^ jnp.asarray(t).astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         ^ plan.seed ^ jnp.uint32(salt))
+    return faults._mix32(x)
+
+
+def _arrival_num(plan: TrafficPlan, t) -> jnp.ndarray:
+    """() uint32 — the arrival threshold at round t: the base rate,
+    overridden inside any active burst window (windows-as-data, the
+    one evaluation shape every compiled schedule here uses)."""
+    return windows_fold(
+        plan.b_starts, plan.b_ends, t,
+        lambda w, active, num: jnp.where(active, plan.b_num[w], num),
+        plan.rate_num)
+
+
+def arrive(plan: TrafficPlan, t, ids: jnp.ndarray) -> jnp.ndarray:
+    """bool, shaped like ``ids`` — which (GLOBAL) client ids issue an
+    op at round ``t``.
+
+    - ``poisson``: Bernoulli(rate) per (client, round) — geometric
+      inter-arrivals, the round-synchronous Poisson process.
+    - ``constant``: per-client fixed-point accumulator
+      ``acc_t = phase_c + t * rate_num (mod 2^32)`` fires exactly when
+      adding another ``rate_num`` would wrap — a deterministic
+      1-in-(1/rate) cadence, de-phased across clients by the seeded
+      ``phase_c`` so the fleet's constant streams do not stampede.
+
+    Burst windows multiply the Poisson threshold via
+    :func:`_arrival_num`.  ``rate == 1`` fires every round."""
+    num = _arrival_num(plan, t)
+    always = num == jnp.uint32(0xFFFFFFFF)
+    poisson = _client_hash(plan, t, ids, _SALT_ARRIVE) < num
+    phase = faults._mix32(
+        jnp.asarray(ids).astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
+        ^ plan.seed ^ jnp.uint32(_SALT_PHASE))
+    acc = phase + jnp.asarray(t).astype(jnp.uint32) * num
+    constant = acc > ~num
+    fire = jnp.where(plan.kind == jnp.int32(1), constant,
+                     poisson) | always
+    t32 = jnp.asarray(t).astype(jnp.int32)
+    return fire & (t32 >= 0) & (t32 < plan.until)
+
+
+def local_node_cols(spec: TrafficSpec, n_loc: int) -> jnp.ndarray:
+    """(n_loc,) int32 — LOCAL node column of each local client (an
+    iota expression, so no host constant is baked in).  Valid because
+    the client axis blocks align with the node axis blocks: with
+    ``clients_per_node`` packing, local client lc sits at local node
+    ``lc // cpn``; with striding, at ``lc * stride``."""
+    c, n = spec.n_clients, spec.n_nodes
+    lc = jnp.arange(n_loc, dtype=jnp.int32)
+    if c >= n:
+        return lc // jnp.int32(spec.clients_per_node)
+    return lc * jnp.int32(spec.node_stride)
+
+
+def intake_rank(arr: jnp.ndarray, cpn: int) -> jnp.ndarray:
+    """(C_loc,) int32 — each arriving client's rank among this round's
+    arrivals AT ITS HOME NODE (client-index order — the deterministic
+    intake queue).  ``cpn`` static clients per node; rank 0 everywhere
+    when each node has one client."""
+    if cpn <= 1:
+        return jnp.zeros(arr.shape, jnp.int32)
+    a = arr.reshape(-1, cpn).astype(jnp.int32)
+    return (jnp.cumsum(a, axis=1) - a).reshape(-1)
+
+
+# -- the per-op tracker ---------------------------------------------------
+
+
+class TrafficState(NamedTuple):
+    """Per-op completion tracker + backpressure counters.  Rides the
+    DONATED state pytree of the traffic drivers (it is mutable per
+    round); client-axis leaves shard with the node axis.  Op identity
+    is the static pair (client, k < ops_per_client)."""
+
+    issued_k: jnp.ndarray     # (C,) int32 — next free op slot per client
+    issue_round: jnp.ndarray  # (C, K) int32 — -1 until issued
+    done_round: jnp.ndarray   # (C, K) int32 — -1 until globally visible
+    # (C, K) int32 sim payload: kafka — the allocated slot; counter —
+    # the KV value the op's flush landed in; -1 = unset
+    op_aux: jnp.ndarray
+    arrived: jnp.ndarray      # () uint32
+    deferred: jnp.ndarray     # () uint32 — backpressured arrivals
+    completed: jnp.ndarray    # () uint32
+
+
+def state_specs(sharded: bool) -> TrafficState:
+    """shard_map in/out_specs for a :class:`TrafficState`: client-axis
+    leaves positionally sharded with the node axis, counters
+    replicated (they are reduce_sum-globalized every round)."""
+    r1 = P("nodes") if sharded else P(None)
+    r2 = P("nodes", None) if sharded else P(None, None)
+    return TrafficState(r1, r2, r2, r2, P(), P(), P())
+
+
+def init_state(spec: TrafficSpec, mesh=None) -> TrafficState:
+    c, k = spec.n_clients, spec.ops_per_client
+    ts = TrafficState(
+        issued_k=jnp.zeros((c,), jnp.int32),
+        issue_round=jnp.full((c, k), -1, jnp.int32),
+        done_round=jnp.full((c, k), -1, jnp.int32),
+        op_aux=jnp.full((c, k), -1, jnp.int32),
+        arrived=jnp.uint32(0), deferred=jnp.uint32(0),
+        completed=jnp.uint32(0))
+    if mesh is not None:
+        n_sh = int(mesh.shape["nodes"])
+        if c % n_sh != 0:
+            raise ValueError(
+                f"n_clients={c} must shard evenly over the "
+                f"{n_sh}-way node axis")
+        s1 = NamedSharding(mesh, P("nodes"))
+        s2 = NamedSharding(mesh, P("nodes", None))
+        ts = ts._replace(
+            issued_k=jax.device_put(ts.issued_k, s1),
+            issue_round=jax.device_put(ts.issue_round, s2),
+            done_round=jax.device_put(ts.done_round, s2),
+            op_aux=jax.device_put(ts.op_aux, s2))
+    return ts
+
+
+def issue(ts: TrafficState, arr: jnp.ndarray, accept: jnp.ndarray, t,
+          reduce_sum: Callable) -> tuple:
+    """Classify this round's LOCAL arrivals and record the issued ops:
+    an arrival is issued iff ``accept`` holds AND the client has a
+    free op slot; everything else is DEFERRED (counted, never
+    dropped).  Returns ``(ts', ok, kslot)`` — ``ok`` the issued mask,
+    ``kslot`` the op slot each issued arrival took (the pre-bump
+    per-client counter).  ``reduce_sum`` globalizes the counters on a
+    mesh (psum), so the scalar leaves stay replicated."""
+    k = ts.issued_k
+    n_k = ts.issue_round.shape[1]
+    ok = arr & accept & (k < n_k)
+    defer = arr & ~ok
+    rows = jnp.arange(k.shape[0], dtype=jnp.int32)
+    kcol = jnp.where(ok, k, jnp.int32(n_k))
+    issue_round = ts.issue_round.at[rows, kcol].set(
+        jnp.asarray(t, jnp.int32), mode="drop")
+    ts = ts._replace(
+        issued_k=k + ok.astype(jnp.int32),
+        issue_round=issue_round,
+        arrived=ts.arrived + reduce_sum(
+            jnp.sum(arr.astype(jnp.uint32), dtype=jnp.uint32)),
+        deferred=ts.deferred + reduce_sum(
+            jnp.sum(defer.astype(jnp.uint32), dtype=jnp.uint32)))
+    return ts, ok, k
+
+
+def record_aux(ts: TrafficState, ok: jnp.ndarray, kslot: jnp.ndarray,
+               vals: jnp.ndarray) -> TrafficState:
+    """Store the sim payload for the ops just issued (kafka's
+    allocated slot / counter's flush-KV placeholder)."""
+    n_k = ts.op_aux.shape[1]
+    rows = jnp.arange(kslot.shape[0], dtype=jnp.int32)
+    kcol = jnp.where(ok, kslot, jnp.int32(n_k))
+    return ts._replace(
+        op_aux=ts.op_aux.at[rows, kcol].set(vals, mode="drop"))
+
+
+def done_scan(ts: TrafficState, bit_fn: Callable, t_done,
+              reduce_sum: Callable, block: int | None = None
+              ) -> TrafficState:
+    """Mark the ops that became globally visible this round:
+    ``bit_fn(lo, block) -> (block, K) bool`` evaluates the workload's
+    visibility predicate for the local client slab ``[lo, lo+block)``.
+    The predicate reads replicated round outputs and static op
+    identity only, so slab order cannot perturb a bit — the
+    ``GG_TRAFFIC_BLOCK`` slab size (see :func:`traffic_block`) bounds
+    the per-round tracker temps without changing any result (the
+    scan_blocks streaming contract, ISSUE-5/PR-5)."""
+    rows = ts.issue_round.shape[0]
+    block = rows if block is None else block
+
+    def blk(carry, lo):
+        dr, comp = carry
+        isl = lax.dynamic_slice_in_dim(ts.issue_round, lo, block,
+                                       axis=0)
+        dsl = lax.dynamic_slice_in_dim(dr, lo, block, axis=0)
+        dn = (isl >= 0) & (dsl < 0) & bit_fn(lo, block)
+        comp = comp + jnp.sum(dn.astype(jnp.uint32), dtype=jnp.uint32)
+        return (lax.dynamic_update_slice_in_dim(
+            dr, jnp.where(dn, jnp.asarray(t_done, jnp.int32), dsl),
+            lo, axis=0), comp)
+
+    dr, comp = scan_blocks(blk, (ts.done_round, jnp.uint32(0)),
+                           rows, block)
+    return ts._replace(done_round=dr,
+                       completed=ts.completed + reduce_sum(comp))
+
+
+# -- env knob -------------------------------------------------------------
+
+
+def traffic_block(rows: int) -> int:
+    """Client-axis slab size for the per-round tracker scan
+    (:func:`done_scan`), from ``GG_TRAFFIC_BLOCK``.  Loud contract
+    (the PR-6 ``_env_int`` rule): a non-integer value, or an integer
+    that does not divide the local client axis, raises a ValueError
+    NAMING the variable; values <= 0 or >= rows clamp to the whole
+    axis (the materialized evaluation order, bit-identical)."""
+    raw = os.environ.get("GG_TRAFFIC_BLOCK")
+    if raw is None:
+        return rows
+    b = _env_int("GG_TRAFFIC_BLOCK", raw)
+    if b <= 0 or b >= rows:
+        return rows
+    if rows % b != 0:
+        raise ValueError(
+            f"GG_TRAFFIC_BLOCK={b} does not divide the {rows}-row "
+            "local client axis (the tracker scan needs even slabs); "
+            "use a divisor, or unset it for the whole axis")
+    return b
+
+
+# -- host mirrors ---------------------------------------------------------
+
+
+def client_nodes(spec: TrafficSpec) -> np.ndarray:
+    """(n_clients,) int32 — each client's GLOBAL home node (host twin
+    of :func:`local_node_cols` + the shard offset)."""
+    ids = np.arange(spec.n_clients, dtype=np.int64)
+    if spec.n_clients >= spec.n_nodes:
+        return (ids // spec.clients_per_node).astype(np.int32)
+    return (ids * spec.node_stride).astype(np.int32)
+
+
+def host_arrivals(spec: TrafficSpec, t: int) -> np.ndarray:
+    """(n_clients,) bool — numpy twin of :func:`arrive`, bit-identical
+    coins (op staging away from the device, and the conservation
+    tests' independent arrival count)."""
+    if not 0 <= t < spec.until:
+        return np.zeros(spec.n_clients, bool)
+    num = np.uint32(faults._rate_to_num(spec.rate))
+    for start, end, mult in spec.burst:
+        if start <= t < end:
+            num = np.uint32(faults._rate_to_num(
+                min(1.0, spec.rate * mult)))
+    seed = np.uint32(spec.seed & 0xFFFFFFFF)
+    ids = np.arange(spec.n_clients, dtype=np.int64).astype(np.uint32)
+    t_term = np.uint32((int(t) * 0x9E3779B9) & 0xFFFFFFFF)
+    if num == np.uint32(0xFFFFFFFF):
+        return np.ones(spec.n_clients, bool)
+    if spec.kind == "constant":
+        phase = faults._mix32_np(
+            ids * np.uint32(0x27D4EB2F) ^ seed ^ np.uint32(_SALT_PHASE))
+        acc = phase + np.uint32((int(t) * int(num)) & 0xFFFFFFFF)
+        return acc > ~num
+    h = faults._mix32_np(ids * np.uint32(0xC2B2AE35) ^ t_term
+                         ^ seed ^ np.uint32(_SALT_ARRIVE))
+    return h < num
+
+
+def offered_per_round(spec: TrafficSpec) -> float:
+    """Mean offered load in ops/round (rate x clients; burst windows
+    raise the within-window mean)."""
+    base = spec.rate * spec.n_clients
+    if not spec.burst:
+        return base
+    boosted = sum((end - start) * (min(1.0, spec.rate * mult)
+                                   - spec.rate) * spec.n_clients
+                  for start, end, mult in spec.burst)
+    return base + boosted / spec.until
+
+
+# -- summaries ------------------------------------------------------------
+
+
+def latency_summary(ts: TrafficState) -> dict:
+    """Host-side per-run report: op counts, the conservation verdict,
+    and latency percentiles in ROUNDS (p50/p99/max over completed
+    ops).  ``conserved`` is the loud-backpressure invariant —
+    ``arrived == issued + deferred`` (and completed ≤ issued): every
+    arrival was classified exactly once, nothing dropped silently."""
+    issue_r = np.asarray(ts.issue_round)
+    done_r = np.asarray(ts.done_round)
+    issued = int((issue_r >= 0).sum())
+    comp_mask = done_r >= 0
+    completed = int(comp_mask.sum())
+    lat = (done_r[comp_mask] - issue_r[comp_mask]).astype(np.int64)
+    arrived, deferred = int(ts.arrived), int(ts.deferred)
+    return {
+        "arrived": arrived, "issued": issued, "deferred": deferred,
+        "completed": completed, "in_flight": issued - completed,
+        "conserved": (arrived == issued + deferred
+                      and completed == int(ts.completed)),
+        "lat_p50": (float(np.percentile(lat, 50)) if completed
+                    else None),
+        "lat_p99": (float(np.percentile(lat, 99)) if completed
+                    else None),
+        "lat_max": int(lat.max()) if completed else None,
+    }
+
+
+def per_round_series(ts: TrafficState, n_rounds: int) -> dict:
+    """Per-round issue/completion counts (the throughput-cliff
+    evidence: completions/round collapses inside a fault window and
+    recovers after it clears)."""
+    issue_r = np.asarray(ts.issue_round)
+    done_r = np.asarray(ts.done_round)
+    return {
+        "issued_by_round": np.bincount(
+            issue_r[issue_r >= 0], minlength=n_rounds).tolist(),
+        "completed_by_round": np.bincount(
+            done_r[done_r >= 0], minlength=n_rounds).tolist(),
+    }

@@ -17,9 +17,10 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)"
 # Fault-matrix smoke: one crash + one loss nemesis scenario per sim,
 # plus the words-major STRUCTURED-path crash/loss scenarios (the same
-# plans through structured.make_nemesis) — certifies recovery and the
-# gather-free fault decomposition on every push, not just in the
-# dedicated nemesis tests.  (CPU, seconds.)
+# plans through structured.make_nemesis), plus one crash+loss-UNDER-
+# LOAD scenario per sim (PR 7: open-loop traffic flowing through the
+# fault windows, serving certifier — zero lost acked ops, bounded
+# drain, latency keys).  (CPU, seconds.)
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python scripts/fault_smoke.py || rc=1
 # Kafka scale smoke (PR 4 + PR 5): 4-device sharded-kafka parity

@@ -20,10 +20,18 @@ except ImportError:  # bare checkout (no pip install -e .)
         os.path.dirname(os.path.abspath(__file__))))
     from gossip_glomers_tpu.harness import nemesis
 from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec  # noqa: E402
+from gossip_glomers_tpu.tpu_sim.traffic import TrafficSpec  # noqa: E402
 
 N = 8
 CRASH = NemesisSpec(n_nodes=N, seed=3, crash=((12, 16, (1, 5)),))
 LOSS = NemesisSpec(n_nodes=N, seed=4, loss_rate=0.2, loss_until=10)
+# crash+loss WHILE open-loop client traffic flows (PR 7): the serving
+# certifier must drain every acked op after the plan clears — zero
+# lost, bounded drain, latency keys in the verdict
+CRASH_LOSS = NemesisSpec(n_nodes=N, seed=5, crash=((6, 10, (2, 6)),),
+                         loss_rate=0.15, loss_until=16)
+TRAFFIC = TrafficSpec(n_nodes=N, n_clients=8, ops_per_client=8,
+                      until=20, rate=0.3, seed=1)
 
 SCENARIOS = [
     ("broadcast/crash", nemesis.run_broadcast_nemesis, CRASH, {}),
@@ -38,6 +46,13 @@ SCENARIOS = [
     ("counter/loss", nemesis.run_counter_nemesis, LOSS, {}),
     ("kafka/crash", nemesis.run_kafka_nemesis, CRASH, {}),
     ("kafka/loss", nemesis.run_kafka_nemesis, LOSS, {}),
+    # crash+loss under open-loop serving load, one per sim (PR 7)
+    ("broadcast/load", nemesis.run_broadcast_nemesis, CRASH_LOSS,
+     {"traffic": TRAFFIC}),
+    ("counter/load", nemesis.run_counter_nemesis, CRASH_LOSS,
+     {"traffic": TRAFFIC}),
+    ("kafka/load", nemesis.run_kafka_nemesis, CRASH_LOSS,
+     {"traffic": TRAFFIC}),
 ]
 
 
@@ -46,9 +61,11 @@ def main() -> int:
     for name, run, spec, kw in SCENARIOS:
         res = run(spec, **kw)
         status = "ok" if res["ok"] else "FAIL"
+        lat = (f" p99={res['lat_p99']}" if "lat_p99" in res else "")
         print(f"fault-smoke {name:16s} {status}  "
               f"recovery={res['recovery_rounds']} "
-              f"lost={res['n_lost_writes']} msgs={res['msgs_total']}")
+              f"lost={res['n_lost_writes']} msgs={res['msgs_total']}"
+              f"{lat}")
         if not res["ok"]:
             failed.append((name, res))
     if failed:

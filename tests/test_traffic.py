@@ -1,0 +1,452 @@
+"""Open-loop traffic engine (tpu_sim/traffic.py + the sims' traffic
+drivers + harness/serving.py): seed-replay determinism across drivers
+and block sizes, LOUD backpressure accounting with per-round
+conservation, host/device coin parity, env-knob contracts, the latency
+checker's falsifiability, and the traced/host split totality that
+keeps the PR-6 determinism lint covering the new module.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from gossip_glomers_tpu.harness import nemesis, serving
+from gossip_glomers_tpu.harness.checkers import (check_op_latency,
+                                                 check_recovery)
+from gossip_glomers_tpu.parallel.topology import (grid,
+                                                  to_padded_neighbors,
+                                                  tree)
+from gossip_glomers_tpu.tpu_sim import audit
+from gossip_glomers_tpu.tpu_sim import structured as S
+from gossip_glomers_tpu.tpu_sim import traffic as T
+from gossip_glomers_tpu.tpu_sim.broadcast import BroadcastSim
+from gossip_glomers_tpu.tpu_sim.counter import CounterSim
+from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec
+from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim
+
+N = 8
+
+
+def mesh_1d():
+    return Mesh(np.array(jax.devices()).reshape(8), ("nodes",))
+
+
+def tspec(**kw):
+    base = dict(n_nodes=N, n_clients=8, ops_per_client=6, until=12,
+                rate=0.4, seed=1)
+    base.update(kw)
+    return T.TrafficSpec(**base)
+
+
+def tracker_arrays(ts):
+    return tuple(np.asarray(x) for x in
+                 (ts.issued_k, ts.issue_round, ts.done_round,
+                  ts.op_aux))
+
+
+# -- spec / plan ---------------------------------------------------------
+
+
+def test_spec_validation_and_meta_roundtrip():
+    spec = tspec(burst=((2, 5, 2.0),), intake=2, kind="constant")
+    assert T.TrafficSpec.from_meta(spec.to_meta()) == spec
+    with pytest.raises(ValueError, match="rate"):
+        tspec(rate=1.5)
+    with pytest.raises(ValueError, match="kind"):
+        tspec(kind="pareto")
+    with pytest.raises(ValueError, match="divisible"):
+        T.TrafficSpec(n_nodes=6, n_clients=4, ops_per_client=2,
+                      until=4)
+    with pytest.raises(ValueError, match="burst"):
+        tspec(rate=0.8, burst=((0, 4, 3.0),))
+    with pytest.raises(ValueError, match="horizon"):
+        tspec(burst=((4, 99, 2.0),))      # window past `until`
+    with pytest.raises(ValueError, match="overlap"):
+        tspec(burst=((0, 6, 2.0), (4, 8, 2.0)))
+    with pytest.raises(ValueError, match="ops_per_client"):
+        tspec(ops_per_client=0)
+
+
+@pytest.mark.parametrize("kind,burst", [
+    ("poisson", ()), ("constant", ()), ("poisson", ((3, 6, 2.5),))])
+def test_arrival_coins_host_device_match(kind, burst):
+    spec = tspec(kind=kind, burst=burst, rate=0.3, until=10)
+    plan = spec.compile()
+    ids = np.arange(spec.n_clients)
+    total = 0
+    for t in range(12):                      # includes t >= until
+        dev = np.asarray(T.arrive(plan, t, ids))
+        host = T.host_arrivals(spec, t)
+        assert (dev == host).all(), (kind, t)
+        total += int(host.sum())
+    assert total > 0
+    # rate=1 fires every client every round inside the horizon
+    one = tspec(kind=kind, rate=1.0, burst=()).compile()
+    assert np.asarray(T.arrive(one, 0, ids)).all()
+
+
+def test_constant_rate_cadence():
+    # rate 0.25 constant: every client fires exactly until/4 +- 1 times
+    spec = tspec(kind="constant", rate=0.25, until=16)
+    per_client = np.zeros(spec.n_clients, int)
+    for t in range(16):
+        per_client += T.host_arrivals(spec, t)
+    assert (np.abs(per_client - 4) <= 1).all(), per_client
+
+
+# -- seed replay across drivers and block sizes --------------------------
+
+
+def test_seed_replay_across_drivers_and_blocks(monkeypatch):
+    spec = tspec()
+    runs = []
+
+    def run_one():
+        sim = CounterSim(N, mode="cas", poll_every=2)
+        st, ts = sim.init_state(), sim.traffic_state(spec)
+        st, ts = sim.run_traffic(st, ts, spec, 16, donate=True)
+        return tracker_arrays(ts), T.latency_summary(ts)
+
+    runs.append(run_one())                       # whole-axis tracker
+    monkeypatch.setenv("GG_TRAFFIC_BLOCK", "2")  # blocked tracker scan
+    runs.append(run_one())
+    monkeypatch.delenv("GG_TRAFFIC_BLOCK")
+    # stepwise (16 x rounds=1, undonated) vs the fused donated driver
+    sim = CounterSim(N, mode="cas", poll_every=2)
+    st, ts = sim.init_state(), sim.traffic_state(spec)
+    for _ in range(16):
+        st, ts = sim.run_traffic(st, ts, spec, 1)
+    runs.append((tracker_arrays(ts), T.latency_summary(ts)))
+    ref_arrays, ref_summary = runs[0]
+    for arrays, summary in runs[1:]:
+        for a, b in zip(ref_arrays, arrays):
+            assert (a == b).all()
+        assert summary == ref_summary
+
+
+def test_kafka_replay_across_union_blocks():
+    spec = tspec(until=10)
+    nspec = NemesisSpec(n_nodes=N, seed=7, crash=((2, 5, (1,)),),
+                        loss_rate=0.2, loss_until=8)
+    outs = []
+    for ub in ("materialized", 2):
+        sim = KafkaSim(N, 4, capacity=64, max_sends=2,
+                       fault_plan=nspec.compile(), resync_every=2,
+                       union_block=ub)
+        st, ts = sim.init_state(), sim.traffic_state(spec)
+        st, ts = sim.run_traffic(st, ts, spec, 14, donate=True)
+        outs.append((tracker_arrays(ts), np.asarray(st.present)))
+    for a, b in zip(outs[0][0], outs[1][0]):
+        assert (a == b).all()
+    assert (outs[0][1] == outs[1][1]).all()
+
+
+def test_mesh_parity_and_conservation():
+    spec = T.TrafficSpec(n_nodes=16, n_clients=16, ops_per_client=4,
+                         until=10, rate=0.35, seed=3)
+    outs = []
+    for mesh in (None, mesh_1d()):
+        sim = CounterSim(16, mode="cas", poll_every=2, mesh=mesh)
+        st, ts = sim.init_state(), sim.traffic_state(spec)
+        st, ts = sim.run_traffic(st, ts, spec, 24, donate=True)
+        summ = T.latency_summary(ts)
+        assert summ["conserved"], summ
+        outs.append(tracker_arrays(ts))
+    for a, b in zip(*outs):
+        assert (a == b).all()
+
+
+# -- env knob ------------------------------------------------------------
+
+
+def test_traffic_block_env_parsing_is_loud(monkeypatch):
+    monkeypatch.setenv("GG_TRAFFIC_BLOCK", "banana")
+    with pytest.raises(ValueError, match="GG_TRAFFIC_BLOCK"):
+        T.traffic_block(8)
+    monkeypatch.setenv("GG_TRAFFIC_BLOCK", "3")
+    with pytest.raises(ValueError, match="GG_TRAFFIC_BLOCK"):
+        T.traffic_block(8)
+    # and it surfaces from the sim's driver build, naming the variable
+    with pytest.raises(ValueError, match="GG_TRAFFIC_BLOCK"):
+        CounterSim(N).run_traffic(
+            None, None, tspec(), 1)
+    monkeypatch.setenv("GG_TRAFFIC_BLOCK", "99")   # >= rows: whole axis
+    assert T.traffic_block(8) == 8
+    monkeypatch.setenv("GG_TRAFFIC_BLOCK", "4")
+    assert T.traffic_block(8) == 4
+
+
+# -- backpressure accounting --------------------------------------------
+
+
+def test_backpressure_deferral_is_loud_and_conserved():
+    # intake=0 refuses every arrival: all deferred, none issued, and
+    # the accounting says so — nothing silently dropped
+    spec = tspec(intake=0, until=6)
+    sim = BroadcastSim(to_padded_neighbors(grid(N)), n_values=64,
+                       srv_ledger=False)
+    st = sim.init_state(np.zeros((N, 2), np.uint32))
+    ts = sim.traffic_state(spec)
+    expect = sum(int(T.host_arrivals(spec, t).sum()) for t in range(6))
+    st, ts = sim.run_traffic(st, ts, spec, 6)
+    summ = T.latency_summary(ts)
+    assert summ["arrived"] == expect > 0
+    assert summ["deferred"] == expect and summ["issued"] == 0
+    assert summ["conserved"]
+
+
+def test_conservation_holds_every_round():
+    spec = tspec(ops_per_client=2, until=12)   # tiny K: slot deferrals
+    sim = BroadcastSim(to_padded_neighbors(grid(N)), n_values=64,
+                       srv_ledger=False)
+    st = sim.init_state(np.zeros((N, 2), np.uint32))
+    ts = sim.traffic_state(spec)
+    host_arrived = 0
+    for t in range(14):
+        st, ts = sim.run_traffic(st, ts, spec, 1)
+        host_arrived += int(T.host_arrivals(spec, t).sum())
+        summ = T.latency_summary(ts)
+        assert summ["conserved"], (t, summ)
+        assert summ["arrived"] == host_arrived
+        assert (summ["issued"]
+                == summ["completed"] + summ["in_flight"])
+    assert summ["deferred"] > 0          # K=2 must have saturated
+    assert summ["in_flight"] == 0        # fault-free: all drained
+
+
+def test_counter_amnesia_lost_op_never_completes():
+    # the certifier's false-negative regression (PR-7 review): node
+    # 2's round-0 op cannot flush (KV-blocked), its delta dies in the
+    # round-1 amnesia wipe, and traffic RESUMES at the node after
+    # restart — the later flush must NOT claim the lost op: it stays
+    # in flight forever and surfaces as a lost acked write
+    import jax.numpy as jnp
+    spec = tspec(rate=1.0, kind="constant", until=6, ops_per_client=8)
+    nspec = NemesisSpec(n_nodes=N, seed=1, crash=((1, 3, (2,)),))
+    blocked = np.zeros((1, N), bool)
+    blocked[0, 2] = True
+    from gossip_glomers_tpu.tpu_sim.counter import KVReach
+    sched = KVReach(jnp.asarray([0], jnp.int32),
+                    jnp.asarray([1], jnp.int32), jnp.asarray(blocked))
+    sim = CounterSim(N, mode="allreduce", poll_every=2,
+                     kv_sched=sched, fault_plan=nspec.compile())
+    st, ts = sim.init_state(), sim.traffic_state(spec)
+    st, ts = sim.run_traffic(st, ts, spec, 6, donate=True)
+    for _ in range(8):
+        st, ts = sim.run_traffic(st, ts, spec, 4, donate=True)
+    summ = T.latency_summary(ts)
+    assert summ["arrived"] == 48          # rate 1.0: 8 clients x 6
+    assert summ["deferred"] == 2          # node 2 down rounds 1-2
+    assert summ["in_flight"] == 1, summ   # the wiped round-0 op
+    assert summ["conserved"]
+    # and the KV really is short by exactly that one delta
+    assert int(st.kv) == summ["completed"]
+
+
+def test_down_node_arrivals_defer_and_nothing_is_lost():
+    # allreduce + a loss-free plan: every reachable node flushes its
+    # pending the round it arrives, so a crash window can defer
+    # arrivals but never wipe an unflushed acked delta — in cas mode
+    # the same window WOULD lose the unlucky contenders' ops, and the
+    # tracker now reports that honestly (see
+    # test_counter_amnesia_lost_op_never_completes)
+    spec = tspec(until=10)
+    nspec = NemesisSpec(n_nodes=N, seed=9, crash=((2, 8, (0, 3)),))
+    sim = CounterSim(N, mode="allreduce", poll_every=2,
+                     fault_plan=nspec.compile())
+    st, ts = sim.init_state(), sim.traffic_state(spec)
+    st, ts = sim.run_traffic(st, ts, spec, 10, donate=True)
+    mid = T.latency_summary(ts)
+    assert mid["deferred"] > 0           # arrivals at down nodes
+    for _ in range(10):
+        st, ts = sim.run_traffic(st, ts, spec, 4, donate=True)
+    summ = T.latency_summary(ts)
+    assert summ["conserved"] and summ["in_flight"] == 0, summ
+
+
+def test_kafka_capacity_overflow_defers():
+    # capacity 1 slot/key: almost every send fails allocation — every
+    # one of them must surface as a deferral, and the few acked ops
+    # must all complete
+    spec = tspec(until=8, rate=0.5)
+    sim = KafkaSim(N, 2, capacity=1, max_sends=2)
+    st, ts = sim.init_state(), sim.traffic_state(spec)
+    st, ts = sim.run_traffic(st, ts, spec, 10)
+    summ = T.latency_summary(ts)
+    assert summ["conserved"], summ
+    assert summ["deferred"] > 0
+    assert summ["issued"] <= 2           # one slot per key, two keys
+    assert summ["in_flight"] == 0
+
+
+def test_counter_cas_latency_grows_at_saturation():
+    # cas mode drains ~one node's pending per round: offered load
+    # past that rate must queue, and the queue is visible as latency
+    lo = serving.run_serving("counter", tspec(rate=0.1, until=16),
+                             sim_kw={"mode": "cas"})
+    hi = serving.run_serving("counter", tspec(rate=1.0, until=16),
+                             sim_kw={"mode": "cas"})
+    assert lo["ok"] and hi["ok"]
+    assert hi["lat_p50"] > lo["lat_p50"]
+    assert hi["lat_p99"] > lo["lat_p99"]
+
+
+# -- serving runner + nemesis composition --------------------------------
+
+
+def test_run_serving_curve_rows_and_fault_overlay():
+    spec = tspec(until=16, ops_per_client=8)
+    rows = serving.run_serving_curve("broadcast", spec, [0.1, 0.4])
+    assert [r["traffic"]["rate"] for r in rows] == [0.1, 0.4]
+    for r in rows:
+        assert r["ok"] and r["conserved"] and r["in_flight"] == 0
+        assert r["lat_p99"] is not None
+        assert r["offered_per_round"] > 0
+    nspec = NemesisSpec(n_nodes=N, seed=5, crash=((4, 8, (1, 6)),),
+                        loss_rate=0.1, loss_until=12)
+    res = nemesis.run_kafka_nemesis(nspec, traffic=spec)
+    assert res["ok"], res
+    assert res["workload"] == "kafka"
+    for key in ("lat_p50", "lat_p99", "lat_max", "cliff",
+                "recovery_rounds"):
+        assert key in res
+    assert res["spec"] == nspec.to_meta()
+    # counter composes through the same kwarg; allreduce + crash-only
+    # keeps the run loss-proof (every reachable round flushes)
+    c_spec = NemesisSpec(n_nodes=N, seed=5, crash=((4, 8, (1, 6)),))
+    res_c = nemesis.run_counter_nemesis(c_spec, traffic=spec,
+                                        mode="allreduce")
+    assert res_c["ok"], res_c
+    assert res_c["workload"] == "counter" and "lat_p99" in res_c
+
+
+def test_check_recovery_surfaces_latency_keys():
+    ok, details = check_recovery(
+        clear_round=4, converged_round=6, max_recovery_rounds=8,
+        lost_writes=[], latency={"lat_p50": 2.0, "lat_p99": 5.0,
+                                 "lat_max": 7})
+    assert ok
+    assert (details["lat_p50"], details["lat_p99"],
+            details["lat_max"]) == (2.0, 5.0, 7)
+
+
+# -- latency checker falsifiability --------------------------------------
+
+
+def _summary(p99, mx, completed=10, conserved=True):
+    return {"arrived": completed, "issued": completed, "deferred": 0,
+            "completed": completed, "in_flight": 0,
+            "conserved": conserved, "lat_p50": 1.0, "lat_p99": p99,
+            "lat_max": mx}
+
+
+def test_latency_checker_bites_on_delayed_op():
+    # a real tracker with one deliberately-delayed op: 9 ops complete
+    # in 2 rounds, one straggler takes 40 — p99 blows the bound
+    issue = np.zeros((10, 1), np.int32)
+    done = np.full((10, 1), 2, np.int32)
+    done[7, 0] = 40
+    ts = T.TrafficState(
+        issued_k=np.ones((10,), np.int32), issue_round=issue,
+        done_round=done, op_aux=np.full((10, 1), -1, np.int32),
+        arrived=np.uint32(10), deferred=np.uint32(0),
+        completed=np.uint32(10))
+    summ = T.latency_summary(ts)
+    ok, details = check_op_latency(summ, p99_max_rounds=8)
+    assert not ok
+    assert any("p99" in p for p in details["problems"])
+    # the same histogram passes a bound that admits the straggler
+    ok2, _ = check_op_latency(summ, p99_max_rounds=64)
+    assert ok2
+    # conservation breakage and empty runs also fail
+    assert not check_op_latency(_summary(1.0, 1, conserved=False),
+                                p99_max_rounds=8)[0]
+    assert not check_op_latency(_summary(1.0, 1, completed=0),
+                                p99_max_rounds=8)[0]
+    assert not check_op_latency(_summary(2.0, 99), p99_max_rounds=8,
+                                max_rounds=50)[0]
+    # min_completed=0 makes an EMPTY run vacuously in bound (the
+    # lat_* keys are None there — must not crash)
+    empty = dict(_summary(1.0, 1, completed=0),
+                 lat_p50=None, lat_p99=None, lat_max=None)
+    ok3, _ = check_op_latency(empty, p99_max_rounds=8,
+                              min_completed=0)
+    assert ok3
+
+
+# -- lint / registry coverage --------------------------------------------
+
+
+def test_traffic_traced_host_split_is_total():
+    import ast as ast_mod
+
+    import gossip_glomers_tpu
+    pkg = os.path.dirname(os.path.abspath(gossip_glomers_tpu.__file__))
+    src = open(os.path.join(pkg, "tpu_sim", "traffic.py")).read()
+    tree_ = ast_mod.parse(src)
+    top_fns = {n.name for n in tree_.body
+               if isinstance(n, ast_mod.FunctionDef)}
+    declared = set(T.TRACED_EVALUATORS) | set(T.HOST_SIDE)
+    assert top_fns == declared, (
+        f"undeclared: {sorted(top_fns - declared)}, "
+        f"stale: {sorted(declared - top_fns)}")
+    pat = audit._root_pattern_for("tpu_sim/traffic.py")
+    for name in T.TRACED_EVALUATORS:
+        assert pat.match(name), name
+    for name in T.HOST_SIDE:
+        assert not pat.match(name), name
+
+
+def test_traffic_contracts_registered():
+    names = [c.name for c in audit.default_registry()]
+    for expected in ("broadcast/sharded-traffic-run-halo-wm",
+                     "counter/sharded-traffic-run",
+                     "kafka/sharded-traffic-run-union-nem-blocked"):
+        assert expected in names, names
+    # all three are donation contracts: the alias-coverage half of the
+    # injected-traffic acceptance gate (the census half rides the same
+    # rows; the full registry runs in scripts/audit.py and the donated
+    # set in test_audit.py::test_registered_donation_contracts_pass)
+    traffic_rows = [c for c in audit.default_registry()
+                    if "traffic" in c.name]
+    assert all(c.donation for c in traffic_rows)
+    assert all("all-gather" not in c.collectives
+               for c in traffic_rows)
+
+
+# -- broadcast words-major traffic parity --------------------------------
+
+
+def test_broadcast_wm_traffic_matches_gather_latency():
+    # the same spec through the gather path and the words-major tree:
+    # different topologies flood differently, but the ACCOUNTING
+    # invariants hold on both and the wm path completes everything
+    spec = tspec(until=10)
+    for kw in ({}, {"exchange": S.make_exchange("tree", N)}):
+        sim = BroadcastSim(to_padded_neighbors(tree(N)), n_values=64,
+                           sync_every=4, srv_ledger=False, **kw)
+        st = sim.init_state(np.zeros((N, 2), np.uint32))
+        ts = sim.traffic_state(spec)
+        st, ts = sim.run_traffic(st, ts, spec, 10, donate=True)
+        for _ in range(5):
+            st, ts = sim.run_traffic(st, ts, spec, 4, donate=True)
+        summ = T.latency_summary(ts)
+        assert summ["conserved"] and summ["in_flight"] == 0, (kw,
+                                                              summ)
+
+
+def test_traffic_rejects_unsupported_modes():
+    spec = tspec()
+    sim = BroadcastSim(to_padded_neighbors(grid(N)), n_values=64)
+    with pytest.raises(ValueError, match="srv_ledger"):
+        sim.run_traffic(None, None, spec, 1)
+    small = BroadcastSim(to_padded_neighbors(grid(N)), n_values=8,
+                         srv_ledger=False)
+    with pytest.raises(ValueError, match="value universe"):
+        small.run_traffic(None, None, spec, 1)
+    with pytest.raises(ValueError, match="matmul"):
+        KafkaSim(N, 2, capacity=8, repl_fast=False).run_traffic(
+            None, None, spec, 1)
