@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Telemetry overhead benchmark (PR 8) — the BENCH_PR8.json +
+TIMELINE_PR8.json artifact writer.
+
+Rows:
+
+- ``sweep_point_1024``: the BENCH_PR5 kafka timing cell (1,024 nodes,
+  10k keys, capacity 128, max_sends 16, blocked union 256, crash+loss
+  active every timed round) — telemetry-off ``run_rounds`` vs
+  telemetry-on ``run_observed``, min-of-repeats.  The acceptance row:
+  overhead must stay under 5%.
+- ``mesh_65536``: counter allreduce at 65,536 nodes on the 8-way
+  virtual mesh under crash+loss — the scale row, same gate.
+- ``small_1024``: counter-cas and broadcast-gather at 1,024 nodes.
+  These rounds are MICROSECONDS on CPU, so the relative number is
+  dominated by scheduler noise (repeated runs swing tens of percent
+  in both directions) — recorded honestly with min-of-many and a
+  noise note, NOT gated.
+
+Plus one committed example timeline: a certified crash+loss+traffic
+counter run, telemetry-on, exported through ``observe.run_timeline``
+(fault windows + driven/drain phases + every per-round series).
+
+Usage: python benchmarks/telemetry_overhead.py
+           [--out BENCH_PR8.json] [--timeline TIMELINE_PR8.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gossip_glomers_tpu.parallel.mesh import force_virtual_devices  # noqa: E402
+
+force_virtual_devices(8)
+
+import jax                                               # noqa: E402
+import numpy as np                                       # noqa: E402
+from jax.sharding import Mesh                            # noqa: E402
+
+from gossip_glomers_tpu.harness import observe, serving  # noqa: E402
+from gossip_glomers_tpu.harness.nemesis import stage_kafka_ops  # noqa: E402
+from gossip_glomers_tpu.parallel.topology import (  # noqa: E402
+    to_padded_neighbors, tree)
+from gossip_glomers_tpu.tpu_sim import telemetry as TM   # noqa: E402
+from gossip_glomers_tpu.tpu_sim.broadcast import (  # noqa: E402
+    BroadcastSim, make_inject)
+from gossip_glomers_tpu.tpu_sim.counter import CounterSim  # noqa: E402
+from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec  # noqa: E402
+from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim      # noqa: E402
+from gossip_glomers_tpu.tpu_sim.traffic import TrafficSpec  # noqa: E402
+
+
+def _best_pair(off_fn, on_fn, repeats: int) -> tuple[float, float]:
+    """INTERLEAVED min-of-repeats wall times for the off/on pair.
+    Interleaving matters more than the repeat count: measuring one
+    variant's whole block after the other's lets background-load
+    drift masquerade as overhead (observed ±15% on an 800 ms
+    computation measured block-wise on a shared box); alternating
+    samples see the same machine, and the minimum is the
+    least-contended sample of a deterministic computation."""
+    off_fn()                                 # warm / compile
+    on_fn()
+    best_off = best_on = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        off_fn()
+        best_off = min(best_off, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        on_fn()
+        best_on = min(best_on, time.perf_counter() - t0)
+    return best_off, best_on
+
+
+def _row(name, off_s, on_s, rounds, gate):
+    overhead = (on_s - off_s) / off_s
+    row = {"ms_per_round_off": round(off_s / rounds * 1e3, 3),
+           "ms_per_round_on": round(on_s / rounds * 1e3, 3),
+           "overhead_frac": round(overhead, 4),
+           "overhead_pct": round(overhead * 100, 2)}
+    if gate is not None:
+        row["gate_pct"] = gate
+        row["ok"] = overhead * 100 < gate
+    print(f"  {name}: off {row['ms_per_round_off']} ms/round, "
+          f"on {row['ms_per_round_on']} ms/round, "
+          f"overhead {row['overhead_pct']}%"
+          + (f" (gate <{gate}%: {'ok' if row['ok'] else 'FAIL'})"
+             if gate is not None else ""))
+    return row
+
+
+def kafka_sweep_point(repeats: int = 3) -> dict:
+    """The BENCH_PR5 1,024-node/10k-key timing cell, telemetry on vs
+    off."""
+    n, k, cap, s_dim, rounds = 1024, 10_000, 128, 16, 2
+    spec = NemesisSpec(
+        n_nodes=n, seed=5,
+        crash=((0, rounds, tuple(range(0, n, 97))),),
+        loss_rate=0.1, loss_until=rounds)
+    sks, svs, _ = stage_kafka_ops(spec, rounds, n_keys=k,
+                                  max_sends=s_dim, workload_seed=0,
+                                  commits=False)
+    sim = KafkaSim(n, k, capacity=cap, max_sends=s_dim,
+                   fault_plan=spec.compile(), resync_every=4,
+                   union_block=256)
+    s0 = sim.init_state()
+    tsp = TM.TelemetrySpec("kafka", rounds=rounds)
+    tel0 = sim.telemetry_state(tsp)
+    off, on = _best_pair(
+        lambda: jax.block_until_ready(
+            sim.run_rounds(s0, sks, svs).msgs),
+        lambda: jax.block_until_ready(
+            sim.run_observed(s0, tel0, tsp, sks, svs)[0].msgs),
+        repeats)
+    return {"workload": "kafka", "n_nodes": n, "n_keys": k,
+            "capacity": cap, "max_sends": s_dim, "rounds": rounds,
+            "union_block": 256,
+            "fault": "crash(1 in 97)+loss(0.1) every timed round",
+            **_row("kafka sweep point", off, on, rounds, gate=5.0)}
+
+
+def counter_mesh_65536(repeats: int = 3) -> dict:
+    n, rounds = 65_536, 32
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("nodes",))
+    spec = NemesisSpec(
+        n_nodes=n, seed=5,
+        crash=((2, 20, tuple(range(0, n, 997))),),
+        loss_rate=0.1, loss_until=rounds)
+    sim = CounterSim(n, mode="allreduce", poll_every=2,
+                     fault_plan=spec.compile(), mesh=mesh)
+    s0 = sim.add(sim.init_state(), np.ones(n, np.int32))
+    tsp = TM.TelemetrySpec("counter", rounds=rounds)
+    tel0 = sim.telemetry_state(tsp)
+    off, on = _best_pair(
+        lambda: jax.block_until_ready(sim.run(s0, rounds).msgs),
+        lambda: jax.block_until_ready(
+            sim.run_observed(s0, tel0, tsp, rounds)[0].msgs),
+        repeats)
+    return {"workload": "counter", "mode": "allreduce", "n_nodes": n,
+            "mesh": 8, "rounds": rounds,
+            "fault": "crash(1 in 997)+loss(0.1)",
+            **_row("counter 65,536 8-way", off, on, rounds,
+                   gate=5.0)}
+
+
+def small_1024(repeats: int = 15) -> dict:
+    out = {"note": "rounds are microseconds on CPU at these shapes — "
+                   "the relative overhead is scheduler-noise-"
+                   "dominated (swings both directions across runs); "
+                   "recorded min-of-%d, not gated" % repeats}
+    n, rounds = 1024, 64
+    spec = NemesisSpec(
+        n_nodes=n, seed=5,
+        crash=((2, 40, tuple(range(0, n, 97))),),
+        loss_rate=0.1, loss_until=rounds)
+    sim = CounterSim(n, mode="cas", poll_every=2,
+                     fault_plan=spec.compile())
+    s0 = sim.add(sim.init_state(),
+                 np.arange(1, n + 1, dtype=np.int32))
+    tsp = TM.TelemetrySpec("counter", rounds=rounds)
+    tel0 = sim.telemetry_state(tsp)
+    off, on = _best_pair(
+        lambda: jax.block_until_ready(sim.run(s0, rounds).msgs),
+        lambda: jax.block_until_ready(
+            sim.run_observed(s0, tel0, tsp, rounds)[0].msgs),
+        repeats)
+    out["counter_cas"] = {"n_nodes": n, "rounds": rounds,
+                          **_row("counter 1,024 (noisy)", off, on,
+                                 rounds, gate=None)}
+    nv, rounds = 2048, 8
+    spec = NemesisSpec(
+        n_nodes=n, seed=5,
+        crash=((1, 6, tuple(range(0, n, 97))),),
+        loss_rate=0.1, loss_until=rounds)
+    bsim = BroadcastSim(to_padded_neighbors(tree(n, branching=4)),
+                        n_values=nv, sync_every=4, srv_ledger=False,
+                        fault_plan=spec.compile())
+    b0, _ = bsim.stage(make_inject(n, nv))
+    btsp = TM.TelemetrySpec("broadcast", rounds=rounds)
+    btel = bsim.telemetry_state(btsp)
+    off, on = _best_pair(
+        lambda: jax.block_until_ready(
+            bsim.run_staged_fixed(b0, rounds).msgs),
+        lambda: jax.block_until_ready(
+            bsim.run_observed(b0, btel, btsp, rounds)[0].msgs),
+        repeats)
+    out["broadcast_gather"] = {"n_nodes": n, "n_values": nv,
+                               "rounds": rounds,
+                               **_row("broadcast 1,024 W=64 (noisy)",
+                                      off, on, rounds, gate=None)}
+    return out
+
+
+def example_timeline(path: str) -> dict:
+    """One certified crash+loss+traffic run, telemetry-on, exported
+    as the committed Perfetto example.  Kafka: its acks are durable
+    (send_ok only after the lin-kv allocation), so the crash window
+    stalls completions without losing acked ops — the run certifies
+    AND the cliff renders."""
+    n = 64
+    spec = NemesisSpec(n_nodes=n, seed=11,
+                       crash=((8, 14, tuple(range(0, n, 9))),),
+                       loss_rate=0.15, loss_until=20)
+    tspec = TrafficSpec(n_nodes=n, n_clients=64, ops_per_client=6,
+                        until=24, rate=0.3, seed=3)
+    res = serving.run_serving("kafka", tspec, nemesis=spec,
+                              telemetry=True)
+    assert res["ok"], ("example run must certify",
+                       res.get("telemetry", {}).get("check"))
+    tl = observe.run_timeline(res, name="kafka crash+loss+traffic "
+                                        "(certified)")
+    observe.validate_timeline(tl)
+    observe.write_json_atomic(path, tl)
+    print(f"  timeline: {len(tl['traceEvents'])} events -> {path} "
+          f"(certified: completed={res['completed']}, lost=0, "
+          f"p99={res['lat_p99']})")
+    return {"path": path, "events": len(tl["traceEvents"]),
+            "completed": res["completed"], "lat_p99": res["lat_p99"],
+            "certified": res["ok"]}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="BENCH_PR8.json")
+    ap.add_argument("--timeline", default="TIMELINE_PR8.json")
+    args = ap.parse_args()
+    print("telemetry overhead (PR 8):")
+    report = {
+        "benchmark": "telemetry_overhead_pr8",
+        "backend": jax.default_backend(),
+        "series_default": {w: list(s)
+                           for w, s in TM.SIM_SERIES.items()},
+        "sweep_point_1024": kafka_sweep_point(),
+        "mesh_65536": counter_mesh_65536(),
+        "small_1024": small_1024(),
+        "example_timeline": example_timeline(args.timeline),
+    }
+    ok = (report["sweep_point_1024"]["ok"]
+          and report["mesh_65536"]["ok"])
+    report["ok"] = ok
+    pathlib.Path(args.out).write_text(
+        json.dumps(report, indent=1) + "\n")
+    print(f"wrote {args.out}  (gates {'ok' if ok else 'FAILED'})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

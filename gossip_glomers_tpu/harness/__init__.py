@@ -20,12 +20,14 @@ Everything is seeded: the same (workload, seed) pair replays the identical
 message timeline.
 
 The tpu_sim-side nemesis campaigns (crash/loss/dup with recovery
-certification) live in :mod:`.nemesis`, and the open-loop serving
+certification) live in :mod:`.nemesis`, the open-loop serving
 harness (latency-vs-offered-load curves over tpu_sim/traffic.py, with
-fault overlays — PR 7) in :mod:`.serving` — both imported explicitly
-(``from gossip_glomers_tpu.harness import nemesis, serving``) rather
-than here, so the pure-python harness surface stays importable
-without JAX.
+fault overlays — PR 7) in :mod:`.serving`, and the observability
+harness (run manifests, Perfetto timelines, flight-recorder repro
+bundles over tpu_sim/telemetry.py — PR 8) in :mod:`.observe` — all
+imported explicitly (``from gossip_glomers_tpu.harness import
+nemesis, serving, observe``) rather than here, so the pure-python
+harness surface stays importable without JAX.
 """
 
 from .network import Client, SimNodeRuntime, VirtualNetwork

@@ -172,6 +172,83 @@ def check_op_latency(summary: dict, *, p99_max_rounds: float,
         "problems": problems}
 
 
+def check_telemetry(series: dict, *, msgs_total: int | None = None,
+                    traffic: dict | None = None) -> tuple[bool, dict]:
+    """Conservation cross-check of a recorded telemetry ring
+    (tpu_sim/telemetry.py ``series_arrays``) against the run's final
+    ledgers (PR 8): the device-resident series must agree with the
+    accounting the sims already keep, or the recorder itself is
+    broken.
+
+    - ``msgs_total``: the final ``state.msgs`` — the ring's ``msgs``
+      running total must end exactly there (mod 2^32, the ledger's
+      own wrap), and must be non-decreasing row to row.
+    - ``traffic``: the tracker summary (``latency_summary``) — the
+      loud-backpressure identity ``arrived == issued + deferred``
+      must hold at EVERY recorded round, and the final row must match
+      the tracker's totals.
+
+    A check whose column was not recorded (a ``GG_TELEMETRY_SERIES``
+    subset) cannot run; it is listed in ``details['skipped']`` so a
+    vacuous pass is never silent.
+
+    Falsifiable by construction (a mutated series must fail) —
+    tests/test_telemetry.py proves it."""
+    problems: list[str] = []
+    skipped: list[str] = []
+    msgs = series.get("msgs")
+    if msgs_total is not None and not msgs:
+        skipped.append("msgs-vs-ledger (series 'msgs' not recorded)")
+    if msgs_total is not None and msgs:
+        want = msgs_total & 0xFFFFFFFF
+        if msgs[-1] != want:
+            problems.append(
+                f"telemetry msgs[-1]={msgs[-1]} != ledger total "
+                f"{want}")
+        for i in range(1, len(msgs)):
+            # serial arithmetic: the ledger wraps @2^32, so a
+            # decrease is legal exactly when the unsigned delta is a
+            # small forward step past the wrap
+            delta = (msgs[i] - msgs[i - 1]) & 0xFFFFFFFF
+            if msgs[i] < msgs[i - 1] and delta >= 1 << 31:
+                problems.append(
+                    f"msgs running total decreased at recorded row "
+                    f"{i}: {msgs[i - 1]} -> {msgs[i]}")
+                break
+    if traffic is not None:
+        arr = series.get("arrived") or []
+        iss = series.get("issued") or []
+        dfr = series.get("deferred") or []
+        if not (arr and iss and dfr):
+            missing = [k for k, c in (("arrived", arr), ("issued", iss),
+                                      ("deferred", dfr)) if not c]
+            skipped.append(
+                f"arrived == issued + deferred (series {missing} "
+                "not recorded)")
+        for i, (a, b, c) in enumerate(zip(arr, iss, dfr)):
+            if a != b + c:
+                problems.append(
+                    f"arrived != issued + deferred at recorded row "
+                    f"{i}: {a} != {b} + {c} (a silently-dropped "
+                    "arrival)")
+                break
+        for key, col in (("arrived", arr), ("deferred", dfr),
+                         ("completed", series.get("completed") or [])):
+            want = traffic.get(key)
+            if want is not None and not col:
+                skipped.append(
+                    f"{key}-vs-tracker (series {key!r} not recorded)")
+            if want is not None and col and col[-1] != want:
+                problems.append(
+                    f"telemetry {key}[-1]={col[-1]} != tracker "
+                    f"{want}")
+    return not problems, {
+        "problems": problems,
+        "skipped": skipped,
+        "rounds_recorded": len(series.get("_round", ())),
+        "wrapped": bool(series.get("_wrapped", False))}
+
+
 def check_kafka(send_acks: list[tuple[str, int, int]],
                 polls: list[dict[str, list[list[int]]]],
                 committed: dict[str, int],

@@ -48,13 +48,49 @@ def _neighbors(topology: str, n: int) -> np.ndarray:
     return to_padded_neighbors(build(n))
 
 
+def _failure_of(details: dict) -> dict:
+    keys = ("clear_round", "converged_round", "recovery_rounds",
+            "n_lost_writes", "lost_writes")
+    return {k: details[k] for k in keys if k in details}
+
+
+def _finish_observed(ok: bool, details: dict, tel, tel_spec, *,
+                     msgs_total: int, observe_dir, workload: str,
+                     spec: NemesisSpec, runner_kw: dict) -> bool:
+    """Shared PR-8 tail of the nemesis runners: surface the recorded
+    telemetry series, cross-check them against the run's own ledgers
+    (``checkers.check_telemetry`` — a broken recorder fails the run),
+    and on any failure write the flight-recorder repro bundle
+    (harness/observe.py) into ``observe_dir``."""
+    from ..tpu_sim import telemetry as TM
+    from . import observe
+    from .checkers import check_telemetry
+
+    series = tel_meta = None
+    if tel is not None:
+        series = TM.series_arrays(tel, tel_spec)
+        ok_t, t_det = check_telemetry(series, msgs_total=msgs_total)
+        details["telemetry"] = {"spec": tel_spec.to_meta(),
+                                "series": series, "check": t_det}
+        tel_meta = tel_spec.to_meta()
+        ok = ok and ok_t
+    if not ok and observe_dir is not None:
+        details["flight_bundle"] = observe.write_flight_bundle(
+            observe_dir, kind="nemesis", workload=workload,
+            nemesis=spec.to_meta(), runner_kw=runner_kw,
+            telemetry_spec=tel_meta, telemetry_series=series,
+            failure=_failure_of(details))
+    return ok
+
+
 def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                           topology: str = "grid", sync_every: int = 4,
                           parts: Partitions | None = None,
                           max_recovery_rounds: int = 96,
                           mesh=None,
                           structured: "bool | str" = False,
-                          traffic=None) -> dict:
+                          traffic=None, telemetry=None,
+                          observe_dir=None) -> dict:
     """Broadcast under the full nemesis (crash/loss/dup from ``spec``,
     plus an optional partition schedule): values injected round-robin
     at round 0, convergence = every node holds every value.  A lost
@@ -76,10 +112,23 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
     certifier (harness/serving.py): bounded drain after
     ``clear_round``, zero lost acked ops, p50/p99 op latency in the
     details.  Fault campaigns and serving load compose in one fused
-    device program (the (TrafficPlan, FaultPlan) operand pair)."""
+    device program (the (TrafficPlan, FaultPlan) operand pair).
+
+    ``telemetry`` (PR 8): None (the ``GG_TELEMETRY`` env switch,
+    default off) / True / False / a ``TelemetrySpec`` — run the
+    campaign on the telemetry-on observed drivers (bit-exact to the
+    plain ones), surface the per-round series in
+    ``details['telemetry']``, cross-check them against the ledgers
+    (``checkers.check_telemetry`` — a broken recorder fails the
+    run), and on ANY failure write the flight-recorder repro bundle
+    into ``observe_dir`` (if given)."""
     from ..tpu_sim import structured as S
+    from . import observe
     n = spec.n_nodes
     nv = n_values if n_values is not None else 2 * n
+    if isinstance(parts, dict):
+        # a replayed flight bundle carries the schedule as JSON
+        parts = Partitions.from_meta(parts)
     if traffic is not None:
         from . import serving
         if parts is not None:
@@ -97,7 +146,8 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
             sim_kw["n_values"] = nv
         return serving.run_serving(
             "broadcast", traffic, nemesis=spec, mesh=mesh,
-            max_recovery_rounds=max_recovery_rounds, sim_kw=sim_kw)
+            max_recovery_rounds=max_recovery_rounds, sim_kw=sim_kw,
+            telemetry=telemetry, observe_dir=observe_dir)
     if structured == "auto":
         structured = (S.faulted_path_pick((nv + 31) // 32)
                       == "structured")
@@ -118,14 +168,25 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
     inject = make_inject(n, nv)
     target = sim.target_bits(inject)
     clear = spec.clear_round
+    tel_spec = observe.telemetry_setup(
+        telemetry, "broadcast", clear + max_recovery_rounds)
+    tel = (sim.telemetry_state(tel_spec) if tel_spec is not None
+           else None)
     state, _tgt = sim.stage(inject)
     if clear > 0:
-        state = sim.run_staged_fixed(state, clear, donate=True)
+        if tel is None:
+            state = sim.run_staged_fixed(state, clear, donate=True)
+        else:
+            state, tel = sim.run_observed(state, tel, tel_spec,
+                                          clear, donate=True)
     msgs_at_clear = int(state.msgs)
     converged_round = clear if sim.converged(state, target) else None
     while converged_round is None \
             and int(state.t) < clear + max_recovery_rounds:
-        state = sim.step(state)
+        if tel is None:
+            state = sim.step(state)
+        else:
+            state, tel = sim.run_observed(state, tel, tel_spec, 1)
         if sim.converged(state, target):
             converged_round = int(state.t)
     rec = sim.received_node_major(state)
@@ -140,6 +201,16 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                    topology=topology, msgs_total=int(state.msgs),
                    path="structured" if structured else "gather",
                    spec=spec.to_meta())
+    runner_kw = dict(n_values=n_values, topology=topology,
+                     sync_every=sync_every,
+                     structured=bool(structured),
+                     max_recovery_rounds=max_recovery_rounds,
+                     parts=(None if parts is None
+                            else parts.to_meta()))
+    ok = _finish_observed(
+        ok, details, tel, tel_spec, msgs_total=int(state.msgs),
+        observe_dir=observe_dir, workload="broadcast", spec=spec,
+        runner_kw=runner_kw)
     return {"ok": ok, **details}
 
 
@@ -148,7 +219,8 @@ def run_counter_nemesis(spec: NemesisSpec, *,
                         mode: str = "cas", poll_every: int = 2,
                         max_recovery_rounds: int = 64,
                         union_block: "int | str | None" = None,
-                        mesh=None, traffic=None) -> dict:
+                        mesh=None, traffic=None, telemetry=None,
+                        observe_dir=None) -> dict:
     """G-counter under the nemesis: per-node deltas acked at round 0,
     convergence = pending fully drained AND every node's cached read
     equals the KV.  Lost acknowledged writes = the final shortfall
@@ -160,13 +232,15 @@ def run_counter_nemesis(spec: NemesisSpec, *,
     through the fault windows and the serving certifier takes over
     (see :func:`run_broadcast_nemesis`); ``deltas`` is ignored (each
     traffic op adds 1)."""
+    from . import observe
     if traffic is not None:
         from . import serving
         return serving.run_serving(
             "counter", traffic, nemesis=spec, mesh=mesh,
             max_recovery_rounds=max_recovery_rounds,
             sim_kw=dict(mode=mode, poll_every=poll_every,
-                        union_block=union_block))
+                        union_block=union_block),
+            telemetry=telemetry, observe_dir=observe_dir)
     n = spec.n_nodes
     if deltas is None:
         deltas = np.arange(1, n + 1, dtype=np.int32)
@@ -176,8 +250,16 @@ def run_counter_nemesis(spec: NemesisSpec, *,
                      union_block=union_block, mesh=mesh)
     state = sim.add(sim.init_state(), deltas)
     clear = spec.clear_round
+    tel_spec = observe.telemetry_setup(
+        telemetry, "counter", clear + max_recovery_rounds)
+    tel = (sim.telemetry_state(tel_spec) if tel_spec is not None
+           else None)
     if clear > 0:
-        state = sim.run_fused(state, clear)
+        if tel is None:
+            state = sim.run_fused(state, clear)
+        else:
+            state, tel = sim.run_observed(state, tel, tel_spec,
+                                          clear, donate=True)
     msgs_at_clear = int(state.msgs)
 
     def converged(s) -> bool:
@@ -187,7 +269,10 @@ def run_counter_nemesis(spec: NemesisSpec, *,
     converged_round = clear if converged(state) else None
     while converged_round is None \
             and int(state.t) < clear + max_recovery_rounds:
-        state = sim.step(state)
+        if tel is None:
+            state = sim.step(state)
+        else:
+            state, tel = sim.run_observed(state, tel, tel_spec, 1)
         if converged(state):
             converged_round = int(state.t)
     shortfall = acked_sum - sim.kv_value(state) \
@@ -200,6 +285,17 @@ def run_counter_nemesis(spec: NemesisSpec, *,
     details.update(workload="counter", n_nodes=n, mode=mode,
                    acked_sum=acked_sum, kv=sim.kv_value(state),
                    msgs_total=int(state.msgs), spec=spec.to_meta())
+    deltas_kw = (None if np.array_equal(
+        deltas, np.arange(1, n + 1, dtype=np.int32))
+        else [int(d) for d in np.asarray(deltas)])
+    runner_kw = dict(deltas=deltas_kw, mode=mode,
+                     poll_every=poll_every,
+                     max_recovery_rounds=max_recovery_rounds,
+                     union_block=union_block)
+    ok = _finish_observed(
+        ok, details, tel, tel_spec, msgs_total=int(state.msgs),
+        observe_dir=observe_dir, workload="counter", spec=spec,
+        runner_kw=runner_kw)
     return {"ok": ok, **details}
 
 
@@ -256,7 +352,8 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
                       union_block: "int | str | None" = None,
                       commits: bool = True,
                       send_prob: float = 0.7,
-                      mesh=None, traffic=None) -> dict:
+                      mesh=None, traffic=None, telemetry=None,
+                      observe_dir=None) -> dict:
     """Replicated log under the nemesis: seeded send/commit traffic at
     live nodes through the faulted phase, then quiescent recovery.
     Convergence = every node's presence bitset identical (the periodic
@@ -286,6 +383,7 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
     serving certifier takes over (see :func:`run_broadcast_nemesis`);
     the staged-campaign knobs (``workload_seed``/``commits``/
     ``send_prob``/``rounds``/``repl_fast``) are inert in that mode."""
+    from . import observe
     if traffic is not None:
         from . import serving
         return serving.run_serving(
@@ -295,7 +393,8 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
                         max_sends=max_sends,
                         resync_every=resync_every,
                         resync_mode=resync_mode,
-                        union_block=union_block))
+                        union_block=union_block),
+            telemetry=telemetry, observe_dir=observe_dir)
     n = spec.n_nodes
     clear = max(spec.clear_round, rounds or 0)
     sks, svs, crs = stage_kafka_ops(
@@ -306,29 +405,44 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
                    fault_plan=spec.compile(), resync_every=resync_every,
                    resync_mode=resync_mode, repl_fast=repl_fast,
                    union_block=union_block, mesh=mesh)
+    tel_spec = observe.telemetry_setup(
+        telemetry, "kafka", clear + max_recovery_rounds)
+    tel = (sim.telemetry_state(tel_spec) if tel_spec is not None
+           else None)
     state = sim.init_state()
     if clear > 0:
-        state = sim.run_fused(state, sks, svs, crs)
+        if tel is None:
+            state = sim.run_fused(state, sks, svs, crs)
+        else:
+            state, tel = sim.run_observed(state, tel, tel_spec, sks,
+                                          svs, crs, donate=True)
     msgs_at_clear = int(state.msgs)
 
     def converged(s) -> bool:
         pres = np.asarray(s.present)
         return bool((pres == pres[:1]).all())
 
-    def step1(s):
+    def step1(s, tl):
+        if tl is not None:
+            # quiescent observed round: a 1-round empty send batch
+            # through the same scan driver (commit-free — the traced
+            # all--1 commit_req constant, bit-identical to step())
+            sk1 = np.full((1, n, max_sends), -1, np.int32)
+            return sim.run_observed(s, tl, tel_spec, sk1,
+                                    np.zeros_like(sk1))
         if commits:
-            return sim.step(s)
+            return sim.step(s), None
         # send-only campaigns drive quiescent recovery rounds through
         # run_rounds with NO commit operand — the (N, K) all--1
         # commit_req host array a plain step() stages every round is
         # itself O(N²/16) at the large-N shapes
         sk1 = np.full((1, n, max_sends), -1, np.int32)
-        return sim.run_rounds(s, sk1, np.zeros_like(sk1))
+        return sim.run_rounds(s, sk1, np.zeros_like(sk1)), None
 
     converged_round = clear if converged(state) else None
     while converged_round is None \
             and int(state.t) < clear + max_recovery_rounds:
-        state = step1(state)
+        state, tel = step1(state, tel)
         if converged(state):
             converged_round = int(state.t)
 
@@ -349,4 +463,16 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
     details.update(workload="kafka", n_nodes=n, n_keys=n_keys,
                    n_allocated=int(allocated.sum()),
                    msgs_total=int(state.msgs), spec=spec.to_meta())
+    runner_kw = dict(n_keys=n_keys, capacity=capacity,
+                     max_sends=max_sends, resync_every=resync_every,
+                     resync_mode=resync_mode,
+                     workload_seed=workload_seed,
+                     max_recovery_rounds=max_recovery_rounds,
+                     rounds=rounds, repl_fast=repl_fast,
+                     union_block=union_block, commits=commits,
+                     send_prob=send_prob)
+    ok = _finish_observed(
+        ok, details, tel, tel_spec, msgs_total=int(state.msgs),
+        observe_dir=observe_dir, workload="kafka", spec=spec,
+        runner_kw=runner_kw)
     return {"ok": ok, **details}

@@ -1,0 +1,420 @@
+"""Observability harness (PR 8): run manifests, Perfetto timelines,
+and the flight recorder over the device-resident telemetry ring
+(tpu_sim/telemetry.py).
+
+Three artifacts per observed run, all plain JSON:
+
+- **run manifest** (:func:`run_manifest`): the full reproducibility
+  record — workload config, every seeded spec (`NemesisSpec`,
+  `TrafficSpec`, `TelemetrySpec`) as JSON, program fingerprints +
+  analytic/compiled memory + XLA cost analysis per driver
+  (``engine.program_record``), contract verdicts when audited, and the
+  wall/amortized timings.  Schema-checked by :func:`validate_manifest`.
+- **Perfetto / Chrome-trace timeline** (:func:`run_timeline`): rounds
+  as slices (1 round = 1 ms of trace time), fault windows and traffic
+  phases as separate tracks, every telemetry series as a counter
+  track — load the file at ``ui.perfetto.dev`` (or
+  ``chrome://tracing``).  The SAME serializer
+  (:class:`TimelineBuilder`) exports the host-side virtual-network
+  traces (harness/tracing.py ``to_timeline``), so virtual-harness and
+  tpu_sim runs are visually comparable.
+- **flight-recorder bundle** (:func:`write_flight_bundle`): on any
+  checker failure, one atomically-written JSON file carrying the
+  seeds, the fault/traffic/telemetry specs, the recorded series, and
+  the failing checker's details — :func:`replay_bundle` re-runs the
+  scenario from the bundle ALONE and reproduces the same failure
+  (everything in a run is a pure function of its seeded specs, and
+  mesh/off-mesh parity is pinned, so a fuzzer-found failure is a
+  one-file repro).
+
+Also here: :func:`telemetry_setup` (how the scenario runners resolve
+their ``telemetry=`` argument against the ``GG_TELEMETRY`` /
+``GG_TELEMETRY_SERIES`` env knobs) and :func:`profiled` (optional
+``jax.profiler`` capture around driver dispatch; a clean no-op
+wherever the profiler is unavailable, e.g. CPU CI).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import tempfile
+import time
+
+from ..tpu_sim import telemetry as TM
+
+US_PER_ROUND = 1000.0     # 1 round = 1 ms of trace time
+_MAX_ROUND_SLICES = 4096  # timeline cap; longer runs keep counters only
+
+MANIFEST_SCHEMA = "gg-run-manifest/1"
+TIMELINE_SCHEMA = "gg-timeline/1"
+BUNDLE_SCHEMA = "gg-flight-bundle/1"
+
+
+# -- runner-side telemetry resolution ------------------------------------
+
+
+def telemetry_setup(telemetry, workload: str, rounds: int,
+                    traffic: bool = False):
+    """Resolve a scenario runner's ``telemetry=`` argument to a
+    :class:`~..tpu_sim.telemetry.TelemetrySpec` or None:
+
+    - ``None`` (default): consult the ``GG_TELEMETRY`` env switch —
+      off unless ``GG_TELEMETRY=1``;
+    - ``True``/``False``: force on (default spec for this workload,
+      ``GG_TELEMETRY_SERIES``-filtered, ring sized to ``rounds``) or
+      off;
+    - a ``TelemetrySpec``: used as-is (workload/traffic validated).
+    """
+    if telemetry is None:
+        telemetry = TM.enabled()
+    if telemetry is False:
+        return None
+    if telemetry is True:
+        return TM.default_spec(workload, rounds, traffic)
+    spec = telemetry
+    if spec.workload != workload or spec.traffic != traffic:
+        raise ValueError(
+            f"TelemetrySpec(workload={spec.workload!r}, "
+            f"traffic={spec.traffic}) does not match this run "
+            f"(workload={workload!r}, traffic={traffic})")
+    return spec
+
+
+# -- the shared Perfetto serializer --------------------------------------
+
+
+class TimelineBuilder:
+    """Chrome-trace (Perfetto-loadable) event builder — the ONE
+    serializer behind both the tpu_sim telemetry timelines and the
+    virtual-harness trace export (harness/tracing.py), so the two
+    render identically.  Times are microseconds."""
+
+    def __init__(self, name: str = "run") -> None:
+        self.name = name
+        self.events: list[dict] = []
+        self._tids: dict[str, int] = {}
+        self.events.append({"ph": "M", "pid": 1, "tid": 0,
+                            "name": "process_name",
+                            "args": {"name": name}})
+
+    def _tid(self, track: str) -> int:
+        if track not in self._tids:
+            tid = len(self._tids) + 1
+            self._tids[track] = tid
+            self.events.append({"ph": "M", "pid": 1, "tid": tid,
+                                "name": "thread_name",
+                                "args": {"name": track}})
+        return self._tids[track]
+
+    def slice(self, track: str, name: str, ts_us: float,
+              dur_us: float, args: dict | None = None) -> None:
+        ev = {"ph": "X", "pid": 1, "tid": self._tid(track),
+              "name": name, "ts": round(float(ts_us), 3),
+              "dur": round(float(dur_us), 3)}
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def counter(self, track: str, name: str, ts_us: float,
+                value) -> None:
+        # counters are per-(pid, name); the track prefix keeps series
+        # from different subsystems apart in the UI
+        self.events.append({"ph": "C", "pid": 1,
+                            "name": f"{track}/{name}",
+                            "ts": round(float(ts_us), 3),
+                            "args": {name: int(value)}})
+
+    def to_dict(self) -> dict:
+        return {"schema": TIMELINE_SCHEMA,
+                "displayTimeUnit": "ms",
+                "otherData": {"name": self.name,
+                              "us_per_round": US_PER_ROUND},
+                "traceEvents": self.events}
+
+
+def run_timeline(result: dict, *, name: str | None = None) -> dict:
+    """Build the Perfetto timeline of one finished run from its
+    verdict dict (a ``run_*_nemesis`` / ``run_serving`` result):
+    rounds as slices, crash/loss/dup windows as a ``faults`` track,
+    driven/drain phases as a ``traffic`` track, and every recorded
+    telemetry series as a counter track."""
+    u = US_PER_ROUND
+    workload = result.get("workload", "run")
+    tb = TimelineBuilder(name or f"{workload} run")
+    tel = result.get("telemetry") or {}
+    series = tel.get("series") or {}
+    rounds_idx = series.get("_round") or []
+    total = result.get("total_rounds")
+    if total is None:
+        total = (result.get("converged_round")
+                 or result.get("clear_round") or 0)
+    total = max(int(total), (rounds_idx[-1] + 1) if rounds_idx else 0)
+    for t in range(min(total, _MAX_ROUND_SLICES)):
+        tb.slice("rounds", f"round {t}", t * u, u)
+    spec = result.get("spec") or {}
+    for start, end, nodes in spec.get("crash", ()):
+        tb.slice("faults", f"crash nodes={list(nodes)}", start * u,
+                 (end - start) * u, args={"nodes": list(nodes)})
+    if spec.get("loss_rate"):
+        tb.slice("faults", f"loss p={spec['loss_rate']}", 0,
+                 spec.get("loss_until", 0) * u)
+    if spec.get("dup_rate"):
+        tb.slice("faults", f"dup p={spec['dup_rate']}", 0,
+                 spec.get("dup_until", 0) * u)
+    tspec = result.get("traffic") or {}
+    if tspec:
+        until = int(tspec.get("until", 0))
+        tb.slice("traffic", "driven (open-loop arrivals)", 0,
+                 until * u, args={"rate": tspec.get("rate")})
+        if total > until:
+            tb.slice("traffic", "drain", until * u,
+                     (total - until) * u)
+        for start, end, mult in tspec.get("burst", ()):
+            tb.slice("traffic", f"burst x{mult}", start * u,
+                     (end - start) * u)
+    for sname, vals in sorted(series.items()):
+        if sname.startswith("_"):
+            continue
+        for t, v in zip(rounds_idx, vals):
+            tb.counter("telemetry", sname, t * u, v)
+    return tb.to_dict()
+
+
+def validate_timeline(d: dict) -> None:
+    """Loud schema check (the CI smoke gate): raises ValueError on a
+    malformed timeline."""
+    if d.get("schema") != TIMELINE_SCHEMA:
+        raise ValueError(
+            f"timeline schema {d.get('schema')!r} != "
+            f"{TIMELINE_SCHEMA!r}")
+    events = d.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        raise ValueError("timeline has no traceEvents")
+    for ev in events:
+        if ev.get("ph") not in ("M", "X", "C", "i"):
+            raise ValueError(f"unknown event phase {ev.get('ph')!r}")
+        if ev["ph"] in ("X", "C") and "ts" not in ev:
+            raise ValueError(f"event missing ts: {ev}")
+        if ev["ph"] == "X" and "dur" not in ev:
+            raise ValueError(f"slice missing dur: {ev}")
+
+
+# -- run manifests -------------------------------------------------------
+
+
+def run_manifest(result: dict, *, programs: dict | None = None,
+                 contracts: list | None = None,
+                 extra: dict | None = None) -> dict:
+    """Assemble the run manifest from a finished run's verdict dict.
+
+    ``programs``: {name: engine.program_record(...)} — fingerprint,
+    compiled memory footprint, and cost analysis per driver program.
+    ``contracts``: audit rows (tpu_sim/audit.py ``audit_contract``
+    verdicts) when the caller ran them.  Timings, specs, and the
+    checker verdict are lifted from the result itself."""
+    import jax
+
+    timing_keys = ("driven_s", "total_s", "wall_s", "ms_per_round")
+    verdict_keys = ("ok", "clear_round", "converged_round",
+                    "recovery_rounds", "n_lost_writes", "lost_writes",
+                    "arrived", "issued", "deferred", "completed",
+                    "in_flight", "conserved", "lat_p50", "lat_p99",
+                    "lat_max", "msgs_total", "offered_per_round",
+                    "sustained_per_round", "ops_per_sec")
+    spec_keys = ("spec", "traffic", "telemetry")
+    manifest = {
+        "schema": MANIFEST_SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "workload": result.get("workload"),
+        "env": {
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+        },
+        "config": {k: v for k, v in result.items()
+                   if k not in verdict_keys + spec_keys
+                   and k not in timing_keys
+                   and not isinstance(v, (list, dict))},
+        "specs": {k: result[k] for k in spec_keys if k in result},
+        "verdict": {k: result[k] for k in verdict_keys
+                    if k in result},
+        "timings": {k: result[k] for k in timing_keys
+                    if k in result},
+        "programs": programs or {},
+        "contracts": contracts or [],
+    }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def validate_manifest(d: dict) -> None:
+    """Loud schema check (the CI smoke gate)."""
+    if d.get("schema") != MANIFEST_SCHEMA:
+        raise ValueError(
+            f"manifest schema {d.get('schema')!r} != "
+            f"{MANIFEST_SCHEMA!r}")
+    for key in ("workload", "env", "specs", "verdict"):
+        if key not in d:
+            raise ValueError(f"manifest missing {key!r}")
+    if "ok" not in d["verdict"]:
+        raise ValueError("manifest verdict missing 'ok'")
+    for name, rec in (d.get("programs") or {}).items():
+        if "fingerprint" not in rec:
+            raise ValueError(
+                f"program record {name!r} missing fingerprint")
+
+
+# -- atomic JSON writes --------------------------------------------------
+
+
+def write_json_atomic(path: str, payload: dict) -> str:
+    """Write ``payload`` as JSON via tmp-file + ``os.replace`` — the
+    flight-recorder durability contract: a reader (or a crashed
+    writer) can never observe a half-written artifact."""
+    path = os.fspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".",
+        prefix=os.path.basename(path) + ".tmp.")
+    try:
+        with os.fdopen(fd, "w") as fp:
+            json.dump(payload, fp, indent=1, sort_keys=True)
+            fp.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+# -- flight recorder -----------------------------------------------------
+
+
+def write_flight_bundle(out_dir: str, *, kind: str, workload: str,
+                        nemesis: dict | None = None,
+                        traffic: dict | None = None,
+                        sim_kw: dict | None = None,
+                        runner_kw: dict | None = None,
+                        telemetry_spec: dict | None = None,
+                        telemetry_series: dict | None = None,
+                        failure: dict | None = None) -> str:
+    """Write the one-file repro bundle for a failed run (module
+    docstring).  ``kind``: ``"nemesis"`` (a ``run_*_nemesis``
+    campaign) or ``"serving"`` (a ``run_serving`` open-loop run).
+    Everything needed to replay rides inside; the write is atomic."""
+    if kind not in ("nemesis", "serving"):
+        raise ValueError(f"unknown bundle kind {kind!r}")
+    bundle = {
+        "schema": BUNDLE_SCHEMA,
+        "created_unix": round(time.time(), 3),
+        "kind": kind,
+        "workload": workload,
+        "nemesis": nemesis,
+        "traffic": traffic,
+        "sim_kw": sim_kw or {},
+        "runner_kw": runner_kw or {},
+        "telemetry_spec": telemetry_spec,
+        "telemetry_series": telemetry_series,
+        "failure": failure or {},
+    }
+    seed_bits = []
+    if nemesis:
+        seed_bits.append(f"n{nemesis.get('seed', 0)}")
+    if traffic:
+        seed_bits.append(f"t{traffic.get('seed', 0)}")
+    stem = (f"flight_{workload}_{kind}_"
+            f"{'_'.join(seed_bits) or 'seedless'}")
+    # never clobber an earlier failure's repro: distinct failures can
+    # share (workload, kind, seeds) — e.g. a fuzzer sweeping bounds —
+    # so suffix until the name is free
+    path = os.path.join(out_dir, f"{stem}.json")
+    i = 2
+    while os.path.exists(path):
+        path = os.path.join(out_dir, f"{stem}_{i}.json")
+        i += 1
+    return write_json_atomic(path, bundle)
+
+
+def load_bundle(path_or_dict) -> dict:
+    if isinstance(path_or_dict, dict):
+        bundle = path_or_dict
+    else:
+        with open(path_or_dict) as fp:
+            bundle = json.load(fp)
+    if bundle.get("schema") != BUNDLE_SCHEMA:
+        raise ValueError(
+            f"not a flight bundle (schema "
+            f"{bundle.get('schema')!r} != {BUNDLE_SCHEMA!r})")
+    return bundle
+
+
+def replay_bundle(path_or_dict, *, telemetry=False) -> dict:
+    """Re-run a flight bundle's scenario from its own JSON alone and
+    return the fresh verdict dict — the repro contract: every run is
+    a pure function of its seeded specs (and sim results are pinned
+    bit-exact across mesh layouts), so the replay reproduces the
+    recorded failure.  Telemetry is off by default on replay (the
+    bundle already carries the series); pass ``telemetry=True`` to
+    re-record."""
+    from ..tpu_sim.faults import NemesisSpec
+    from ..tpu_sim.traffic import TrafficSpec
+    from . import nemesis as NM
+    from . import serving as SV
+
+    bundle = load_bundle(path_or_dict)
+    spec = (NemesisSpec.from_meta(bundle["nemesis"])
+            if bundle.get("nemesis") else None)
+    if bundle["kind"] == "serving":
+        if not bundle.get("traffic"):
+            raise ValueError("serving bundle has no traffic spec")
+        kw = dict(bundle.get("runner_kw") or {})
+        return SV.run_serving(
+            bundle["workload"], TrafficSpec.from_meta(bundle["traffic"]),
+            nemesis=spec, sim_kw=bundle.get("sim_kw") or {},
+            telemetry=telemetry, **kw)
+    runners = {"broadcast": NM.run_broadcast_nemesis,
+               "counter": NM.run_counter_nemesis,
+               "kafka": NM.run_kafka_nemesis}
+    if spec is None:
+        raise ValueError("nemesis bundle has no NemesisSpec")
+    kw = dict(bundle.get("runner_kw") or {})
+    if bundle.get("traffic"):
+        kw["traffic"] = TrafficSpec.from_meta(bundle["traffic"])
+    return runners[bundle["workload"]](spec, telemetry=telemetry,
+                                       **kw)
+
+
+# -- optional jax.profiler capture ---------------------------------------
+
+
+@contextlib.contextmanager
+def profiled(out_dir: str | None):
+    """Optional ``jax.profiler`` capture around driver dispatch:
+    ``with observe.profiled(dir):`` traces into ``dir`` when the
+    profiler is available, and is a clean NO-OP when it is not (CPU
+    CI, missing tensorboard plugins) or when ``out_dir`` is None —
+    observability must never fail a run."""
+    if out_dir is None:
+        yield None
+        return
+    import jax
+
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        jax.profiler.start_trace(out_dir)
+    except Exception:
+        yield None
+        return
+    try:
+        yield out_dir
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass

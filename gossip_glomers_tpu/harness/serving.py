@@ -24,6 +24,7 @@ generalization of ``check_recovery``'s ``degraded_throughput``).
 
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -122,7 +123,9 @@ def run_serving(kind: str, tspec: "traffic.TrafficSpec", *,
                 sim_kw: dict | None = None,
                 max_recovery_rounds: int = 96,
                 drain_every: int = 8,
-                series: bool = False, sim=None) -> dict:
+                series: bool = False, sim=None,
+                telemetry=None, observe_dir=None,
+                latency_bound: dict | None = None) -> dict:
     """One open-loop serving run, certified (module docstring).
 
     Returns the merged ``check_recovery`` details dict: ``ok`` (bounded
@@ -134,32 +137,55 @@ def run_serving(kind: str, tspec: "traffic.TrafficSpec", *,
 
     ``sim``: a prebuilt sim to reuse (the curve sweep passes one so
     every load shares ONE compiled traffic program — the drivers cache
-    by ``TrafficSpec.program_key``, and rate rides the traced plan)."""
+    by ``TrafficSpec.program_key``, and rate rides the traced plan).
+
+    PR 8: ``telemetry`` (None = the ``GG_TELEMETRY`` env switch /
+    True / False / a ``TelemetrySpec(traffic=True)``) records the
+    per-round device telemetry ring through every phase and
+    cross-checks it against the tracker
+    (``checkers.check_telemetry``); ``latency_bound`` (kwargs for
+    ``checkers.check_op_latency``, e.g. ``{"p99_max_rounds": 8}``)
+    ANDs a per-op latency bound into the verdict; ``observe_dir``
+    gets the flight-recorder repro bundle on any failure."""
+    from . import observe
+    from ..tpu_sim import telemetry as TM
     if sim is None:
         sim, state = make_serving_sim(kind, tspec, nemesis=nemesis,
                                       mesh=mesh, **(sim_kw or {}))
     else:
         state = _fresh_state(kind, sim)
     ts = sim.traffic_state(tspec)
-    t0 = time.perf_counter()
-    state, ts = sim.run_traffic(state, ts, tspec, tspec.until,
-                                donate=True)
-    jax.block_until_ready(ts.completed)
-    driven_s = time.perf_counter() - t0
     clear = max(tspec.until,
                 nemesis.clear_round if nemesis is not None else 0)
+    tel_spec = observe.telemetry_setup(
+        telemetry, kind, clear + max_recovery_rounds, True)
+    tel = (TM.init_state(tel_spec) if tel_spec is not None else None)
+
+    def drive(st, tr, tl, n):
+        if tl is None:
+            st, tr = sim.run_traffic(st, tr, tspec, n, donate=True)
+            return st, tr, None
+        return sim.run_traffic(st, tr, tspec, n, donate=True,
+                               tel=tl, tel_spec=tel_spec)
+
+    t0 = time.perf_counter()
+    # optional jax.profiler capture around the driven-phase dispatch
+    # (observe.profiled: a clean no-op unless GG_PROFILE_DIR is set
+    # and the profiler is available — e.g. not on CPU CI)
+    with observe.profiled(os.environ.get("GG_PROFILE_DIR")):
+        state, ts, tel = drive(state, ts, tel, tspec.until)
+        jax.block_until_ready(ts.completed)
+    driven_s = time.perf_counter() - t0
     if clear > tspec.until:
         # faults outlast the traffic horizon: keep the system running
         # (arrival coins are off past `until`) until the plan clears
-        state, ts = sim.run_traffic(state, ts, tspec,
-                                    clear - tspec.until, donate=True)
+        state, ts, tel = drive(state, ts, tel, clear - tspec.until)
     msgs_at_clear = int(state.msgs)
     drained = 0
     while (int(ts.completed) < int(np.asarray(ts.issued_k).sum())
            and drained < max_recovery_rounds):
         step = min(drain_every, max_recovery_rounds - drained)
-        state, ts = sim.run_traffic(state, ts, tspec, step,
-                                    donate=True)
+        state, ts, tel = drive(state, ts, tel, step)
         drained += step
     total_s = time.perf_counter() - t0
     summ = traffic.latency_summary(ts)
@@ -178,6 +204,12 @@ def run_serving(kind: str, tspec: "traffic.TrafficSpec", *,
         msgs_at_clear=msgs_at_clear, msgs_at_converged=int(state.msgs),
         latency=summ)
     ok = ok and summ["conserved"]
+    if latency_bound is not None:
+        from .checkers import check_op_latency
+        ok_lat, lat_details = check_op_latency(summ, **latency_bound)
+        ok = ok and ok_lat
+        details["latency_bound"] = {"kw": latency_bound,
+                                    **lat_details}
     total_rounds = clear + drained
     details.update(
         workload=kind, n_nodes=tspec.n_nodes, mesh=(
@@ -210,6 +242,31 @@ def run_serving(kind: str, tspec: "traffic.TrafficSpec", *,
                 "recovery_completions_per_round": (
                     float(after.mean()) if after.size else None),
             }
+    tel_series = tel_meta = None
+    if tel is not None:
+        from .checkers import check_telemetry
+        tel_series = TM.series_arrays(tel, tel_spec)
+        ok_t, t_det = check_telemetry(
+            tel_series, msgs_total=int(state.msgs), traffic=summ)
+        details["telemetry"] = {"spec": tel_spec.to_meta(),
+                                "series": tel_series, "check": t_det}
+        tel_meta = tel_spec.to_meta()
+        ok = ok and ok_t
+    if not ok and observe_dir is not None:
+        failure = {k: details[k] for k in
+                   ("recovery_rounds", "n_lost_writes", "lost_writes",
+                    "conserved", "latency_bound")
+                   if k in details}
+        details["flight_bundle"] = observe.write_flight_bundle(
+            observe_dir, kind="serving", workload=kind,
+            nemesis=(nemesis.to_meta() if nemesis is not None
+                     else None),
+            traffic=tspec.to_meta(), sim_kw=sim_kw or {},
+            runner_kw=dict(max_recovery_rounds=max_recovery_rounds,
+                           drain_every=drain_every,
+                           latency_bound=latency_bound),
+            telemetry_spec=tel_meta, telemetry_series=tel_series,
+            failure=failure)
     return {"ok": ok, **details}
 
 

@@ -48,6 +48,28 @@ def load_jsonl(fp: IO[str]) -> list[tuple[float, Message]]:
     return out
 
 
+def to_timeline(trace: list[tuple[float, Message]], *,
+                name: str = "virtual-harness",
+                us_per_s: float = 1e6) -> dict:
+    """Export a captured virtual-network trace to the SAME
+    Perfetto/Chrome-trace format the tpu_sim telemetry timelines use
+    (harness/observe.py :class:`~.observe.TimelineBuilder`), so
+    virtual-harness and tpu_sim runs are visually comparable: one
+    thread per source id, a slice per routed message at its virtual
+    timestamp, and a cumulative message counter track."""
+    from .observe import TimelineBuilder
+
+    tb = TimelineBuilder(name)
+    total = 0
+    for t, msg in trace:
+        ts = t * us_per_s
+        tb.slice(f"src {msg.src}", msg.type, ts, 1.0,
+                 args={"dest": msg.dest})
+        total += 1
+        tb.counter("net", "msgs_total", ts, total)
+    return tb.to_dict()
+
+
 def summarize(trace: list[tuple[float, Message]],
               server_prefix: str = "n",
               nodes: set[str] | None = None,

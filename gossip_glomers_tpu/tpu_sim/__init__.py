@@ -46,6 +46,13 @@ Modules:
   next to the FaultPlan), the per-op completion-round tracker behind
   the p50/p99 serving-latency reports, and the loud backpressure
   accounting (see ARCHITECTURE.md "Open-loop traffic").
+- :mod:`.telemetry` — flight-recorder telemetry (PR 8): the
+  device-resident per-round metrics ring (``TelemetrySpec`` →
+  ``TelemetryState`` carry, psum-of-partials, donated with the
+  state) behind the sims' ``run_observed`` / ``run_traffic(tel=)``
+  drivers and harness/observe.py's manifests, Perfetto timelines,
+  and flight-recorder repro bundles (see ARCHITECTURE.md
+  "Observability").
 """
 
 from .broadcast import (BroadcastSim, BroadcastState, Partitions,
@@ -57,6 +64,7 @@ from .kafka import KafkaSim, KafkaState
 from .structured import (FaultedDelayed, StructuredDelays,
                          StructuredFaults, make_delayed,
                          make_delayed_faulted, make_faulted)
+from .telemetry import TelemetrySpec, TelemetryState
 from .traffic import TrafficPlan, TrafficSpec, TrafficState
 from .unique_ids import UniqueIdsSim, UniqueIdsState
 
@@ -68,5 +76,6 @@ __all__ = ["BroadcastSim", "BroadcastState", "Partitions", "make_inject",
            "StructuredDelays", "make_delayed",
            "FaultedDelayed", "make_delayed_faulted",
            "TrafficSpec", "TrafficPlan", "TrafficState",
+           "TelemetrySpec", "TelemetryState",
            "UniqueIdsSim", "UniqueIdsState",
            "EchoSim", "EchoState"]

@@ -375,10 +375,11 @@ def audit_contract(contract: ProgramContract, mesh=None) -> dict:
 
 def default_registry() -> list[ProgramContract]:
     """Every registered driver contract, collected from the sims (each
-    stateful sim module owns its own ``audit_contracts()``)."""
-    from . import broadcast, counter, kafka
+    stateful sim module owns its own ``audit_contracts()``; telemetry
+    registers the observed-driver rows, PR 8)."""
+    from . import broadcast, counter, kafka, telemetry
     out: list[ProgramContract] = []
-    for mod in (broadcast, counter, kafka):
+    for mod in (broadcast, counter, kafka, telemetry):
         out.extend(mod.audit_contracts())
     names = [c.name for c in out]
     if len(set(names)) != len(names):
@@ -432,16 +433,29 @@ def _traffic_roots() -> str:
                             for n in traffic.TRACED_EVALUATORS) + ")$")
 
 
+def _telemetry_roots() -> str:
+    # telemetry.py declares its split the same way (PR 8; totality
+    # pinned by tests/test_telemetry.py)
+    from . import telemetry
+    return ("^(" + "|".join(re.escape(n)
+                            for n in telemetry.TRACED_EVALUATORS)
+            + ")$")
+
+
 _TRACED_ROOTS: dict[str, str] = {
     "tpu_sim/broadcast.py":
         r"^(_round|flood_step$|_wm_round_single$|_sharded_round"
         r"|_live_rows$|_edge_live$|_popcount$|_flood_loop$"
-        r"|_flood_ledger$|_traffic_inject$|_traffic_done$)",
-    "tpu_sim/counter.py": r"^(_round$|_reach$|_traffic_round$)",
+        r"|_flood_ledger$|_traffic_inject$|_traffic_done$"
+        r"|_tel_series$|_traffic_tel$)",
+    "tpu_sim/counter.py":
+        r"^(_round$|_reach$|_traffic_round$|_tel_series$)",
     "tpu_sim/kafka.py":
-        r"^(_round$|_rank_within_key$|_alloc$|_traffic_round$)",
+        r"^(_round$|_rank_within_key$|_alloc$|_traffic_round$"
+        r"|_tel_series$)",
     "tpu_sim/faults.py": _faults_roots(),
     "tpu_sim/traffic.py": _traffic_roots(),
+    "tpu_sim/telemetry.py": _telemetry_roots(),
     "tpu_sim/engine.py":
         r"^(sharded_roll$|sharded_shift$|collectives$|fori_rounds$"
         r"|windows_fold$|scan_blocks$|scan_rounds$|while_converge$)",

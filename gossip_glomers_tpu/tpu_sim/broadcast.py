@@ -43,7 +43,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import faults, traffic
+from . import faults, telemetry, traffic
 from .engine import (collectives, donate_argnums_for, fori_rounds,
                      jit_program, resolve_block, scan_blocks,
                      shard_map, stepwise_converge, while_converge,
@@ -85,6 +85,26 @@ class Partitions(NamedTuple):
         return Partitions(jnp.zeros((0,), jnp.int32),
                           jnp.zeros((0,), jnp.int32),
                           jnp.zeros((0, n_nodes), jnp.int8))
+
+    def to_meta(self) -> dict:
+        """JSON-able form — the flight-recorder bundle carries the
+        schedule so a partition-campaign failure replays from the
+        bundle alone (harness/observe.py)."""
+        return {"starts": [int(v) for v in np.asarray(self.starts)],
+                "ends": [int(v) for v in np.asarray(self.ends)],
+                "group": np.asarray(self.group).tolist()}
+
+    @staticmethod
+    def from_meta(meta: dict) -> "Partitions":
+        group = np.asarray(meta["group"], dtype=np.int8)
+        if group.ndim != 2:
+            raise ValueError(
+                f"Partitions meta group must be (P, N), got shape "
+                f"{group.shape}")
+        return Partitions(
+            jnp.asarray(np.asarray(meta["starts"], np.int32)),
+            jnp.asarray(np.asarray(meta["ends"], np.int32)),
+            jnp.asarray(group))
 
 
 class BroadcastState(NamedTuple):
@@ -1184,6 +1204,8 @@ class BroadcastSim:
         # (runner, flood parts | None) pair (fixed) — see _build_fixed
         self._fused = {}
         self._fixed = {}
+        # telemetry-on observed drivers (PR 8)
+        self._obs_progs = {}
         # open-loop traffic drivers, keyed by (TrafficSpec, donate)
         self._traffic_progs = {}
 
@@ -2039,6 +2061,182 @@ class BroadcastSim:
         return (lambda state, nbrs, nbr_mask: run_g(
             state, nbrs, nbr_mask, self.parts, *fp_args)), None
 
+    # -- flight-recorder telemetry (PR 8) ----------------------------------
+
+    def _tel_series(self, s0: BroadcastState, s1: BroadcastState,
+                    plan, reduce_sum) -> tuple:
+        """One round's telemetry row (telemetry.SIM_SERIES
+        ['broadcast'] order), traced: liveness from the replicated
+        plan, frontier/new/known popcounts as per-shard partials
+        globalized in ONE packed ``reduce_sum`` (node AND word shards
+        partition the bit counts, so the psum over all mesh axes is
+        exact), and the value-message running total.
+        Layout-agnostic: the popcount sums reduce the whole local
+        block, node-major or words-major."""
+        def pc(x):
+            return jnp.sum(lax.population_count(x).astype(jnp.uint32),
+                           dtype=jnp.uint32)
+
+        g = reduce_sum(jnp.stack(
+            [pc(s0.frontier), pc(s1.frontier), pc(s1.received)]))
+        return (telemetry.live_count(plan, s0.t, self.n_nodes),
+                g[0], g[1], g[2], s1.msgs)
+
+    def _build_observed(self, tspec: "telemetry.TelemetrySpec",
+                        donate: bool):
+        """The telemetry-on fused driver: the generic fixed-loop round
+        bodies (gather and words-major, single-device and mesh)
+        unchanged, a (state, ring) carry with a DYNAMIC trip count,
+        the ring donated with the state.  Delay-ring modes are not
+        wired (the traffic drivers' restriction)."""
+        if tspec.workload != "broadcast" or tspec.traffic:
+            raise ValueError(
+                "run_observed needs a TelemetrySpec(workload="
+                "'broadcast', traffic=False); open-loop runs record "
+                "through run_traffic(tel=...)")
+        if (self.delays is not None or self._delayed is not None
+                or self._edge is not None or self._nem_delayed):
+            raise ValueError(
+                "observed drivers run the 1-hop gather and "
+                "words-major paths; delay-ring modes are not wired")
+        parts, sync_every = self.parts, self.sync_every
+        wm = self.words_major
+        mesh = self.mesh
+        dn = donate_argnums_for(donate, 0, 1)
+        tel_mask = tspec.static_mask
+        has_nem = self._nem is not None
+
+        if mesh is None:
+            extra = self._wm_extra_args() + self._fp_mesh_extra()[1]
+
+            @functools.partial(jax.jit, donate_argnums=dn)
+            def run(state: BroadcastState, tel, n, nbrs, nbr_mask,
+                    deg, *rest):
+                if wm:
+                    plan = rest[3] if has_nem else None
+                else:
+                    plan = rest[0] if rest else None
+
+                def one(c):
+                    s, tl = c
+                    if wm:
+                        s2 = self._wm_round_single(s, deg,
+                                                   rest or None)
+                    else:
+                        s2 = flood_step(
+                            s, nbrs=nbrs, nbr_mask=nbr_mask,
+                            parts=parts, sync_every=sync_every,
+                            delays=self.delays,
+                            delay_set=self._delay_set, plan=plan,
+                            dup_on=self._fp_dup, union_block=self._ub)
+                    return (s2, telemetry.record(
+                        tl, s.t,
+                        self._tel_series(s, s2, plan, lambda x: x),
+                        tel_mask))
+
+                return fori_rounds(one, (state, tel), n)
+
+            def args_fn(state, tel, n):
+                return (state, tel, n, self.nbrs, self.nbr_mask,
+                        self.deg) + extra
+
+            runner = lambda state, tel, n: run(*args_fn(state, tel,
+                                                        n))
+            return run, args_fn, runner
+
+        state_spec, node_spec, part_spec = self._specs()
+        tel_in = telemetry.state_specs()
+        axes = tuple(mesh.axis_names)
+
+        if wm:
+            extra_specs, extra_args = self._wm_mesh_extra()
+
+            @functools.partial(jax.jit, donate_argnums=dn)
+            @functools.partial(
+                shard_map, mesh=mesh,
+                in_specs=(state_spec, tel_in, P(), P("nodes"))
+                + extra_specs,
+                out_specs=(state_spec, tel_in), check_vma=False,
+            )
+            def run_wm(state: BroadcastState, tel, n, deg, *masks):
+                plan = masks[3] if has_nem else None
+                rs = lambda s: lax.psum(s, axes)   # noqa: E731
+
+                def one(c):
+                    s, tl = c
+                    s2 = self._sharded_round_wm(s, deg,
+                                                masks or None)
+                    return (s2, telemetry.record(
+                        tl, s.t, self._tel_series(s, s2, plan, rs),
+                        tel_mask))
+
+                return fori_rounds(one, (state, tel), n)
+
+            def args_fn(state, tel, n):
+                return (state, tel, n, self.deg) + extra_args
+
+            runner = lambda state, tel, n: run_wm(
+                *args_fn(state, tel, n))
+            return run_wm, args_fn, runner
+
+        fp_specs, fp_args = self._fp_mesh_extra()
+
+        @functools.partial(jax.jit, donate_argnums=dn)
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(state_spec, tel_in, P(), node_spec, node_spec,
+                      part_spec) + fp_specs,
+            out_specs=(state_spec, tel_in), check_vma=False,
+        )
+        def run_g(state: BroadcastState, tel, n, nbrs, nbr_mask,
+                  parts_: Partitions, *fp):
+            plan = fp[0] if fp else None
+            rs = lambda s: lax.psum(s, axes)       # noqa: E731
+
+            def one(c):
+                s, tl = c
+                s2 = self._sharded_round(s, nbrs, nbr_mask, parts_,
+                                         None, plan)
+                return (s2, telemetry.record(
+                    tl, s.t, self._tel_series(s, s2, plan, rs),
+                    tel_mask))
+
+            return fori_rounds(one, (state, tel), n)
+
+        def args_fn(state, tel, n):
+            return (state, tel, n, self.nbrs, self.nbr_mask,
+                    self.parts) + fp_args
+
+        runner = lambda state, tel, n: run_g(*args_fn(state, tel, n))
+        return run_g, args_fn, runner
+
+    def telemetry_state(self, tspec) -> "telemetry.TelemetryState":
+        return telemetry.init_state(tspec)
+
+    def run_observed(self, state: BroadcastState, tel, tspec,
+                     n_rounds: int, *, donate: bool = False):
+        """Telemetry-on fused driver: ``n_rounds`` rounds as one
+        device program with the per-round metrics ring recorded next
+        to the state — bit-exact to the telemetry-off drivers (the
+        recorder only reads state).  Returns ``(state, tel)``."""
+        key = (tspec, donate)
+        if key not in self._obs_progs:
+            self._obs_progs[key] = self._build_observed(tspec, donate)
+        return self._obs_progs[key][2](state, tel,
+                                       jnp.int32(n_rounds))
+
+    def audit_observed_program(self, tspec, *, donate: bool = True):
+        """(jitted, example_args) of the observed driver — the handle
+        the contract auditor lowers."""
+        key = (tspec, donate)
+        if key not in self._obs_progs:
+            self._obs_progs[key] = self._build_observed(tspec, donate)
+        prog, args_fn, _ = self._obs_progs[key]
+        state = self.init_state(
+            np.zeros((self.n_nodes, self.n_words), np.uint32))
+        return prog, args_fn(state, telemetry.init_state(tspec),
+                             jnp.int32(4))
+
     # -- drivers -----------------------------------------------------------
 
     # -- open-loop traffic (PR 7) -----------------------------------------
@@ -2142,139 +2340,172 @@ class BroadcastSim:
         return traffic.done_scan(ts, bit_fn, s2.t, coll.reduce_sum,
                                  ub)
 
-    def _build_traffic(self, tspec, donate: bool):
+    def _traffic_tel(self, s_inj, s2, ts2, plan, coll, tel, tel_mask):
+        """Record one traffic round's telemetry row (PR 8): s0 = the
+        post-injection state (arrivals count in this round's frontier
+        gauge), tracker totals appended."""
+        vals = (self._tel_series(s_inj, s2, plan, coll.reduce_sum)
+                + traffic.tel_series(ts2, coll.reduce_sum))
+        return telemetry.record(tel, s_inj.t, vals, tel_mask)
+
+    def _build_traffic(self, tspec, donate: bool, tel_spec=None):
         self._traffic_validate(tspec)
         mesh = self.mesh
         n_sh = 1 if mesh is None else int(mesh.shape["nodes"])
         ub = traffic.traffic_block(tspec.n_clients // n_sh)
-        dn = donate_argnums_for(donate, 0, 1)
+        tl = tel_spec is not None
+        mask = tel_spec.static_mask if tl else None
+        dn = donate_argnums_for(donate, *((0, 1, 2) if tl else (0, 1)))
         wm = self.words_major
         has_nem = self._nem is not None
+
+        def mk_body(round_fn, plan, coll):
+            """The per-round traffic body: inject, round, track —
+            plus the telemetry row when the ring carry rides along."""
+            def body(carry, op):
+                s, t_ = self._traffic_inject(
+                    carry[0], carry[1], tspec, op, plan, coll)
+                s2 = round_fn(s)
+                t2 = self._traffic_done(s2, t_, tspec, coll, ub)
+                if not tl:
+                    return (s2, t2)
+                return (s2, t2, self._traffic_tel(
+                    s, s2, t2, plan, coll, carry[2], mask))
+
+            return body
+
+        def carry_of(state, ts, tel):
+            return (state, ts, tel) if tl else (state, ts)
 
         if mesh is None:
             if wm:
                 extra = self._wm_extra_args()
 
-                def run_wm(state, ts, n, tplan, deg, *masks):
+                def run_wm(state, *rest):
+                    rest = list(rest)
+                    tel = rest.pop(0) if tl else None
+                    ts, n, tplan, deg = (rest[0], rest[1], rest[2],
+                                         rest[3])
+                    masks = tuple(rest[4:])
                     coll = collectives(self.n_nodes)
                     plan = masks[3] if has_nem else None
-
-                    def body(carry, op):
-                        s, t_ = self._traffic_inject(
-                            carry[0], carry[1], tspec, op, plan, coll)
-                        s2 = self._wm_round_single(s, deg,
-                                                   masks or None)
-                        return (s2, self._traffic_done(
-                            s2, t_, tspec, coll, ub))
-
-                    return fori_rounds(body, (state, ts), n,
-                                       operand=tplan)
+                    body = mk_body(
+                        lambda s: self._wm_round_single(
+                            s, deg, masks or None), plan, coll)
+                    return fori_rounds(body, carry_of(state, ts, tel),
+                                       n, operand=tplan)
 
                 prog = jit_program(run_wm, donate_argnums=dn)
 
-                def args_fn(state, ts, n, tplan):
-                    return (state, ts, n, tplan, self.deg) + extra
+                def args_fn(state, ts, n, tplan, tel=None):
+                    pre = (state, tel) if tl else (state,)
+                    return pre + (ts, n, tplan, self.deg) + extra
             else:
                 fp_args = self._fp_mesh_extra()[1]
 
-                def run_g(state, ts, n, tplan, nbrs, nbr_mask, *fp):
+                def run_g(state, *rest):
+                    rest = list(rest)
+                    tel = rest.pop(0) if tl else None
+                    ts, n, tplan, nbrs, nbr_mask = (
+                        rest[0], rest[1], rest[2], rest[3], rest[4])
+                    fp = tuple(rest[5:])
                     coll = collectives(self.n_nodes)
                     plan = fp[0] if fp else None
-
-                    def body(carry, op):
-                        s, t_ = self._traffic_inject(
-                            carry[0], carry[1], tspec, op, plan, coll)
-                        s2 = flood_step(
+                    body = mk_body(
+                        lambda s: flood_step(
                             s, nbrs=nbrs, nbr_mask=nbr_mask,
                             parts=self.parts,
                             sync_every=self.sync_every, plan=plan,
-                            dup_on=self._fp_dup, union_block=self._ub)
-                        return (s2, self._traffic_done(
-                            s2, t_, tspec, coll, ub))
-
-                    return fori_rounds(body, (state, ts), n,
-                                       operand=tplan)
+                            dup_on=self._fp_dup,
+                            union_block=self._ub), plan, coll)
+                    return fori_rounds(body, carry_of(state, ts, tel),
+                                       n, operand=tplan)
 
                 prog = jit_program(run_g, donate_argnums=dn)
 
-                def args_fn(state, ts, n, tplan):
-                    return (state, ts, n, tplan, self.nbrs,
-                            self.nbr_mask) + fp_args
+                def args_fn(state, ts, n, tplan, tel=None):
+                    pre = (state, tel) if tl else (state,)
+                    return pre + (ts, n, tplan, self.nbrs,
+                                  self.nbr_mask) + fp_args
 
-            runner = lambda state, ts, n, tplan: prog(
-                *args_fn(state, ts, n, tplan))
+            runner = lambda state, ts, n, tplan, tel=None: prog(
+                *args_fn(state, ts, n, tplan, tel))
             return prog, args_fn, runner
 
         state_spec, node_spec, part_spec = self._specs()
         t_specs = traffic.state_specs(True)
+        tel_in = (telemetry.state_specs(),) if tl else ()
 
         if wm:
             extra_specs, extra_args = self._wm_mesh_extra()
 
-            def run_wm(state, ts, n, tplan, deg, *masks):
+            def run_wm(state, *rest):
+                rest = list(rest)
+                tel = rest.pop(0) if tl else None
+                ts, n, tplan, deg = (rest[0], rest[1], rest[2],
+                                     rest[3])
+                masks = tuple(rest[4:])
                 coll = collectives(state.received.shape[1], mesh)
                 plan = masks[3] if has_nem else None
-
-                def body(carry, op):
-                    s, t_ = self._traffic_inject(
-                        carry[0], carry[1], tspec, op, plan, coll)
-                    s2 = self._sharded_round_wm(s, deg, masks or None)
-                    return (s2, self._traffic_done(
-                        s2, t_, tspec, coll, ub))
-
-                return fori_rounds(body, (state, ts), n,
+                body = mk_body(
+                    lambda s: self._sharded_round_wm(
+                        s, deg, masks or None), plan, coll)
+                return fori_rounds(body, carry_of(state, ts, tel), n,
                                    operand=tplan)
 
             prog = jit_program(
                 run_wm, mesh=mesh,
-                in_specs=(state_spec, t_specs, P(),
-                          traffic.plan_specs(), P("nodes"))
+                in_specs=(state_spec,) + tel_in
+                + (t_specs, P(), traffic.plan_specs(), P("nodes"))
                 + extra_specs,
-                out_specs=(state_spec, t_specs),
+                out_specs=(state_spec, t_specs) + tel_in,
                 check_vma=False, donate_argnums=dn)
 
-            def args_fn(state, ts, n, tplan):
-                return (state, ts, n, tplan, self.deg) + extra_args
+            def args_fn(state, ts, n, tplan, tel=None):
+                pre = (state, tel) if tl else (state,)
+                return pre + (ts, n, tplan, self.deg) + extra_args
         else:
             fp_specs, fp_args = self._fp_mesh_extra()
 
-            def run_g(state, ts, n, tplan, nbrs, nbr_mask, parts,
-                      *fp):
+            def run_g(state, *rest):
+                rest = list(rest)
+                tel = rest.pop(0) if tl else None
+                ts, n, tplan, nbrs, nbr_mask, parts = (
+                    rest[0], rest[1], rest[2], rest[3], rest[4],
+                    rest[5])
+                fp = tuple(rest[6:])
                 coll = collectives(nbrs.shape[0], mesh)
                 plan = fp[0] if fp else None
-
-                def body(carry, op):
-                    s, t_ = self._traffic_inject(
-                        carry[0], carry[1], tspec, op, plan, coll)
-                    s2 = self._sharded_round(s, nbrs, nbr_mask, parts,
-                                             None, plan)
-                    return (s2, self._traffic_done(
-                        s2, t_, tspec, coll, ub))
-
-                return fori_rounds(body, (state, ts), n,
+                body = mk_body(
+                    lambda s: self._sharded_round(
+                        s, nbrs, nbr_mask, parts, None, plan), plan,
+                    coll)
+                return fori_rounds(body, carry_of(state, ts, tel), n,
                                    operand=tplan)
 
             prog = jit_program(
                 run_g, mesh=mesh,
-                in_specs=(state_spec, t_specs, P(),
-                          traffic.plan_specs(), node_spec, node_spec,
-                          part_spec) + fp_specs,
-                out_specs=(state_spec, t_specs),
+                in_specs=(state_spec,) + tel_in
+                + (t_specs, P(), traffic.plan_specs(), node_spec,
+                   node_spec, part_spec) + fp_specs,
+                out_specs=(state_spec, t_specs) + tel_in,
                 check_vma=False, donate_argnums=dn)
 
-            def args_fn(state, ts, n, tplan):
-                return (state, ts, n, tplan, self.nbrs, self.nbr_mask,
-                        self.parts) + fp_args
+            def args_fn(state, ts, n, tplan, tel=None):
+                pre = (state, tel) if tl else (state,)
+                return pre + (ts, n, tplan, self.nbrs, self.nbr_mask,
+                              self.parts) + fp_args
 
-        runner = lambda state, ts, n, tplan: prog(
-            *args_fn(state, ts, n, tplan))
+        runner = lambda state, ts, n, tplan, tel=None: prog(
+            *args_fn(state, ts, n, tplan, tel))
         return prog, args_fn, runner
 
     def traffic_state(self, tspec) -> "traffic.TrafficState":
         return traffic.init_state(tspec, self.mesh)
 
     def run_traffic(self, state: BroadcastState, ts, tspec,
-                    n_rounds: int, *, donate: bool = False):
+                    n_rounds: int, *, donate: bool = False,
+                    tel=None, tel_spec=None):
         """Open-loop serving driver: ``n_rounds`` rounds as ONE device
         program, each round injecting the spec's seeded client
         arrivals (new values at their home nodes) before the flood/
@@ -2283,29 +2514,35 @@ class BroadcastSim:
         as a traced operand next to the FaultPlan — fault campaigns
         and serving load compose in one fused program, donation
         preserved (``donate`` consumes BOTH the sim state and the
-        tracker).  Programs cache by ``TrafficSpec.program_key``, so a
+        tracker).  ``tel``/``tel_spec`` (PR 8): record the per-round
+        telemetry ring next to the tracker — returns ``(state, ts,
+        tel)``.  Programs cache by ``TrafficSpec.program_key``, so a
         load sweep reuses one compiled program across rates."""
-        key = (tspec.program_key, donate)
+        key = (tspec.program_key, donate,
+               telemetry.tel_key(tel, tel_spec, "broadcast"))
         if key not in self._traffic_progs:
-            self._traffic_progs[key] = self._build_traffic(tspec,
-                                                           donate)
+            self._traffic_progs[key] = self._build_traffic(
+                tspec, donate, tel_spec)
         return self._traffic_progs[key][2](state, ts,
                                            jnp.int32(n_rounds),
-                                           tspec.compile())
+                                           tspec.compile(), tel)
 
-    def audit_traffic_program(self, tspec, *, donate: bool = True):
+    def audit_traffic_program(self, tspec, *, donate: bool = True,
+                              tel_spec=None):
         """(jitted, example_args) of the traffic driver — the handle
         the contract auditor lowers (census + donation of the EXACT
         program :meth:`run_traffic` executes)."""
-        key = (tspec.program_key, donate)
+        key = (tspec.program_key, donate, tel_spec)
         if key not in self._traffic_progs:
-            self._traffic_progs[key] = self._build_traffic(tspec,
-                                                           donate)
+            self._traffic_progs[key] = self._build_traffic(
+                tspec, donate, tel_spec)
         prog, args_fn, _ = self._traffic_progs[key]
         state = self.init_state(
             np.zeros((self.n_nodes, self.n_words), np.uint32))
+        tel = (telemetry.init_state(tel_spec) if tel_spec is not None
+               else None)
         return prog, args_fn(state, self.traffic_state(tspec),
-                             jnp.int32(4), tspec.compile())
+                             jnp.int32(4), tspec.compile(), tel)
 
     def converged(self, state: BroadcastState,
                   target: jnp.ndarray) -> bool:

@@ -42,7 +42,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import faults, traffic
+from . import faults, telemetry, traffic
 from .engine import (Collectives, collectives, donate_argnums_for,
                      fori_rounds, jit_program, resolve_block,
                      scan_blocks)
@@ -179,6 +179,9 @@ class CounterSim:
         self._run_progs: dict = {}
         # open-loop traffic drivers, keyed by (TrafficSpec, donate)
         self._traffic_progs: dict = {}
+        # telemetry-on observed drivers, keyed by (TelemetrySpec,
+        # donate) — PR 8
+        self._obs_progs: dict = {}
         self._step = self._build_step()
         self._run_n = self._build_run_n(donate=False)
         # the donated twin: same traced rounds, state buffers consumed
@@ -448,10 +451,132 @@ class CounterSim:
         must not be used again afterwards."""
         return self._run_n_donated(state, jnp.int32(n_rounds))
 
+    # -- flight-recorder telemetry (PR 8) ----------------------------------
+
+    def _tel_series(self, s0: CounterState, s1: CounterState,
+                    coll: Collectives, sched: KVReach, plan) -> tuple:
+        """One round's telemetry row (telemetry.SIM_SERIES['counter']
+        order), traced: recomputes the round's reach/want gates from
+        the SAME pure evaluators the round used (stateless coins ⇒
+        bit-identical), so flush attempts/acks/conflicts are exact
+        without instrumenting the round body — telemetry reads state,
+        never feeds back into it.  Every partial is evaluated over
+        the LOCAL rows and the whole row globalizes in ONE packed
+        ``reduce_sum`` (a per-scalar psum apiece would multiply the
+        round's collective count — the overhead budget of
+        BENCH_PR8)."""
+        row_ids = coll.row_ids
+        reach = _reach(s0.t, row_ids, sched)
+        pend0 = s0.pending
+        live_loc = jnp.ones(row_ids.shape, bool)
+        if plan is not None:
+            live_loc = faults.node_up(plan, s0.t, row_ids)
+            wipe = faults.amnesia(plan, s0.t, row_ids)
+            pend0 = jnp.where(wipe, 0, pend0)
+            reach = (reach & live_loc
+                     & ~faults.kv_drop(plan, s0.t, row_ids))
+        want = (pend0 > 0) & reach
+        acks = want & (s1.pending == 0)
+
+        def cnt(x):
+            return jnp.sum(x.astype(jnp.uint32), dtype=jnp.uint32)
+
+        g = coll.reduce_sum(jnp.stack(
+            [cnt(live_loc), cnt(s1.pending), cnt(want), cnt(acks)]))
+        return (g[0], g[1], g[2], g[3], g[2] - g[3],
+                s1.kv.astype(jnp.uint32),
+                s1.msgs)
+
+    def _build_run_obs(self, tspec: "telemetry.TelemetrySpec",
+                       donate: bool):
+        """The telemetry-on fused driver: the round unchanged, a
+        (state, ring) carry, the ring donated WITH the state."""
+        if tspec.workload != "counter" or tspec.traffic:
+            raise ValueError(
+                "run_observed needs a TelemetrySpec(workload="
+                "'counter', traffic=False); open-loop runs record "
+                "through run_traffic(tel=...)")
+        mesh = self.mesh
+        dn = donate_argnums_for(donate, 0, 1)
+        fp_specs, fp_args = self._fp_extra()
+        tel_mask = tspec.static_mask
+
+        def one(carry, sched, coll, plan):
+            s, tel = carry
+            s2 = self._round(s, coll, sched, plan)
+            return (s2, telemetry.record(
+                tel, s.t,
+                self._tel_series(s, s2, coll, sched, plan), tel_mask))
+
+        if mesh is None:
+            def run_n(state, tel, n, *fp):
+                coll = collectives(self.n_nodes)
+                plan = fp[0] if fp else None
+                return fori_rounds(
+                    lambda c: one(c, self.kv_sched, coll, plan),
+                    (state, tel), n)
+
+            prog = jit_program(run_n, donate_argnums=dn)
+
+            def args_fn(state, tel, n):
+                return (state, tel, n) + fp_args
+        else:
+            sched_spec = KVReach(P(), P(), P(None, None))
+
+            def run_n(state, tel, sched, n, *fp):
+                coll = collectives(state.pending.shape[0], mesh)
+                plan = fp[0] if fp else None
+                return fori_rounds(lambda c: one(c, sched, coll, plan),
+                                   (state, tel), n)
+
+            prog = jit_program(
+                run_n, mesh=mesh,
+                in_specs=(self._state_spec(), telemetry.state_specs(),
+                          sched_spec, P()) + fp_specs,
+                out_specs=(self._state_spec(),
+                           telemetry.state_specs()),
+                check_vma=False, donate_argnums=dn)
+
+            def args_fn(state, tel, n):
+                return (state, tel, self.kv_sched, n) + fp_args
+
+        runner = lambda state, tel, n: prog(*args_fn(state, tel, n))
+        return prog, args_fn, runner
+
+    def telemetry_state(self, tspec) -> "telemetry.TelemetryState":
+        return telemetry.init_state(tspec)
+
+    def run_observed(self, state: CounterState, tel, tspec,
+                     n_rounds: int, *, donate: bool = False):
+        """Telemetry-on :meth:`run_fused`: ``n_rounds`` rounds as one
+        device program with the per-round metrics ring recorded next
+        to the state (tpu_sim/telemetry.py) — bit-exact to the
+        telemetry-off drivers (the recorder only reads state).  With
+        ``donate`` both the state and the ring are consumed.  Returns
+        ``(state, tel)``."""
+        key = (tspec, donate)
+        if key not in self._obs_progs:
+            self._obs_progs[key] = self._build_run_obs(tspec, donate)
+        return self._obs_progs[key][2](state, tel,
+                                       jnp.int32(n_rounds))
+
+    def audit_observed_program(self, tspec, *, donate: bool = True):
+        """(jitted, example_args) of the observed driver — the handle
+        the contract auditor lowers (census + donation of the EXACT
+        program :meth:`run_observed` executes)."""
+        key = (tspec, donate)
+        if key not in self._obs_progs:
+            self._obs_progs[key] = self._build_run_obs(tspec, donate)
+        prog, args_fn, _ = self._obs_progs[key]
+        return prog, args_fn(self.init_state(),
+                             telemetry.init_state(tspec),
+                             jnp.int32(8))
+
     # -- open-loop traffic (PR 7) -----------------------------------------
 
     def _traffic_round(self, state: CounterState, ts, tspec, tplan,
-                       sched: KVReach, coll: Collectives, plan, ub):
+                       sched: KVReach, coll: Collectives, plan, ub,
+                       tel=None, tel_mask=None):
         """One traffic-injected round (traced): classify this round's
         arrivals (home node down → deferred; per-node ``intake`` cap →
         deferred; op slots exhausted → deferred), fold the accepted
@@ -521,10 +646,18 @@ class CounterSim:
             return (a >= 0) & (min_cached >= a)
 
         ts = traffic.done_scan(ts, bit_fn, s2.t, coll.reduce_sum, ub)
-        return s2, ts
+        if tel is None:
+            return s2, ts
+        # telemetry row (PR 8): s0 = the post-injection state (this
+        # round's arrivals count as pending adds), tracker totals
+        # appended — recorded AFTER the tracker advanced, so the ring
+        # cross-checks the final ledgers exactly
+        vals = (self._tel_series(state, s2, coll, sched, plan)
+                + traffic.tel_series(ts, coll.reduce_sum))
+        return s2, ts, telemetry.record(tel, state.t, vals, tel_mask)
 
     def _build_traffic(self, tspec: "traffic.TrafficSpec",
-                       donate: bool):
+                       donate: bool, tel_spec=None):
         if tspec.n_nodes != self.n_nodes:
             raise ValueError(
                 f"TrafficSpec is for {tspec.n_nodes} nodes, sim has "
@@ -536,43 +669,64 @@ class CounterSim:
                 f"n_clients={tspec.n_clients} must shard evenly over "
                 f"the {n_sh}-way node axis")
         ub = traffic.traffic_block(tspec.n_clients // n_sh)
-        dn = donate_argnums_for(donate, 0, 1)
+        tl = tel_spec is not None
+        mask = tel_spec.static_mask if tl else None
+        dn = donate_argnums_for(donate, *((0, 1, 2) if tl else (0, 1)))
         fp_specs, fp_args = self._fp_extra()
 
+        def body(c, op, sched, coll, plan):
+            if tl:
+                return self._traffic_round(
+                    c[0], c[1], tspec, op, sched, coll, plan, ub,
+                    tel=c[2], tel_mask=mask)
+            return self._traffic_round(
+                c[0], c[1], tspec, op, sched, coll, plan, ub)
+
         if mesh is None:
-            def run(state, ts, n, tplan, sched, *fp):
+            def run(state, *rest):
+                rest = list(rest)
+                tel = rest.pop(0) if tl else None
+                ts, n, tplan, sched = rest[0], rest[1], rest[2], rest[3]
+                fp = rest[4:]
                 coll = collectives(self.n_nodes)
                 plan = fp[0] if fp else None
+                carry = (state, ts, tel) if tl else (state, ts)
                 return fori_rounds(
-                    lambda c, op: self._traffic_round(
-                        c[0], c[1], tspec, op, sched, coll, plan, ub),
-                    (state, ts), n, operand=tplan)
+                    lambda c, op: body(c, op, sched, coll, plan),
+                    carry, n, operand=tplan)
 
             prog = jit_program(run, donate_argnums=dn)
         else:
             sched_spec = KVReach(P(), P(), P(None, None))
             t_specs = traffic.state_specs(True)
 
-            def run(state, ts, n, tplan, sched, *fp):
+            def run(state, *rest):
+                rest = list(rest)
+                tel = rest.pop(0) if tl else None
+                ts, n, tplan, sched = rest[0], rest[1], rest[2], rest[3]
+                fp = rest[4:]
                 coll = collectives(state.pending.shape[0], mesh)
                 plan = fp[0] if fp else None
+                carry = (state, ts, tel) if tl else (state, ts)
                 return fori_rounds(
-                    lambda c, op: self._traffic_round(
-                        c[0], c[1], tspec, op, sched, coll, plan, ub),
-                    (state, ts), n, operand=tplan)
+                    lambda c, op: body(c, op, sched, coll, plan),
+                    carry, n, operand=tplan)
 
+            tel_in = (telemetry.state_specs(),) if tl else ()
             prog = jit_program(
                 run, mesh=mesh,
-                in_specs=(self._state_spec(), t_specs, P(),
-                          traffic.plan_specs(), sched_spec) + fp_specs,
-                out_specs=(self._state_spec(), t_specs),
+                in_specs=(self._state_spec(),) + tel_in
+                + (t_specs, P(), traffic.plan_specs(), sched_spec)
+                + fp_specs,
+                out_specs=(self._state_spec(), t_specs) + tel_in,
                 check_vma=False, donate_argnums=dn)
 
-        def args_fn(state, ts, n, tplan):
-            return (state, ts, n, tplan, self.kv_sched) + fp_args
+        def args_fn(state, ts, n, tplan, tel=None):
+            pre = (state, tel) if tl else (state,)
+            return pre + (ts, n, tplan, self.kv_sched) + fp_args
 
-        runner = lambda state, ts, n, tplan: prog(
-            *args_fn(state, ts, n, tplan))
+        runner = lambda state, ts, n, tplan, tel=None: prog(
+            *args_fn(state, ts, n, tplan, tel))
         return prog, args_fn, runner
 
     def traffic_state(self, tspec) -> traffic.TrafficState:
@@ -580,7 +734,7 @@ class CounterSim:
 
     def run_traffic(self, state: CounterState,
                     ts: traffic.TrafficState, tspec, n_rounds: int, *,
-                    donate: bool = False):
+                    donate: bool = False, tel=None, tel_spec=None):
         """Open-loop serving driver: ``n_rounds`` rounds as ONE device
         program, each round injecting the spec's seeded arrivals
         before the ordinary flush/poll round and advancing the per-op
@@ -590,30 +744,39 @@ class CounterSim:
         load compose in one fused program.  With ``donate`` both the
         sim state and the tracker are consumed (updated in place).
 
+        ``tel``/``tel_spec`` (PR 8): a telemetry ring + its
+        ``TelemetrySpec(traffic=True)`` — the per-round series record
+        next to the tracker and the call returns ``(state, ts, tel)``
+        (the ring donated with the rest).
+
         Programs are cached by the spec's STATIC shape
         (``TrafficSpec.program_key``): a serving-curve load sweep
         reuses one compiled program across its rates — the plan rides
         as a traced operand."""
-        key = (tspec.program_key, donate)
+        key = (tspec.program_key, donate,
+               telemetry.tel_key(tel, tel_spec, "counter"))
         if key not in self._traffic_progs:
-            self._traffic_progs[key] = self._build_traffic(tspec,
-                                                           donate)
+            self._traffic_progs[key] = self._build_traffic(
+                tspec, donate, tel_spec)
         return self._traffic_progs[key][2](state, ts,
                                            jnp.int32(n_rounds),
-                                           tspec.compile())
+                                           tspec.compile(), tel)
 
-    def audit_traffic_program(self, tspec, *, donate: bool = True):
+    def audit_traffic_program(self, tspec, *, donate: bool = True,
+                              tel_spec=None):
         """(jitted, example_args) of the traffic driver — the handle
         the contract auditor lowers (census + donation of the EXACT
         program :meth:`run_traffic` executes)."""
-        key = (tspec.program_key, donate)
+        key = (tspec.program_key, donate, tel_spec)
         if key not in self._traffic_progs:
-            self._traffic_progs[key] = self._build_traffic(tspec,
-                                                           donate)
+            self._traffic_progs[key] = self._build_traffic(
+                tspec, donate, tel_spec)
         prog, args_fn, _ = self._traffic_progs[key]
+        tel = (telemetry.init_state(tel_spec) if tel_spec is not None
+               else None)
         return prog, args_fn(self.init_state(),
                              self.traffic_state(tspec), jnp.int32(4),
-                             tspec.compile())
+                             tspec.compile(), tel)
 
     # -- reads -------------------------------------------------------------
 

@@ -293,6 +293,9 @@ def fori_rounds(round_fn: Callable, state, rounds, unroll: int = 1,
     return lax.fori_loop(0, rounds, body, state, **kw)
 
 
+_WINDOWS_UNROLL = 8
+
+
 def windows_fold(starts, ends, t, body, init):
     """Fold a windows-as-data fault schedule at round ``t``: for every
     window ``w``, ``carry = body(w, active_w, carry)`` with ``active_w
@@ -300,10 +303,22 @@ def windows_fold(starts, ends, t, body, init):
     every compiled fault mode (partition schedules, crash windows, KV
     reachability): the schedule rides as tiny traced arrays and the
     round re-derives the active set from ``t``, so one program replays
-    any schedule.  Zero windows costs nothing (returns ``init``)."""
+    any schedule.  Zero windows costs nothing (returns ``init``).
+
+    The window count is static, so small schedules (the common case:
+    1-4 windows) UNROLL instead of emitting a ``fori_loop`` — an XLA
+    ``while`` op costs ~a microsecond per round on CPU, which at the
+    small-N shapes is comparable to the round itself (several folds
+    run per faulted round, more with telemetry on).  Identical math
+    either way — bool/int folds carry no reassociation hazard."""
     n_windows = starts.shape[0]
     if n_windows == 0:
         return init
+    if n_windows <= _WINDOWS_UNROLL:
+        carry = init
+        for w in range(n_windows):
+            carry = body(w, (starts[w] <= t) & (t < ends[w]), carry)
+        return carry
     return lax.fori_loop(
         0, n_windows,
         lambda w, c: body(w, (starts[w] <= t) & (t < ends[w]), c),
@@ -515,6 +530,36 @@ def memory_footprint(jitted: Callable, *args, **kw) -> dict | None:
     (and only compiles — use :func:`aot_compile` when the same program
     will also be executed)."""
     return aot_compile(jitted, *args, **kw)[1]
+
+
+def program_record(jitted: Callable, *args, **kw) -> dict:
+    """Compile-only record of one driver program for run manifests
+    (harness/observe.py): a stable ``fingerprint`` (sha256 of the
+    compiled HLO text — two runs executed the same program iff the
+    fingerprints match), the :func:`memory_footprint` dict, and XLA's
+    cost analysis (flops / bytes accessed) when the backend exposes
+    one.  Compiles the program; use on the sims' ``audit_*_program``
+    handles so the recorded program is the EXACT one the run
+    executed."""
+    import hashlib
+
+    compiled = jitted.lower(*args, **kw).compile()
+    hlo = compiled.as_text()
+    rec = {
+        "fingerprint": hashlib.sha256(hlo.encode()).hexdigest()[:16],
+        "memory": _footprint_of(compiled),
+    }
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        rec["cost"] = {k: float(v) for k, v in dict(ca).items()
+                       if k in ("flops", "bytes accessed",
+                                "transcendentals")
+                       and isinstance(v, (int, float))}
+    except Exception:      # cost analysis is best-effort per backend
+        rec["cost"] = None
+    return rec
 
 
 def operand_bytes(tree) -> int:

@@ -88,7 +88,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import faults, traffic
+from . import faults, telemetry, traffic
 from .counter import KVReach, _reach
 from .engine import (analytic_peak_bytes, collectives,
                      donate_argnums_for, fori_rounds, jit_program,
@@ -301,6 +301,8 @@ class KafkaSim:
         self._run_rounds = {}
         self._step_progs = {}
         self._traffic_progs = {}
+        # telemetry-on observed drivers (PR 8)
+        self._obs_progs = {}
         self._poll_batch_fn = None
         self._alloc_fn = None
 
@@ -941,6 +943,143 @@ class KafkaSim:
         return self.run_rounds(state, send_key, send_val, commit_req,
                                repl_ok, donate=True)
 
+    # -- flight-recorder telemetry (PR 8) ----------------------------------
+
+    def _tel_series(self, s0: KafkaState, s1: KafkaState, coll,
+                    plan) -> tuple:
+        """One round's telemetry row (telemetry.SIM_SERIES['kafka']
+        order), traced: per-shard LOCAL partials globalized in ONE
+        packed ``reduce_sum`` — liveness counted over the local rows,
+        and ``present_bits`` as the presence-bitset popcount at the
+        WITNESS node (global row 0): it climbs to ``alloc_total``
+        exactly when every allocated send has replicated to node 0,
+        so the two series together plot replication lag per round.
+        (A full-presence popcount would re-stream the whole O(N·K·C)
+        bitset every round — measured ~18% of the 1,024/10k sweep
+        round; the witness gauge is O(K·C) on one shard.)  The
+        allocated-slot total reads the replicated log content — no
+        collective at all."""
+        row_ids = coll.row_ids
+        live_loc = (jnp.ones(row_ids.shape, bool) if plan is None
+                    else faults.node_up(plan, s0.t, row_ids))
+        wit = jnp.where(
+            row_ids[0] == 0,
+            jnp.sum(lax.population_count(s1.present[0])
+                    .astype(jnp.uint32), dtype=jnp.uint32),
+            jnp.uint32(0))
+        g = coll.reduce_sum(jnp.stack(
+            [jnp.sum(live_loc.astype(jnp.uint32), dtype=jnp.uint32),
+             wit]))
+        alloc = jnp.sum((s1.log_vals >= 0).astype(jnp.uint32),
+                        dtype=jnp.uint32)       # replicated — no psum
+        return (g[0], alloc, g[1], s1.msgs)
+
+    def _build_obs_prog(self, tspec: "telemetry.TelemetrySpec",
+                        has_commits: bool, donate: bool):
+        """Telemetry-on :meth:`_run_prog`: same scan body, a
+        (state, ring) carry donated together."""
+        if tspec.workload != "kafka" or tspec.traffic:
+            raise ValueError(
+                "run_observed needs a TelemetrySpec(workload='kafka', "
+                "traffic=False); open-loop runs record through "
+                "run_traffic(tel=...)")
+        repl_mode = self._repl_mode(None)
+        if repl_mode == "matmul":
+            raise ValueError(
+                "observed drivers ride the origin-union replication "
+                "paths; repl_fast=False pins the matmul oracle")
+        key = (tspec, has_commits, donate)
+        if key in self._obs_progs:
+            return self._obs_progs[key]
+        k_dim = self.n_keys
+        mesh = self.mesh
+        dn = donate_argnums_for(donate, 0, 1)
+        fp = self._fp_active
+        tel_mask = tspec.static_mask
+
+        def run(state, tel, sks, svs, *rest):
+            rest = list(rest)
+            plan = rest.pop() if fp else None
+            sched = rest.pop()
+            coll = collectives(sks.shape[1], mesh)
+
+            def body(c, xs):
+                s, tl = c
+                sk, sv = xs[0], xs[1]
+                cr = (xs[2] if has_commits else jnp.full(
+                    (sk.shape[0], k_dim), -1, jnp.int32))
+                s2 = self._round(s, sk, sv, cr, None, sched, coll,
+                                 repl_mode=repl_mode, plan=plan)
+                return (s2, telemetry.record(
+                    tl, s.t, self._tel_series(s, s2, coll, plan),
+                    tel_mask))
+
+            xs = ((sks, svs) + ((rest[0],) if has_commits else ()))
+            out, _ = lax.scan(lambda c, x: (body(c, x), None),
+                              (state, tel), xs)
+            return out
+
+        if mesh is None:
+            prog = jit_program(run, donate_argnums=dn)
+        else:
+            node3 = P(None, "nodes", None)
+            state_spec = self._state_spec()
+            in_specs = ((state_spec, telemetry.state_specs(), node3,
+                         node3)
+                        + ((node3,) if has_commits else ())
+                        + (KVReach(P(), P(), P(None, None)),)
+                        + ((faults.plan_specs(),) if fp else ()))
+            prog = jit_program(
+                run, mesh=mesh, in_specs=in_specs,
+                out_specs=(state_spec, telemetry.state_specs()),
+                check_vma=False, donate_argnums=dn)
+        self._obs_progs[key] = prog
+        return prog
+
+    def telemetry_state(self, tspec) -> "telemetry.TelemetryState":
+        return telemetry.init_state(tspec)
+
+    def run_observed(self, state: KafkaState, tel, tspec,
+                     send_key: np.ndarray, send_val: np.ndarray,
+                     commit_req: np.ndarray | None = None, *,
+                     donate: bool = False):
+        """Telemetry-on :meth:`run_rounds`: the R staged rounds as one
+        scan with the per-round metrics ring recorded next to the
+        state — bit-exact to the telemetry-off driver (the recorder
+        only reads state).  Returns ``(state, tel)``."""
+        has_commits = commit_req is not None
+        args = [jnp.asarray(send_key, jnp.int32),
+                jnp.asarray(send_val, jnp.int32)]
+        if has_commits:
+            args.append(jnp.asarray(commit_req, jnp.int32))
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P(None, "nodes", None))
+            args = [jax.device_put(a, sh) for a in args]
+        args.append(self.kv_sched)
+        if self._fp_active:
+            args.append(self.fault_plan)
+        prog = self._build_obs_prog(tspec, has_commits, donate)
+        return prog(state, tel, *args)
+
+    def audit_observed_program(self, tspec, *, donate: bool = True,
+                               rounds: int = 8):
+        """(jitted, example_args) of the observed driver — the handle
+        the contract auditor lowers."""
+        n, s = self.n_nodes, self.max_sends
+        sks = np.full((rounds, n, s), -1, np.int32)
+        sks[:, 0, 0] = 0
+        svs = np.zeros((rounds, n, s), np.int32)
+        prog = self._build_obs_prog(tspec, False, donate)
+        args = [jnp.asarray(sks), jnp.asarray(svs)]
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P(None, "nodes", None))
+            args = [jax.device_put(a, sh) for a in args]
+        args.append(self.kv_sched)
+        if self._fp_active:
+            args.append(self.fault_plan)
+        return prog, (self.init_state(), telemetry.init_state(tspec),
+                      *args)
+
     def step(self, state: KafkaState,
              send_key: np.ndarray | None = None,
              send_val: np.ndarray | None = None,
@@ -973,7 +1112,7 @@ class KafkaSim:
 
     def _traffic_round(self, state: KafkaState, ts, tspec, tplan,
                        sched: KVReach, coll, plan, repl_mode: str,
-                       ub: int):
+                       ub: int, tel=None, tel_mask=None):
         """One traffic-injected round (traced): stage this round's
         arrivals as a shard-local send batch (op (client, k) sends a
         seeded key with its op id as the value — globally unique, like
@@ -1068,9 +1207,13 @@ class KafkaSim:
             return (a >= 0) & (bit > 0)
 
         ts = traffic.done_scan(ts, bit_fn, s2.t, coll.reduce_sum, ub)
-        return s2, ts
+        if tel is None:
+            return s2, ts
+        vals = (self._tel_series(state, s2, coll, plan)
+                + traffic.tel_series(ts, coll.reduce_sum))
+        return s2, ts, telemetry.record(tel, state.t, vals, tel_mask)
 
-    def _build_traffic(self, tspec, donate: bool):
+    def _build_traffic(self, tspec, donate: bool, tel_spec=None):
         if tspec.n_nodes != self.n_nodes:
             raise ValueError(
                 f"TrafficSpec is for {tspec.n_nodes} nodes, sim has "
@@ -1089,47 +1232,63 @@ class KafkaSim:
                 f"n_clients={tspec.n_clients} must shard evenly over "
                 f"the {n_sh}-way node axis")
         ub = traffic.traffic_block(tspec.n_clients // n_sh)
-        dn = donate_argnums_for(donate, 0, 1)
+        tl = tel_spec is not None
+        mask = tel_spec.static_mask if tl else None
+        dn = donate_argnums_for(donate, *((0, 1, 2) if tl else (0, 1)))
         fp = self._fp_active
 
-        def run(state, ts, n, tplan, sched, *rest):
-            plan = rest[0] if fp else None
+        def run(state, *rest):
+            rest = list(rest)
+            tel = rest.pop(0) if tl else None
+            ts, n, tplan, sched = rest[0], rest[1], rest[2], rest[3]
+            plan = rest[4] if fp else None
             coll = collectives(
                 state.present.shape[0],
                 mesh)
-            return fori_rounds(
-                lambda c, op: self._traffic_round(
+
+            def body(c, op):
+                if tl:
+                    return self._traffic_round(
+                        c[0], c[1], tspec, op, sched, coll, plan,
+                        repl_mode, ub, tel=c[2], tel_mask=mask)
+                return self._traffic_round(
                     c[0], c[1], tspec, op, sched, coll, plan,
-                    repl_mode, ub),
-                (state, ts), n, operand=tplan)
+                    repl_mode, ub)
+
+            carry = (state, ts, tel) if tl else (state, ts)
+            return fori_rounds(body, carry, n, operand=tplan)
 
         if mesh is None:
             prog = jit_program(run, donate_argnums=dn)
         else:
             t_specs = traffic.state_specs(True)
             state_spec = self._state_spec()
-            in_specs = ((state_spec, t_specs, P(),
-                         traffic.plan_specs(),
-                         KVReach(P(), P(), P(None, None)))
+            tel_in = (telemetry.state_specs(),) if tl else ()
+            in_specs = ((state_spec,) + tel_in
+                        + (t_specs, P(), traffic.plan_specs(),
+                           KVReach(P(), P(), P(None, None)))
                         + ((faults.plan_specs(),) if fp else ()))
             prog = jit_program(run, mesh=mesh, in_specs=in_specs,
-                               out_specs=(state_spec, t_specs),
+                               out_specs=(state_spec, t_specs)
+                               + tel_in,
                                check_vma=False, donate_argnums=dn)
 
         fp_args = (self.fault_plan,) if fp else ()
 
-        def args_fn(state, ts, n, tplan):
-            return (state, ts, n, tplan, self.kv_sched) + fp_args
+        def args_fn(state, ts, n, tplan, tel=None):
+            pre = (state, tel) if tl else (state,)
+            return pre + (ts, n, tplan, self.kv_sched) + fp_args
 
-        runner = lambda state, ts, n, tplan: prog(
-            *args_fn(state, ts, n, tplan))
+        runner = lambda state, ts, n, tplan, tel=None: prog(
+            *args_fn(state, ts, n, tplan, tel))
         return prog, args_fn, runner
 
     def traffic_state(self, tspec) -> traffic.TrafficState:
         return traffic.init_state(tspec, self.mesh)
 
     def run_traffic(self, state: KafkaState, ts, tspec,
-                    n_rounds: int, *, donate: bool = False):
+                    n_rounds: int, *, donate: bool = False,
+                    tel=None, tel_spec=None):
         """Open-loop serving driver: ``n_rounds`` rounds as ONE device
         program, each round staging the spec's seeded arrivals through
         the existing send path (allocation, append, fire-and-forget
@@ -1139,27 +1298,33 @@ class KafkaSim:
         streaming union included.  ``donate`` consumes both the sim
         state and the tracker.  Programs cache by
         ``TrafficSpec.program_key``, so a load sweep reuses one
-        compiled program across rates."""
-        key = (tspec.program_key, donate)
+        compiled program across rates.  ``tel``/``tel_spec`` (PR 8):
+        record the per-round telemetry ring next to the tracker —
+        returns ``(state, ts, tel)``."""
+        key = (tspec.program_key, donate,
+               telemetry.tel_key(tel, tel_spec, "kafka"))
         if key not in self._traffic_progs:
-            self._traffic_progs[key] = self._build_traffic(tspec,
-                                                           donate)
+            self._traffic_progs[key] = self._build_traffic(
+                tspec, donate, tel_spec)
         return self._traffic_progs[key][2](state, ts,
                                            jnp.int32(n_rounds),
-                                           tspec.compile())
+                                           tspec.compile(), tel)
 
-    def audit_traffic_program(self, tspec, *, donate: bool = True):
+    def audit_traffic_program(self, tspec, *, donate: bool = True,
+                              tel_spec=None):
         """(jitted, example_args) of the traffic driver — the handle
         the contract auditor lowers (census + donation of the EXACT
         program :meth:`run_traffic` executes)."""
-        key = (tspec.program_key, donate)
+        key = (tspec.program_key, donate, tel_spec)
         if key not in self._traffic_progs:
-            self._traffic_progs[key] = self._build_traffic(tspec,
-                                                           donate)
+            self._traffic_progs[key] = self._build_traffic(
+                tspec, donate, tel_spec)
         prog, args_fn, _ = self._traffic_progs[key]
+        tel = (telemetry.init_state(tel_spec) if tel_spec is not None
+               else None)
         return prog, args_fn(self.init_state(),
                              self.traffic_state(tspec), jnp.int32(4),
-                             tspec.compile())
+                             tspec.compile(), tel)
 
     # -- host-side reads (reference read semantics) ------------------------
 

@@ -1,0 +1,442 @@
+"""Flight-recorder telemetry for the vectorized backend: a
+device-resident per-round metrics ring that rides the donated fused
+drivers the same way ``FaultPlan``/``TrafficPlan`` do.
+
+Maelstrom's core deliverable beyond pass/fail is *observability* —
+per-run timelines, msgs-per-op plots, latency series (PAPER.md survey
+§5).  The repo reproduces that only for the slow host-side virtual
+network (harness/tracing.py); the fused donated drivers — the whole
+point of the TPU-native design — were black boxes between dispatch and
+final state.  This module closes that gap without giving up a single
+design invariant:
+
+- **`TelemetrySpec`** (the `NemesisSpec`/`TrafficSpec` shape): a
+  host-side JSON-able spec naming the workload, the ring capacity in
+  rounds, and the recorded series (a subset of the workload's canonical
+  series — unselected series are statically pruned, so XLA dead-codes
+  their computation).  The spec is STATIC (it keys the compiled
+  program); there is nothing to ``compile()`` — the carry is state.
+- **`TelemetryState`**: a tiny ``(R, n_series)`` uint32 ring plus a
+  written-rounds counter, carried through ``fori_rounds`` /
+  ``scan_rounds`` next to the sim state and DONATED with it.  Each
+  round, every shard computes its per-shard partials (popcounts,
+  pending sums, tracker counts), globalizes them with the engine's
+  existing ``reduce_sum`` psums — **zero all-gathers, zero host
+  callbacks** — and writes one replicated row at ``t mod R``.  The
+  recording step reads the round's input and output states and never
+  feeds back into them, so telemetry-on programs are bit-exact to
+  telemetry-off (pinned by tests/test_telemetry.py for all three sims,
+  stepwise vs donated fused, single-device and 8-way mesh).
+- **series conventions**: ``live_nodes`` and the ``*_bits``/``*_total``
+  gauges are instantaneous values; ``msgs``, ``arrived``, ``issued``,
+  ``completed``, ``deferred``, ``alloc_total``, ``kv_total`` are
+  RUNNING TOTALS (the host differentiates for per-round rates), so one
+  ring row cross-checks the final ledgers exactly — the conservation
+  identities ``ring[msgs][-1] == state.msgs`` and ``arrived == issued
+  + deferred`` hold at every recorded round
+  (harness/checkers.py ``check_telemetry``).
+
+The host side (harness/observe.py) turns a recorded run into run
+manifests, Perfetto/Chrome-trace timelines, and — on checker failure —
+a self-contained flight-recorder repro bundle.
+
+Env knobs (loud parsing, the ``_env_int`` contract): ``GG_TELEMETRY``
+(0/1 — default-off master switch the scenario runners consult) and
+``GG_TELEMETRY_SERIES`` (comma-separated subset; unknown names raise a
+ValueError NAMING the variable).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from typing import NamedTuple
+
+from . import faults
+from .engine import _env_int
+
+# The module's host/device split, DECLARED (the PR-6 faults.py
+# pattern): the determinism lint (tpu_sim/audit.py) treats exactly
+# TRACED_EVALUATORS as traced scope; tests/test_telemetry.py pins the
+# split TOTAL so new traced telemetry code can never dodge the lint.
+TRACED_EVALUATORS = ("record", "live_count")
+HOST_SIDE = (
+    "series_names", "enabled", "env_series", "init_state",
+    "state_specs", "ring_rows", "series_arrays", "default_spec",
+    "tel_key", "audit_contracts")
+
+# canonical per-workload series, in ring-column order.  Totals vs
+# gauges per the module docstring.  broadcast: frontier_bits = bits
+# flooding OUT this round, new_bits = bits newly merged (the frontier
+# entering the next round), known_bits = total received popcount.
+# counter: flush attempts/acks per round and their difference (cas
+# conflicts), pending backlog, the KV cell.  kafka: allocated sends
+# (running total) and `present_bits` — the presence popcount at the
+# WITNESS node (global row 0), which climbs to alloc_total exactly
+# when replication to node 0 has caught up (a full-presence popcount
+# would re-stream the O(N·K·C) bitset every round; see
+# KafkaSim._tel_series).
+SIM_SERIES = {
+    "broadcast": ("live_nodes", "frontier_bits", "new_bits",
+                  "known_bits", "msgs"),
+    "counter": ("live_nodes", "pending_total", "flush_attempts",
+                "flush_acks", "cas_conflicts", "kv_total", "msgs"),
+    "kafka": ("live_nodes", "alloc_total", "present_bits", "msgs"),
+}
+# appended when the spec records an open-loop traffic run (PR 7):
+# lifted straight from the TrafficState tracker's loud accounting
+TRAFFIC_SERIES = ("arrived", "issued", "completed", "deferred")
+
+
+def series_names(workload: str, traffic: bool = False) -> tuple:
+    """The canonical ring-column names for one workload (+ the tracker
+    columns when the run is open-loop)."""
+    try:
+        base = SIM_SERIES[workload]
+    except KeyError:
+        raise ValueError(
+            f"unknown telemetry workload {workload!r}; one of "
+            f"{sorted(SIM_SERIES)}") from None
+    return base + (TRAFFIC_SERIES if traffic else ())
+
+
+@dataclass(frozen=True)
+class TelemetrySpec:
+    """Host-side telemetry spec — JSON-able (:meth:`to_meta`), STATIC
+    (it keys the compiled observed programs: ring capacity and the
+    recorded-series mask are shapes/constants, not operands).
+
+    ``rounds``: ring capacity R — rows write at ``t mod R``, so a run
+    longer than R keeps the LAST R rounds (the flight-recorder
+    semantics; ``TelemetryState.wrote`` counts total recorded rounds
+    so the host can detect the wrap).  ``series``: subset of
+    :func:`series_names` to record — unselected columns are statically
+    zeroed, so XLA prunes their evaluation.  ``traffic``: the run is
+    open-loop (appends the tracker columns)."""
+
+    workload: str
+    rounds: int
+    traffic: bool = False
+    series: tuple = field(default_factory=tuple)
+
+    def __post_init__(self) -> None:
+        known = series_names(self.workload, self.traffic)
+        if self.rounds < 1:
+            raise ValueError("telemetry ring needs rounds >= 1")
+        sel = tuple(self.series) or known
+        bad = [s for s in sel if s not in known]
+        if bad:
+            raise ValueError(
+                f"unknown telemetry series {bad} for workload "
+                f"{self.workload!r} (traffic={self.traffic}); known: "
+                f"{list(known)}")
+        # canonical order, duplicates dropped — the mask below indexes
+        # ring columns positionally
+        object.__setattr__(
+            self, "series", tuple(s for s in known if s in sel))
+
+    @property
+    def names(self) -> tuple:
+        """ALL ring-column names (the ring always carries the full
+        canonical width so its layout never depends on the subset)."""
+        return series_names(self.workload, self.traffic)
+
+    @property
+    def width(self) -> int:
+        return len(self.names)
+
+    @property
+    def static_mask(self) -> tuple:
+        """Per-column python bools (static): False columns record 0
+        and their value expressions are dead-coded by XLA."""
+        return tuple(n in self.series for n in self.names)
+
+    def to_meta(self) -> dict:
+        return {"workload": self.workload, "rounds": self.rounds,
+                "traffic": self.traffic, "series": list(self.series)}
+
+    @staticmethod
+    def from_meta(meta: dict) -> "TelemetrySpec":
+        return TelemetrySpec(
+            workload=str(meta["workload"]), rounds=int(meta["rounds"]),
+            traffic=bool(meta.get("traffic", False)),
+            series=tuple(meta.get("series", ())))
+
+
+class TelemetryState(NamedTuple):
+    """The device carry: rides the DONATED state pytree of the
+    observed drivers.  Replicated on a mesh (every shard computes the
+    identical psum-globalized row)."""
+
+    ring: jnp.ndarray    # (R, width) uint32 — row per recorded round
+    wrote: jnp.ndarray   # () uint32 — total rounds recorded (wrap
+    #                      detection: wrote > R means the ring holds
+    #                      only the LAST R rounds)
+
+
+def state_specs() -> TelemetryState:
+    """shard_map in/out_specs: fully replicated."""
+    return TelemetryState(P(None, None), P())
+
+
+def init_state(spec: TelemetrySpec) -> TelemetryState:
+    return TelemetryState(
+        ring=jnp.zeros((spec.rounds, spec.width), jnp.uint32),
+        wrote=jnp.uint32(0))
+
+
+def record(tel: TelemetryState, t, vals, mask) -> TelemetryState:
+    """Write one round's row at ``t mod R`` (traced).  ``vals`` must
+    already be globalized (replicated psum results / replicated
+    scalars) and match the spec's canonical column order; ``mask`` is
+    the spec's STATIC per-column bool tuple — False columns are pruned
+    at trace time."""
+    row = jnp.stack(
+        [jnp.asarray(v).astype(jnp.uint32) if keep else jnp.uint32(0)
+         for v, keep in zip(vals, mask)])
+    idx = lax.rem(jnp.asarray(t, jnp.int32),
+                  jnp.int32(tel.ring.shape[0]))
+    return TelemetryState(
+        ring=lax.dynamic_update_slice_in_dim(tel.ring, row[None, :],
+                                             idx, axis=0),
+        wrote=tel.wrote + jnp.uint32(1))
+
+
+def live_count(plan, t, n_nodes: int) -> jnp.ndarray:
+    """() uint32 — nodes up at round ``t`` (traced).  Evaluated over
+    the full global id range IDENTICALLY on every shard (the plan is
+    replicated), so the result is replicated with no collective at
+    all; a fault-free run records the constant N."""
+    if plan is None:
+        return jnp.uint32(n_nodes)
+    ids = jnp.arange(n_nodes, dtype=jnp.int32)
+    return jnp.sum(faults.node_up(plan, t, ids).astype(jnp.uint32),
+                   dtype=jnp.uint32)
+
+
+# -- env knobs ------------------------------------------------------------
+
+
+def enabled(default: bool = False) -> bool:
+    """The ``GG_TELEMETRY`` master switch (default OFF — telemetry
+    costs a few extra state passes per round).  Loud contract: any
+    value other than 0/1 raises a ValueError naming the variable."""
+    raw = os.environ.get("GG_TELEMETRY")
+    if raw is None:
+        return default
+    v = _env_int("GG_TELEMETRY", raw)
+    if v not in (0, 1):
+        raise ValueError(
+            f"GG_TELEMETRY={v} must be 0 or 1 (telemetry off/on)")
+    return bool(v)
+
+
+def env_series(workload: str, traffic: bool = False) -> tuple | None:
+    """The ``GG_TELEMETRY_SERIES`` subset filter (None = record all).
+    Loud contract: a name that is not one of the workload's canonical
+    series raises a ValueError naming the variable."""
+    raw = os.environ.get("GG_TELEMETRY_SERIES")
+    if raw is None:
+        return None
+    names = tuple(s.strip() for s in raw.split(",") if s.strip())
+    known = series_names(workload, traffic)
+    bad = [s for s in names if s not in known]
+    if bad:
+        raise ValueError(
+            f"GG_TELEMETRY_SERIES names unknown series {bad} for "
+            f"workload {workload!r} (traffic={traffic}); known: "
+            f"{list(known)}")
+    if not names:
+        raise ValueError(
+            "GG_TELEMETRY_SERIES is set but selects no series; unset "
+            "it to record everything")
+    return names
+
+
+def default_spec(workload: str, rounds: int,
+                 traffic: bool = False) -> TelemetrySpec:
+    """The spec the scenario runners build when telemetry is switched
+    on without an explicit spec: full canonical series, filtered by
+    ``GG_TELEMETRY_SERIES`` if set."""
+    sel = env_series(workload, traffic)
+    return TelemetrySpec(workload=workload, rounds=max(1, rounds),
+                         traffic=traffic, series=sel or ())
+
+
+def tel_key(tel, tel_spec, workload: str):
+    """Validate a traffic driver's ``(tel, tel_spec)`` pair (both or
+    neither; the spec must name this workload with ``traffic=True``)
+    and return the program-cache key component (the spec — it is the
+    static shape)."""
+    if (tel is None) != (tel_spec is None):
+        raise ValueError(
+            "pass tel and tel_spec together (build the ring with "
+            "telemetry.init_state(spec))")
+    if tel_spec is not None and (tel_spec.workload != workload
+                                 or not tel_spec.traffic):
+        raise ValueError(
+            f"run_traffic telemetry needs TelemetrySpec(workload="
+            f"{workload!r}, traffic=True), got {tel_spec.to_meta()}")
+    return tel_spec
+
+
+# -- host-side readout ----------------------------------------------------
+
+
+def ring_rows(tel: TelemetryState,
+              spec: TelemetrySpec) -> tuple[np.ndarray, int, bool]:
+    """(rows, first_round, wrapped): the recorded rows in round order.
+    ``rows[i]`` is round ``first_round + i``; with a wrap the ring
+    holds only the last R rounds."""
+    ring = np.asarray(tel.ring)
+    wrote = int(tel.wrote)
+    r = ring.shape[0]
+    if wrote <= r:
+        return ring[:wrote], 0, False
+    head = wrote % r
+    return np.concatenate([ring[head:], ring[:head]]), wrote - r, True
+
+
+def series_arrays(tel: TelemetryState, spec: TelemetrySpec) -> dict:
+    """{name: list[int]} for the RECORDED series, plus ``_round``
+    (absolute round index per row) and ``_wrapped``.  The JSON-able
+    payload the manifests / timelines / flight bundles carry."""
+    rows, first, wrapped = ring_rows(tel, spec)
+    out: dict = {
+        "_round": list(range(first, first + rows.shape[0])),
+        "_wrapped": wrapped,
+    }
+    for i, name in enumerate(spec.names):
+        if name in spec.series:
+            out[name] = [int(v) for v in rows[:, i]]
+    return out
+
+
+# -- program contracts (tpu_sim/audit.py registry) -----------------------
+
+
+def audit_contracts():
+    """Telemetry-on driver rows: the observed fused drivers of all
+    three sims under a crash+loss plan must stay all-gather-free
+    (cap-0 census — telemetry rides psum-of-partials only), keep the
+    donation alias table covering BOTH the sim state and the telemetry
+    carry, and sit inside the analytic memory band extended by the
+    ring bytes (``engine.analytic_peak_bytes``)."""
+    from ..parallel.topology import to_padded_neighbors, tree
+    from .audit import AuditProgram, ProgramContract
+    from .broadcast import BroadcastSim
+    from .counter import CounterSim
+    from .engine import analytic_peak_bytes
+    from .engine import operand_bytes as engine_operand_bytes
+    from .kafka import KafkaSim
+    from .structured import make_exchange, make_nemesis
+
+    def _spec(n):
+        return faults.NemesisSpec(
+            n_nodes=n, seed=5, crash=((2, 4, (1, n // 2)),),
+            loss_rate=0.1, loss_until=6)
+
+    def counter_obs(mesh):
+        n = 1024
+        tspec = TelemetrySpec("counter", rounds=16)
+        sim = CounterSim(n, mode="cas", poll_every=2, mesh=mesh,
+                         fault_plan=_spec(n).compile())
+        prog, args = sim.audit_observed_program(tspec)
+        n_sh = 1 if mesh is None else 8
+        state_bytes = 2 * n * 4 // n_sh
+        tel_bytes = tspec.rounds * tspec.width * 4
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes + tel_bytes,
+            operand_bytes=engine_operand_bytes(sim.fault_plan))
+        return AuditProgram(prog, args,
+                            donated_bytes=state_bytes + tel_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    def broadcast_obs(mesh):
+        n, nv = 256, 256
+        spec = _spec(n)
+        tspec = TelemetrySpec("broadcast", rounds=16)
+        n_sh = None if mesh is None else 8
+        sim = BroadcastSim(
+            to_padded_neighbors(tree(n, branching=4)), n_values=nv,
+            sync_every=4, srv_ledger=False, mesh=mesh,
+            exchange=make_exchange("tree", n, branching=4),
+            fault_plan=spec.compile(),
+            nemesis=make_nemesis("tree", n, spec, n_shards=n_sh,
+                                 branching=4))
+        prog, args = sim.audit_observed_program(tspec)
+        div = 1 if mesh is None else 8
+        state_bytes = 2 * n * (nv // 32) * 4 // div
+        tel_bytes = tspec.rounds * tspec.width * 4
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes + tel_bytes,
+            operand_bytes=engine_operand_bytes(sim.fault_plan),
+            slab_bytes=n * (nv // 32) * 4 // div)
+        return AuditProgram(prog, args,
+                            donated_bytes=state_bytes + tel_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    def kafka_obs(mesh):
+        n, k, cap = 64, 8, 64
+        tspec = TelemetrySpec("kafka", rounds=16)
+        # union_block pins the BLOCKED streaming union (the PR-5
+        # gather-free path; "auto" would keep this small shape on the
+        # materialized path, whose 3 metadata widens are the oracle's)
+        sim = KafkaSim(n, k, capacity=cap, max_sends=2,
+                       fault_plan=_spec(n).compile(),
+                       resync_every=4, union_block=4, mesh=mesh)
+        prog, args = sim.audit_observed_program(tspec)
+        n_sh = 1 if mesh is None else 8
+        wc = (cap + 31) // 32
+        state_bytes = (n * k * wc * 4 + n * k * 4) // n_sh \
+            + k * cap * 4 + k * 4
+        tel_bytes = tspec.rounds * tspec.width * 4
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes + tel_bytes,
+            operand_bytes=engine_operand_bytes(sim.fault_plan),
+            slab_bytes=(n // n_sh) * n * 2 * 4 + (n // n_sh) * k * wc * 4)
+        return AuditProgram(prog, args,
+                            donated_bytes=state_bytes + tel_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    return [
+        ProgramContract(
+            name="counter/observed-run",
+            build=counter_obs,
+            collectives={"all-reduce": None},
+            donation=True,
+            mem_lo=0.05, mem_hi=8.0,
+            notes="telemetry-on donated counter driver under "
+                  "crash+loss: the per-round series are psums of "
+                  "per-shard partials — NO gather, no ppermute; the "
+                  "(state, ring) pytrees alias in place"),
+        ProgramContract(
+            name="broadcast/observed-run-halo-wm-nem",
+            build=broadcast_obs,
+            collectives={"all-reduce": None,
+                         "collective-permute": None},
+            donation=True,
+            mem_lo=0.05, mem_hi=8.0,
+            notes="telemetry-on words-major nemesis driver: the ring "
+                  "rides the halo path's psums — ZERO added gathers "
+                  "(the PR-3/PR-8 composed contract)"),
+        ProgramContract(
+            name="kafka/observed-run-union-nem",
+            build=kafka_obs,
+            collectives={"all-reduce": None,
+                         "collective-permute": None},
+            donation=True,
+            mem_lo=0.05, mem_hi=8.0,
+            notes="telemetry-on faulted origin-union driver: presence "
+                  "popcount partials psum next to the existing "
+                  "reduce-or circuit — the sharded observed step "
+                  "stays all-gather-free"),
+    ]

@@ -76,7 +76,7 @@ from .engine import _env_int, scan_blocks, windows_fold
 # code can never silently dodge the lint.
 TRACED_EVALUATORS = (
     "arrive", "_arrival_num", "_client_hash", "local_node_cols",
-    "intake_rank", "issue", "record_aux", "done_scan")
+    "intake_rank", "issue", "record_aux", "done_scan", "tel_series")
 HOST_SIDE = (
     "plan_specs", "state_specs", "init_state", "client_nodes",
     "host_arrivals", "traffic_block", "latency_summary",
@@ -455,6 +455,19 @@ def done_scan(ts: TrafficState, bit_fn: Callable, t_done,
                            rows, block)
     return ts._replace(done_round=dr,
                        completed=ts.completed + reduce_sum(comp))
+
+
+def tel_series(ts: TrafficState, reduce_sum: Callable) -> tuple:
+    """The tracker's telemetry columns (tpu_sim/telemetry.py
+    ``TRAFFIC_SERIES`` order): running totals ``(arrived, issued,
+    completed, deferred)`` after this round.  ``arrived`` /
+    ``completed`` / ``deferred`` are already psum-globalized scalars;
+    ``issued`` is the per-shard count of issued op slots globalized
+    here — so the recorded ring itself witnesses the loud-backpressure
+    identity ``arrived == issued + deferred`` at EVERY round."""
+    issued = reduce_sum(jnp.sum(
+        (ts.issue_round >= 0).astype(jnp.uint32), dtype=jnp.uint32))
+    return (ts.arrived, issued, ts.completed, ts.deferred)
 
 
 # -- env knob -------------------------------------------------------------

@@ -33,6 +33,14 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 # of a bare SIGTERM.
 timeout -k 10 900 env JAX_PLATFORMS=cpu \
     python scripts/kafka_smoke.py || rc=1
+# Telemetry smoke (PR 8): one certified crash+loss+traffic run per
+# sim on the TELEMETRY-ON observed drivers — manifest + Perfetto
+# timeline written and schema-validated (uploaded as a CI artifact),
+# and the flight recorder exercised via a deliberately failing
+# latency bound whose bundle must replay to the same failure from
+# its own JSON.  (CPU, seconds.)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/telemetry_smoke.py || rc=1
 # Program-contract audit (PR 6): every registered driver contract
 # (collective census, donation alias table, host boundary, memory
 # band) on the CPU 8-way virtual mesh, plus the AST determinism lint

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""CI telemetry smoke (PR 8): one certified crash+loss+traffic run per
+stateful sim on the TELEMETRY-ON observed drivers, with the full
+observability pipeline exercised end to end on CPU, seconds — the
+budget-safe slice the tier-1 gate runs on every push:
+
+1. each run's manifest (program fingerprint + compiled memory + cost
+   analysis + the workload's telemetry-on contract verdict) and
+   Perfetto timeline are WRITTEN and schema-validated — the manifest
+   directory is uploaded as a CI build artifact;
+2. the flight recorder is exercised via a deliberately failing per-op
+   latency bound: the bundle must be written atomically and
+   ``observe.replay_bundle`` must reproduce the SAME failure from the
+   bundle's own JSON alone.
+
+Exits nonzero on any failure.  Output dir: ``GG_OBSERVE_DIR``
+(default ``artifacts/telemetry_smoke``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gossip_glomers_tpu.parallel.mesh import force_virtual_devices  # noqa: E402
+
+force_virtual_devices(8)
+
+from gossip_glomers_tpu.harness import observe, serving  # noqa: E402
+from gossip_glomers_tpu.tpu_sim import audit             # noqa: E402
+from gossip_glomers_tpu.tpu_sim import telemetry as TM   # noqa: E402
+from gossip_glomers_tpu.tpu_sim.engine import program_record  # noqa: E402
+from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec     # noqa: E402
+from gossip_glomers_tpu.tpu_sim.traffic import TrafficSpec    # noqa: E402
+
+N = 8
+SPEC = NemesisSpec(n_nodes=N, seed=5, crash=((6, 10, (2, 6)),),
+                   loss_rate=0.15, loss_until=16)
+TRAFFIC = TrafficSpec(n_nodes=N, n_clients=8, ops_per_client=8,
+                      until=20, rate=0.3, seed=1)
+# the same certified crash+loss-under-load scenarios the fault smoke
+# runs (grid broadcast: the sole-copy amnesia race of a tree root is
+# a real loss, not a telemetry bug)
+SIM_KW = {"broadcast": {}, "counter": {}, "kafka": {}}
+CONTRACT = {"broadcast": "broadcast/observed-run-halo-wm-nem",
+            "counter": "counter/observed-run",
+            "kafka": "kafka/observed-run-union-nem"}
+
+
+def main() -> int:
+    out = pathlib.Path(os.environ.get("GG_OBSERVE_DIR",
+                                      "artifacts/telemetry_smoke"))
+    out.mkdir(parents=True, exist_ok=True)
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("nodes",))
+    contracts = {c.name: c for c in TM.audit_contracts()}
+    failed = []
+
+    for kind in ("broadcast", "counter", "kafka"):
+        res = serving.run_serving(
+            kind, TRAFFIC, nemesis=SPEC, telemetry=True,
+            observe_dir=str(out), sim_kw=SIM_KW[kind])
+        rec = len(res.get("telemetry", {}).get("series",
+                                               {}).get("_round", ()))
+        print(f"telemetry-smoke {kind:10s} "
+              f"{'ok' if res['ok'] else 'FAIL'}  "
+              f"rounds={rec} completed={res['completed']} "
+              f"lost={res['n_lost_writes']} p99={res['lat_p99']}")
+        if not res["ok"]:
+            failed.append((kind, res.get("telemetry", {}).get(
+                "check", res["n_lost_writes"])))
+            continue
+        # the manifest: the EXACT observed driver's fingerprint +
+        # memory + cost, and this workload's telemetry-on contract
+        # verdict (all-gather census / donation / memory band) — the
+        # TelemetrySpec is lifted from the run itself so the recorded
+        # program IS the one run_serving executed (same ring shape)
+        sim, _ = serving.make_serving_sim(kind, TRAFFIC, nemesis=SPEC,
+                                          **SIM_KW[kind])
+        tsp = TM.TelemetrySpec.from_meta(res["telemetry"]["spec"])
+        prog, args = sim.audit_traffic_program(TRAFFIC, tel_spec=tsp)
+        programs = {"observed-traffic-run": program_record(prog,
+                                                           *args)}
+        verdict = audit.audit_contract(contracts[CONTRACT[kind]],
+                                       mesh)
+        manifest = observe.run_manifest(res, programs=programs,
+                                        contracts=[verdict])
+        observe.validate_manifest(manifest)
+        mpath = observe.write_json_atomic(
+            str(out / f"manifest_{kind}.json"), manifest)
+        timeline = observe.run_timeline(res)
+        observe.validate_timeline(timeline)
+        tpath = observe.write_json_atomic(
+            str(out / f"timeline_{kind}.json"), timeline)
+        if not verdict["ok"]:
+            failed.append((kind, f"contract {verdict['name']}"))
+        print(f"  manifest={os.path.basename(mpath)} "
+              f"fingerprint={programs['observed-traffic-run']['fingerprint']} "
+              f"contract={'ok' if verdict['ok'] else 'FAIL'} "
+              f"timeline_events={len(timeline['traceEvents'])}")
+
+    # flight recorder: a deliberately failing latency bound must
+    # produce a bundle that replays to the same failure
+    bad = serving.run_serving(
+        "counter", TRAFFIC, nemesis=SPEC, telemetry=True,
+        observe_dir=str(out), latency_bound={"p99_max_rounds": 0.0})
+    if bad["ok"] or "flight_bundle" not in bad:
+        failed.append(("flight-recorder", "failing bound did not "
+                       "produce a bundle"))
+    else:
+        bundle_path = bad["flight_bundle"]
+        replay = observe.replay_bundle(bundle_path)
+        same = (not replay["ok"]
+                and replay["lat_p99"] == bad["lat_p99"]
+                and bool(replay["latency_bound"]["problems"]))
+        print(f"telemetry-smoke flight-rec "
+              f"{'ok' if same else 'FAIL'}  "
+              f"bundle={os.path.basename(bundle_path)} "
+              f"replay_p99={replay['lat_p99']}=={bad['lat_p99']}")
+        if not same:
+            failed.append(("flight-recorder", "replay diverged"))
+        with open(bundle_path) as fp:
+            json.load(fp)        # bundle is complete, valid JSON
+
+    if failed:
+        print(f"telemetry-smoke: {len(failed)} leg(s) failed: "
+              f"{failed}", file=sys.stderr)
+        return 1
+    print("telemetry-smoke: all legs ok, artifacts in", out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
