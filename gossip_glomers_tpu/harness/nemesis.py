@@ -54,6 +54,53 @@ def _failure_of(details: dict) -> dict:
     return {k: details[k] for k in keys if k in details}
 
 
+def _unpack_obs(out, tel, prov):
+    """Unpack an observed driver's ``(state, tel?, prov?)`` carry in
+    order (run_observed returns exactly the leaves that were
+    passed)."""
+    if tel is None and prov is None:
+        return out, None, None
+    out = list(out)
+    state = out.pop(0)
+    new_tel = out.pop(0) if tel is not None else None
+    new_prov = out.pop(0) if prov is not None else None
+    return state, new_tel, new_prov
+
+
+def _finish_provenance(ok: bool, details: dict, prov, prov_spec,
+                       spec: NemesisSpec, *, workload: str,
+                       check_kw: dict) -> bool:
+    """Shared PR-9 tail: certify the recorded provenance stamps
+    against the fault model itself (``checkers.check_provenance`` —
+    the host re-evaluates every claimed causal edge's liveness/loss
+    coins), surface the stamp arrays + verdict (+ the broadcast
+    dissemination-tree summary) in ``details['provenance']``, and AND
+    the verdict in."""
+    import numpy as np
+
+    from ..tpu_sim import provenance as PV
+    from . import observe
+    from .checkers import check_provenance
+
+    if prov is None:
+        return ok
+    arrs = PV.arrays_of(prov)
+    ok_p, p_det = check_provenance(workload, arrs, spec=spec,
+                                   **check_kw)
+    # the numpy arrays stay as-is: every in-process consumer
+    # (dissemination_tree, add_provenance_flows, replay_divergence)
+    # np.asarrays them, and eagerly .tolist()-ing an (N, 2N) record
+    # on every SUCCESSFUL run would box millions of ints for nothing
+    # — the one JSON consumer (_finish_observed's bundle write)
+    # converts at write time
+    entry = {"spec": prov_spec.to_meta(), "check": p_det,
+             "arrays": arrs}
+    if workload == "broadcast":
+        entry["tree"] = observe.dissemination_tree(arrs)
+    details["provenance"] = entry
+    return ok and ok_p
+
+
 def _finish_observed(ok: bool, details: dict, tel, tel_spec, *,
                      msgs_total: int, observe_dir, workload: str,
                      spec: NemesisSpec, runner_kw: dict) -> bool:
@@ -61,7 +108,9 @@ def _finish_observed(ok: bool, details: dict, tel, tel_spec, *,
     telemetry series, cross-check them against the run's own ledgers
     (``checkers.check_telemetry`` — a broken recorder fails the run),
     and on any failure write the flight-recorder repro bundle
-    (harness/observe.py) into ``observe_dir``."""
+    (harness/observe.py) into ``observe_dir`` — the recorded
+    provenance (``details['provenance']``, PR 9) rides inside so the
+    replay can report the first-divergence round."""
     from ..tpu_sim import telemetry as TM
     from . import observe
     from .checkers import check_telemetry
@@ -75,12 +124,31 @@ def _finish_observed(ok: bool, details: dict, tel, tel_spec, *,
         tel_meta = tel_spec.to_meta()
         ok = ok and ok_t
     if not ok and observe_dir is not None:
+        import numpy as np
+
+        prov_entry = details.get("provenance") or {}
+        prov_arrays = prov_entry.get("arrays")
         details["flight_bundle"] = observe.write_flight_bundle(
             observe_dir, kind="nemesis", workload=workload,
             nemesis=spec.to_meta(), runner_kw=runner_kw,
             telemetry_spec=tel_meta, telemetry_series=series,
+            provenance_spec=prov_entry.get("spec"),
+            provenance=(None if prov_arrays is None
+                        else {k: np.asarray(v).tolist()
+                              for k, v in prov_arrays.items()}),
             failure=_failure_of(details))
     return ok
+
+
+def _no_traffic_provenance(provenance):
+    """Open-loop runs record through the traffic drivers, which do not
+    carry the provenance stamps — an EXPLICIT request must fail loudly
+    (the env switch stays quietly inert for traffic runs)."""
+    if provenance not in (None, False):
+        raise ValueError(
+            "provenance rides the quiescent nemesis runners; the "
+            "open-loop traffic drivers do not carry the stamp record "
+            "(drop traffic= or provenance=)")
 
 
 def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
@@ -90,6 +158,7 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                           mesh=None,
                           structured: "bool | str" = False,
                           traffic=None, telemetry=None,
+                          provenance=None,
                           observe_dir=None) -> dict:
     """Broadcast under the full nemesis (crash/loss/dup from ``spec``,
     plus an optional partition schedule): values injected round-robin
@@ -121,7 +190,16 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
     ``details['telemetry']``, cross-check them against the ledgers
     (``checkers.check_telemetry`` — a broken recorder fails the
     run), and on ANY failure write the flight-recorder repro bundle
-    into ``observe_dir`` (if given)."""
+    into ``observe_dir`` (if given).
+
+    ``provenance`` (PR 9): None (the ``GG_PROVENANCE`` env switch,
+    default off) / True / False / a ``ProvenanceSpec`` — additionally
+    record the per-(node, value) arrival-round + parent-node stamps
+    (tpu_sim/provenance.py) on the same observed drivers (gather
+    path, 1-hop and per-edge delays; the structured words-major path
+    rejects it), certify them against the fault model itself
+    (``checkers.check_provenance``), and surface the stamps + the
+    dissemination-tree summary in ``details['provenance']``."""
     from ..tpu_sim import structured as S
     from . import observe
     n = spec.n_nodes
@@ -131,6 +209,7 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
         parts = Partitions.from_meta(parts)
     if traffic is not None:
         from . import serving
+        _no_traffic_provenance(provenance)
         if parts is not None:
             raise ValueError(
                 "traffic= composes with the FaultPlan nemesis; "
@@ -172,21 +251,33 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
         telemetry, "broadcast", clear + max_recovery_rounds)
     tel = (sim.telemetry_state(tel_spec) if tel_spec is not None
            else None)
+    prov_spec = observe.provenance_setup(provenance, "broadcast")
+    if prov_spec is not None and structured:
+        raise ValueError(
+            "broadcast provenance rides the gather path; drop "
+            "structured= for a provenance-on campaign")
+    prov = (sim.provenance_state(prov_spec, inject)
+            if prov_spec is not None else None)
+    obs_on = tel is not None or prov is not None
     state, _tgt = sim.stage(inject)
     if clear > 0:
-        if tel is None:
+        if not obs_on:
             state = sim.run_staged_fixed(state, clear, donate=True)
         else:
-            state, tel = sim.run_observed(state, tel, tel_spec,
-                                          clear, donate=True)
+            state, tel, prov = _unpack_obs(
+                sim.run_observed(state, tel, tel_spec, clear,
+                                 donate=True, prov=prov,
+                                 prov_spec=prov_spec), tel, prov)
     msgs_at_clear = int(state.msgs)
     converged_round = clear if sim.converged(state, target) else None
     while converged_round is None \
             and int(state.t) < clear + max_recovery_rounds:
-        if tel is None:
+        if not obs_on:
             state = sim.step(state)
         else:
-            state, tel = sim.run_observed(state, tel, tel_spec, 1)
+            state, tel, prov = _unpack_obs(
+                sim.run_observed(state, tel, tel_spec, 1, prov=prov,
+                                 prov_spec=prov_spec), tel, prov)
         if sim.converged(state, target):
             converged_round = int(state.t)
     rec = sim.received_node_major(state)
@@ -201,6 +292,16 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                    topology=topology, msgs_total=int(state.msgs),
                    path="structured" if structured else "gather",
                    spec=spec.to_meta())
+    if prov is not None:
+        from ..tpu_sim.engine import host_unpack_bits
+
+        ok = _finish_provenance(
+            ok, details, prov, prov_spec, spec, workload="broadcast",
+            check_kw=dict(nbrs=sim.nbrs,
+                          received=host_unpack_bits(rec, nv),
+                          msgs_total=int(state.msgs),
+                          parts=(None if parts is None
+                                 else parts.to_meta())))
     runner_kw = dict(n_values=n_values, topology=topology,
                      sync_every=sync_every,
                      structured=bool(structured),
@@ -220,7 +321,7 @@ def run_counter_nemesis(spec: NemesisSpec, *,
                         max_recovery_rounds: int = 64,
                         union_block: "int | str | None" = None,
                         mesh=None, traffic=None, telemetry=None,
-                        observe_dir=None) -> dict:
+                        provenance=None, observe_dir=None) -> dict:
     """G-counter under the nemesis: per-node deltas acked at round 0,
     convergence = pending fully drained AND every node's cached read
     equals the KV.  Lost acknowledged writes = the final shortfall
@@ -231,10 +332,14 @@ def run_counter_nemesis(spec: NemesisSpec, *,
     ``traffic`` (PR 7): open-loop composition — adds keep arriving
     through the fault windows and the serving certifier takes over
     (see :func:`run_broadcast_nemesis`); ``deltas`` is ignored (each
-    traffic op adds 1)."""
+    traffic op adds 1).
+
+    ``provenance`` (PR 9): the per-node flush→kv→visibility stamps
+    (see :func:`run_broadcast_nemesis`)."""
     from . import observe
     if traffic is not None:
         from . import serving
+        _no_traffic_provenance(provenance)
         return serving.run_serving(
             "counter", traffic, nemesis=spec, mesh=mesh,
             max_recovery_rounds=max_recovery_rounds,
@@ -254,12 +359,18 @@ def run_counter_nemesis(spec: NemesisSpec, *,
         telemetry, "counter", clear + max_recovery_rounds)
     tel = (sim.telemetry_state(tel_spec) if tel_spec is not None
            else None)
+    prov_spec = observe.provenance_setup(provenance, "counter")
+    prov = (sim.provenance_state(prov_spec)
+            if prov_spec is not None else None)
+    obs_on = tel is not None or prov is not None
     if clear > 0:
-        if tel is None:
+        if not obs_on:
             state = sim.run_fused(state, clear)
         else:
-            state, tel = sim.run_observed(state, tel, tel_spec,
-                                          clear, donate=True)
+            state, tel, prov = _unpack_obs(
+                sim.run_observed(state, tel, tel_spec, clear,
+                                 donate=True, prov=prov,
+                                 prov_spec=prov_spec), tel, prov)
     msgs_at_clear = int(state.msgs)
 
     def converged(s) -> bool:
@@ -269,10 +380,12 @@ def run_counter_nemesis(spec: NemesisSpec, *,
     converged_round = clear if converged(state) else None
     while converged_round is None \
             and int(state.t) < clear + max_recovery_rounds:
-        if tel is None:
+        if not obs_on:
             state = sim.step(state)
         else:
-            state, tel = sim.run_observed(state, tel, tel_spec, 1)
+            state, tel, prov = _unpack_obs(
+                sim.run_observed(state, tel, tel_spec, 1, prov=prov,
+                                 prov_spec=prov_spec), tel, prov)
         if converged(state):
             converged_round = int(state.t)
     shortfall = acked_sum - sim.kv_value(state) \
@@ -285,6 +398,9 @@ def run_counter_nemesis(spec: NemesisSpec, *,
     details.update(workload="counter", n_nodes=n, mode=mode,
                    acked_sum=acked_sum, kv=sim.kv_value(state),
                    msgs_total=int(state.msgs), spec=spec.to_meta())
+    ok = _finish_provenance(
+        ok, details, prov, prov_spec, spec, workload="counter",
+        check_kw=dict(final_kv=int(sim.kv_value(state))))
     deltas_kw = (None if np.array_equal(
         deltas, np.arange(1, n + 1, dtype=np.int32))
         else [int(d) for d in np.asarray(deltas)])
@@ -353,7 +469,7 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
                       commits: bool = True,
                       send_prob: float = 0.7,
                       mesh=None, traffic=None, telemetry=None,
-                      observe_dir=None) -> dict:
+                      provenance=None, observe_dir=None) -> dict:
     """Replicated log under the nemesis: seeded send/commit traffic at
     live nodes through the faulted phase, then quiescent recovery.
     Convergence = every node's presence bitset identical (the periodic
@@ -382,10 +498,16 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
     through the fault windows via the sim's own send staging and the
     serving certifier takes over (see :func:`run_broadcast_nemesis`);
     the staged-campaign knobs (``workload_seed``/``commits``/
-    ``send_prob``/``rounds``/``repl_fast``) are inert in that mode."""
+    ``send_prob``/``rounds``/``repl_fast``) are inert in that mode.
+
+    ``provenance`` (PR 9): the per-(key, slot) allocation-round +
+    origin + witness-first-presence stamps (see
+    :func:`run_broadcast_nemesis`; the witness node comes from the
+    ``ProvenanceSpec``)."""
     from . import observe
     if traffic is not None:
         from . import serving
+        _no_traffic_provenance(provenance)
         return serving.run_serving(
             "kafka", traffic, nemesis=spec, mesh=mesh,
             max_recovery_rounds=max_recovery_rounds,
@@ -409,40 +531,48 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
         telemetry, "kafka", clear + max_recovery_rounds)
     tel = (sim.telemetry_state(tel_spec) if tel_spec is not None
            else None)
+    prov_spec = observe.provenance_setup(provenance, "kafka")
+    prov = (sim.provenance_state(prov_spec)
+            if prov_spec is not None else None)
+    obs_on = tel is not None or prov is not None
     state = sim.init_state()
     if clear > 0:
-        if tel is None:
+        if not obs_on:
             state = sim.run_fused(state, sks, svs, crs)
         else:
-            state, tel = sim.run_observed(state, tel, tel_spec, sks,
-                                          svs, crs, donate=True)
+            state, tel, prov = _unpack_obs(
+                sim.run_observed(state, tel, tel_spec, sks, svs, crs,
+                                 donate=True, prov=prov,
+                                 prov_spec=prov_spec), tel, prov)
     msgs_at_clear = int(state.msgs)
 
     def converged(s) -> bool:
         pres = np.asarray(s.present)
         return bool((pres == pres[:1]).all())
 
-    def step1(s, tl):
-        if tl is not None:
+    def step1(s, tl, pv):
+        if tl is not None or pv is not None:
             # quiescent observed round: a 1-round empty send batch
             # through the same scan driver (commit-free — the traced
             # all--1 commit_req constant, bit-identical to step())
             sk1 = np.full((1, n, max_sends), -1, np.int32)
-            return sim.run_observed(s, tl, tel_spec, sk1,
-                                    np.zeros_like(sk1))
+            return _unpack_obs(
+                sim.run_observed(s, tl, tel_spec, sk1,
+                                 np.zeros_like(sk1), prov=pv,
+                                 prov_spec=prov_spec), tl, pv)
         if commits:
-            return sim.step(s), None
+            return sim.step(s), None, None
         # send-only campaigns drive quiescent recovery rounds through
         # run_rounds with NO commit operand — the (N, K) all--1
         # commit_req host array a plain step() stages every round is
         # itself O(N²/16) at the large-N shapes
         sk1 = np.full((1, n, max_sends), -1, np.int32)
-        return sim.run_rounds(s, sk1, np.zeros_like(sk1)), None
+        return sim.run_rounds(s, sk1, np.zeros_like(sk1)), None, None
 
     converged_round = clear if converged(state) else None
     while converged_round is None \
             and int(state.t) < clear + max_recovery_rounds:
-        state, tel = step1(state, tel)
+        state, tel, prov = step1(state, tel, prov)
         if converged(state):
             converged_round = int(state.t)
 
@@ -463,6 +593,12 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
     details.update(workload="kafka", n_nodes=n, n_keys=n_keys,
                    n_allocated=int(allocated.sum()),
                    msgs_total=int(state.msgs), spec=spec.to_meta())
+    ok = _finish_provenance(
+        ok, details, prov, prov_spec, spec, workload="kafka",
+        check_kw=dict(n_nodes=n, resync_every=resync_every,
+                      resync_mode=resync_mode,
+                      witness=(prov_spec.witness
+                               if prov_spec is not None else 0)))
     runner_kw = dict(n_keys=n_keys, capacity=capacity,
                      max_sends=max_sends, resync_every=resync_every,
                      resync_mode=resync_mode,

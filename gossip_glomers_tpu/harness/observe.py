@@ -27,9 +27,26 @@ Three artifacts per observed run, all plain JSON:
   mesh/off-mesh parity is pinned, so a fuzzer-found failure is a
   one-file repro).
 
-Also here: :func:`telemetry_setup` (how the scenario runners resolve
-their ``telemetry=`` argument against the ``GG_TELEMETRY`` /
-``GG_TELEMETRY_SERIES`` env knobs) and :func:`profiled` (optional
+PR 9 adds the CAUSAL layer over the per-(message/op) provenance
+record (tpu_sim/provenance.py):
+
+- :func:`dissemination_tree` rebuilds the per-value spanning trees
+  from a broadcast ``(arrival, parent)`` record — per-value depth /
+  hop-latency attribution, the critical path (the hop chain that
+  bounded convergence), and the per-edge utilization table;
+- :class:`TimelineBuilder` gains Perfetto FLOW events (causal
+  arrows), and :func:`run_timeline` draws them for the recorded
+  dissemination trees next to the existing round/fault/series tracks;
+- the flight bundle carries the provenance spec + stamp arrays, and
+  :func:`replay_bundle` re-runs the scenario and reports the
+  **first-divergence round** (recorded vs replayed telemetry series
+  and provenance stamps — the item-2 fuzzer's shrinker signal;
+  ``None`` for a faithful replay).
+
+Also here: :func:`telemetry_setup` / :func:`provenance_setup` (how
+the scenario runners resolve their ``telemetry=`` / ``provenance=``
+arguments against the ``GG_TELEMETRY`` / ``GG_TELEMETRY_SERIES`` /
+``GG_PROVENANCE`` env knobs) and :func:`profiled` (optional
 ``jax.profiler`` capture around driver dispatch; a clean no-op
 wherever the profiler is unavailable, e.g. CPU CI).
 """
@@ -42,14 +59,17 @@ import os
 import tempfile
 import time
 
+from ..tpu_sim import provenance as PV
 from ..tpu_sim import telemetry as TM
 
 US_PER_ROUND = 1000.0     # 1 round = 1 ms of trace time
 _MAX_ROUND_SLICES = 4096  # timeline cap; longer runs keep counters only
+_MAX_FLOW_VALUES = 8      # flow arrows drawn for at most this many values
 
 MANIFEST_SCHEMA = "gg-run-manifest/1"
 TIMELINE_SCHEMA = "gg-timeline/1"
 BUNDLE_SCHEMA = "gg-flight-bundle/1"
+TREE_SCHEMA = "gg-dissemination-tree/1"
 
 
 # -- runner-side telemetry resolution ------------------------------------
@@ -82,6 +102,26 @@ def telemetry_setup(telemetry, workload: str, rounds: int,
     return spec
 
 
+def provenance_setup(provenance, workload: str):
+    """Resolve a scenario runner's ``provenance=`` argument to a
+    :class:`~..tpu_sim.provenance.ProvenanceSpec` or None — the
+    :func:`telemetry_setup` contract: ``None`` consults the
+    ``GG_PROVENANCE`` env switch (default off), ``True``/``False``
+    force, a ``ProvenanceSpec`` is used as-is (workload validated)."""
+    if provenance is None:
+        provenance = PV.enabled()
+    if provenance is False:
+        return None
+    if provenance is True:
+        return PV.default_spec(workload)
+    spec = provenance
+    if spec.workload != workload:
+        raise ValueError(
+            f"ProvenanceSpec(workload={spec.workload!r}) does not "
+            f"match this run (workload={workload!r})")
+    return spec
+
+
 # -- the shared Perfetto serializer --------------------------------------
 
 
@@ -95,6 +135,7 @@ class TimelineBuilder:
         self.name = name
         self.events: list[dict] = []
         self._tids: dict[str, int] = {}
+        self._flow_id = 0
         self.events.append({"ph": "M", "pid": 1, "tid": 0,
                             "name": "process_name",
                             "args": {"name": name}})
@@ -116,6 +157,27 @@ class TimelineBuilder:
         if args:
             ev["args"] = args
         self.events.append(ev)
+
+    def flow(self, name: str, src_track: str, src_ts_us: float,
+             dst_track: str, dst_ts_us: float,
+             args: dict | None = None) -> int:
+        """One causal arrow (a Chrome-trace flow event pair, PR 9):
+        start on ``src_track`` at ``src_ts_us``, finish on
+        ``dst_track`` at ``dst_ts_us`` — Perfetto renders it as an
+        arrow between the enclosing slices.  Returns the flow id."""
+        self._flow_id += 1
+        fid = self._flow_id
+        start = {"ph": "s", "pid": 1, "tid": self._tid(src_track),
+                 "id": fid, "name": name, "cat": "flow",
+                 "ts": round(float(src_ts_us), 3)}
+        end = {"ph": "f", "pid": 1, "tid": self._tid(dst_track),
+               "id": fid, "name": name, "cat": "flow", "bp": "e",
+               "ts": round(float(dst_ts_us), 3)}
+        if args:
+            start["args"] = args
+        self.events.append(start)
+        self.events.append(end)
+        return fid
 
     def counter(self, track: str, name: str, ts_us: float,
                 value) -> None:
@@ -179,7 +241,162 @@ def run_timeline(result: dict, *, name: str | None = None) -> dict:
             continue
         for t, v in zip(rounds_idx, vals):
             tb.counter("telemetry", sname, t * u, v)
+    prov = result.get("provenance") or {}
+    if (prov.get("spec") or {}).get("workload") == "broadcast" \
+            and prov.get("arrays"):
+        add_provenance_flows(tb, prov["arrays"])
     return tb.to_dict()
+
+
+def add_provenance_flows(tb: TimelineBuilder, arrays: dict, *,
+                         max_values: int = _MAX_FLOW_VALUES) -> int:
+    """Draw a broadcast provenance record's dissemination trees as
+    Perfetto FLOW events (PR 9): per tree edge one ``node {src}``
+    slice at the parent's arrival round, one ``node {dst}`` slice at
+    the child's, and the causal arrow between them.  Only the
+    ``max_values`` values with the DEEPEST trees are drawn (the
+    critical-path ones — a full record is O(N·V) arrows); returns the
+    number of flows emitted."""
+    import numpy as np
+
+    u = US_PER_ROUND
+    arrival = np.asarray(arrays["arrival"])
+    parent = np.asarray(arrays["parent"])
+    depth = arrival.max(axis=0)                       # (V,)
+    order = np.argsort(-depth)[:max_values]
+    seen: set[tuple[int, int]] = set()
+    n_flows = 0
+    for v in order:
+        if depth[v] < 1:
+            continue
+        for i in np.nonzero((arrival[:, v] > 0)
+                            & (parent[:, v] >= 0))[0]:
+            p, ac = int(parent[i, v]), int(arrival[i, v])
+            ap = int(arrival[p, v])
+            for node, t in ((p, ap), (int(i), ac)):
+                if (node, t) not in seen:
+                    seen.add((node, t))
+                    tb.slice(f"node {node}", f"t{t}", t * u, u)
+            tb.flow(f"v{int(v)}", f"node {p}", ap * u + u / 2,
+                    f"node {int(i)}", ac * u + u / 2,
+                    args={"value": int(v), "hop_rounds": ac - ap})
+            n_flows += 1
+    return n_flows
+
+
+# -- dissemination trees (PR 9) ------------------------------------------
+
+
+def dissemination_tree(arrays: dict, *, max_edges: int = 16,
+                       max_chain: int = 64) -> dict:
+    """Rebuild the per-value spanning trees of a broadcast provenance
+    record (tpu_sim/provenance.py ``arrays_of``: ``arrival`` (N, V)
+    and ``parent`` (N, V) int32) and attribute hop latency:
+
+    - per value: nodes reached, tree depth (hops) vs arrival span
+      (rounds — the two differ exactly by the per-hop queueing the
+      sync cadence/delays/faults added), mean hop latency;
+    - the CRITICAL PATH: the origin→leaf hop chain ending at the
+      globally last arrival — the chain that bounded convergence —
+      with its per-hop rounds;
+    - the ``max_edges`` busiest directed edges with use counts and
+      mean per-hop latency (the per-edge utilization table).
+
+    Pure numpy over the host copy; JSON-able output
+    (:func:`validate_tree`)."""
+    import numpy as np
+
+    arrival = np.asarray(arrays["arrival"], np.int64)
+    parent = np.asarray(arrays["parent"], np.int64)
+    n, nv = arrival.shape
+    child = (arrival > 0) & (parent >= 0)
+    ii, vv = np.nonzero(child)
+    pa = parent[ii, vv]
+    hop = arrival[ii, vv] - arrival[pa, vv]           # per-edge rounds
+    # depth via iterated parent-pointer doubling: depth[origin] = 0,
+    # depth[child] = depth[parent] + 1
+    depth = np.where(arrival == 0, 0, -1)
+    for _ in range(n):
+        pd = depth[pa, vv]
+        upd = (depth[ii, vv] < 0) & (pd >= 0)
+        if not upd.any():
+            break
+        depth[ii[upd], vv[upd]] = pd[upd] + 1
+    values = []
+    for v in range(nv):
+        mask = arrival[:, v] >= 0
+        if not mask.any():
+            continue
+        e = vv == v
+        values.append({
+            "value": v,
+            "n_reached": int(mask.sum()),
+            "n_origins": int((arrival[:, v] == 0).sum()),
+            "depth_hops": int(max(depth[:, v].max(), 0)),
+            "span_rounds": int(arrival[:, v].max()),
+            "mean_hop_rounds": (round(float(hop[e].mean()), 3)
+                                if e.any() else 0.0),
+        })
+    # critical path: walk parents back from the globally last arrival
+    chain = []
+    if (arrival >= 0).any():
+        flat = np.argmax(arrival)
+        i, v = int(flat // nv), int(flat % nv)
+        while len(chain) < max_chain:
+            chain.append({"node": i, "round": int(arrival[i, v])})
+            if arrival[i, v] <= 0 or parent[i, v] < 0:
+                break
+            i = int(parent[i, v])
+        chain.reverse()
+    edges: dict[tuple[int, int], list] = {}
+    for s, d, h in zip(pa, ii, hop):
+        cur = edges.setdefault((int(s), int(d)), [0, 0])
+        cur[0] += 1
+        cur[1] += int(h)
+    top = sorted(edges.items(), key=lambda kv: -kv[1][0])[:max_edges]
+    return {
+        "schema": TREE_SCHEMA,
+        "n_nodes": n,
+        "n_values": nv,
+        "n_tree_edges": int(child.sum()),
+        "max_depth_hops": int(max(depth.max(), 0)),
+        "max_span_rounds": int(max(arrival.max(), 0)),
+        "values": values,
+        "critical_path": {
+            "value": (chain and int(np.argmax(arrival) % nv)) or 0,
+            "hops": max(len(chain) - 1, 0),
+            "span_rounds": (int(chain[-1]["round"]) if chain else 0),
+            "chain": chain,
+        },
+        "edges": [{"src": s, "dst": d, "n_values": c,
+                   "mean_hop_rounds": round(t / c, 3)}
+                  for (s, d), (c, t) in top],
+    }
+
+
+def validate_tree(d: dict) -> None:
+    """Loud schema check for a dissemination-tree artifact (the CI
+    provenance-smoke gate)."""
+    if d.get("schema") != TREE_SCHEMA:
+        raise ValueError(
+            f"tree schema {d.get('schema')!r} != {TREE_SCHEMA!r}")
+    for key in ("n_nodes", "n_values", "n_tree_edges", "values",
+                "critical_path", "edges"):
+        if key not in d:
+            raise ValueError(f"dissemination tree missing {key!r}")
+    for row in d["values"]:
+        for key in ("value", "n_reached", "depth_hops", "span_rounds"):
+            if key not in row:
+                raise ValueError(f"tree value row missing {key!r}")
+    cp = d["critical_path"]
+    if cp["chain"]:
+        rounds = [c["round"] for c in cp["chain"]]
+        if rounds != sorted(rounds):
+            raise ValueError("critical path rounds not monotone")
+    for e in d["edges"]:
+        if not (0 <= e["src"] < d["n_nodes"]
+                and 0 <= e["dst"] < d["n_nodes"]):
+            raise ValueError(f"edge out of range: {e}")
 
 
 def validate_timeline(d: dict) -> None:
@@ -192,13 +409,28 @@ def validate_timeline(d: dict) -> None:
     events = d.get("traceEvents")
     if not isinstance(events, list) or not events:
         raise ValueError("timeline has no traceEvents")
+    flows: dict = {}
     for ev in events:
-        if ev.get("ph") not in ("M", "X", "C", "i"):
+        if ev.get("ph") not in ("M", "X", "C", "i", "s", "f"):
             raise ValueError(f"unknown event phase {ev.get('ph')!r}")
-        if ev["ph"] in ("X", "C") and "ts" not in ev:
+        if ev["ph"] in ("X", "C", "s", "f") and "ts" not in ev:
             raise ValueError(f"event missing ts: {ev}")
         if ev["ph"] == "X" and "dur" not in ev:
             raise ValueError(f"slice missing dur: {ev}")
+        if ev["ph"] in ("s", "f"):
+            if "id" not in ev:
+                raise ValueError(f"flow event missing id: {ev}")
+            flows.setdefault(ev["id"], []).append(ev)
+    for fid, evs in flows.items():
+        phs = sorted(e["ph"] for e in evs)
+        if phs != ["f", "s"]:
+            raise ValueError(
+                f"flow {fid} is not a start/finish pair: {phs}")
+        s_ev = next(e for e in evs if e["ph"] == "s")
+        f_ev = next(e for e in evs if e["ph"] == "f")
+        if f_ev["ts"] < s_ev["ts"]:
+            raise ValueError(
+                f"flow {fid} finishes before it starts (causality)")
 
 
 # -- run manifests -------------------------------------------------------
@@ -303,10 +535,15 @@ def write_flight_bundle(out_dir: str, *, kind: str, workload: str,
                         runner_kw: dict | None = None,
                         telemetry_spec: dict | None = None,
                         telemetry_series: dict | None = None,
+                        provenance_spec: dict | None = None,
+                        provenance: dict | None = None,
                         failure: dict | None = None) -> str:
     """Write the one-file repro bundle for a failed run (module
     docstring).  ``kind``: ``"nemesis"`` (a ``run_*_nemesis``
     campaign) or ``"serving"`` (a ``run_serving`` open-loop run).
+    ``provenance_spec``/``provenance`` (PR 9): the ProvenanceSpec
+    meta and recorded stamp arrays (as nested lists) — the replay
+    re-records and diffs them for the first-divergence round.
     Everything needed to replay rides inside; the write is atomic."""
     if kind not in ("nemesis", "serving"):
         raise ValueError(f"unknown bundle kind {kind!r}")
@@ -321,6 +558,8 @@ def write_flight_bundle(out_dir: str, *, kind: str, workload: str,
         "runner_kw": runner_kw or {},
         "telemetry_spec": telemetry_spec,
         "telemetry_series": telemetry_series,
+        "provenance_spec": provenance_spec,
+        "provenance": provenance,
         "failure": failure or {},
     }
     seed_bits = []
@@ -354,14 +593,48 @@ def load_bundle(path_or_dict) -> dict:
     return bundle
 
 
+def replay_divergence(bundle: dict, result: dict) -> int | None:
+    """First round at which a replay's re-recorded observability
+    record disagrees with its bundle (PR 9) — ``None`` for a faithful
+    replay.  Checks the telemetry series
+    (checkers.series_divergence_round) and the provenance stamps
+    (checkers.provenance_divergence_round); the minimum firing round
+    wins.  This is the item-2 fuzzer's auto-shrinker signal: a
+    shrunk fault spec whose replay diverges EARLIER than the failure
+    round changed the trajectory, not just the verdict."""
+    from .checkers import (provenance_divergence_round,
+                           series_divergence_round)
+
+    cands = []
+    exp_series = bundle.get("telemetry_series")
+    got_series = (result.get("telemetry") or {}).get("series")
+    if exp_series and got_series:
+        d = series_divergence_round(exp_series, got_series)
+        if d is not None:
+            cands.append(d)
+    exp_prov = bundle.get("provenance")
+    got_prov = (result.get("provenance") or {}).get("arrays")
+    if exp_prov and got_prov:
+        d = provenance_divergence_round(exp_prov, got_prov)
+        if d is not None:
+            cands.append(d)
+    return min(cands) if cands else None
+
+
 def replay_bundle(path_or_dict, *, telemetry=False) -> dict:
     """Re-run a flight bundle's scenario from its own JSON alone and
     return the fresh verdict dict — the repro contract: every run is
     a pure function of its seeded specs (and sim results are pinned
     bit-exact across mesh layouts), so the replay reproduces the
-    recorded failure.  Telemetry is off by default on replay (the
-    bundle already carries the series); pass ``telemetry=True`` to
-    re-record."""
+    recorded failure.
+
+    PR 9: when the bundle carries a recorded telemetry/provenance
+    record, the replay re-records it (the bundle's own spec), diffs
+    the two
+    (:func:`replay_divergence`), and reports
+    ``result['first_divergence_round']`` — None when the replay is
+    bit-faithful (the deterministic-replay contract), else the
+    earliest diverging round (the shrinker signal)."""
     from ..tpu_sim.faults import NemesisSpec
     from ..tpu_sim.traffic import TrafficSpec
     from . import nemesis as NM
@@ -370,24 +643,38 @@ def replay_bundle(path_or_dict, *, telemetry=False) -> dict:
     bundle = load_bundle(path_or_dict)
     spec = (NemesisSpec.from_meta(bundle["nemesis"])
             if bundle.get("nemesis") else None)
+    has_record = bool(bundle.get("telemetry_series")
+                      or bundle.get("provenance"))
+    if bundle.get("telemetry_series"):
+        telemetry = (telemetry
+                     or TM.TelemetrySpec.from_meta(
+                         bundle["telemetry_spec"]))
     if bundle["kind"] == "serving":
         if not bundle.get("traffic"):
             raise ValueError("serving bundle has no traffic spec")
         kw = dict(bundle.get("runner_kw") or {})
-        return SV.run_serving(
+        result = SV.run_serving(
             bundle["workload"], TrafficSpec.from_meta(bundle["traffic"]),
             nemesis=spec, sim_kw=bundle.get("sim_kw") or {},
             telemetry=telemetry, **kw)
-    runners = {"broadcast": NM.run_broadcast_nemesis,
-               "counter": NM.run_counter_nemesis,
-               "kafka": NM.run_kafka_nemesis}
-    if spec is None:
-        raise ValueError("nemesis bundle has no NemesisSpec")
-    kw = dict(bundle.get("runner_kw") or {})
-    if bundle.get("traffic"):
-        kw["traffic"] = TrafficSpec.from_meta(bundle["traffic"])
-    return runners[bundle["workload"]](spec, telemetry=telemetry,
-                                       **kw)
+    else:
+        runners = {"broadcast": NM.run_broadcast_nemesis,
+                   "counter": NM.run_counter_nemesis,
+                   "kafka": NM.run_kafka_nemesis}
+        if spec is None:
+            raise ValueError("nemesis bundle has no NemesisSpec")
+        kw = dict(bundle.get("runner_kw") or {})
+        if bundle.get("traffic"):
+            kw["traffic"] = TrafficSpec.from_meta(bundle["traffic"])
+        if bundle.get("provenance_spec"):
+            kw["provenance"] = PV.ProvenanceSpec.from_meta(
+                bundle["provenance_spec"])
+        result = runners[bundle["workload"]](spec, telemetry=telemetry,
+                                             **kw)
+    if has_record:
+        result["first_divergence_round"] = replay_divergence(bundle,
+                                                             result)
+    return result
 
 
 # -- optional jax.profiler capture ---------------------------------------

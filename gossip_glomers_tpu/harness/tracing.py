@@ -50,13 +50,17 @@ def load_jsonl(fp: IO[str]) -> list[tuple[float, Message]]:
 
 def to_timeline(trace: list[tuple[float, Message]], *,
                 name: str = "virtual-harness",
-                us_per_s: float = 1e6) -> dict:
+                us_per_s: float = 1e6, flows: bool = True) -> dict:
     """Export a captured virtual-network trace to the SAME
     Perfetto/Chrome-trace format the tpu_sim telemetry timelines use
     (harness/observe.py :class:`~.observe.TimelineBuilder`), so
     virtual-harness and tpu_sim runs are visually comparable: one
     thread per source id, a slice per routed message at its virtual
-    timestamp, and a cumulative message counter track."""
+    timestamp, a cumulative message counter track, and (PR 9) one
+    causal FLOW arrow per message from the source's slice to the
+    destination's track — the same arrows the tpu_sim provenance
+    record draws (observe.add_provenance_flows), so per-message
+    causality renders identically for both backends."""
     from .observe import TimelineBuilder
 
     tb = TimelineBuilder(name)
@@ -65,6 +69,9 @@ def to_timeline(trace: list[tuple[float, Message]], *,
         ts = t * us_per_s
         tb.slice(f"src {msg.src}", msg.type, ts, 1.0,
                  args={"dest": msg.dest})
+        if flows:
+            tb.flow(msg.type, f"src {msg.src}", ts,
+                    f"src {msg.dest}", ts + 1.0)
         total += 1
         tb.counter("net", "msgs_total", ts, total)
     return tb.to_dict()

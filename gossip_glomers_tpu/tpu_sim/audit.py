@@ -376,10 +376,11 @@ def audit_contract(contract: ProgramContract, mesh=None) -> dict:
 def default_registry() -> list[ProgramContract]:
     """Every registered driver contract, collected from the sims (each
     stateful sim module owns its own ``audit_contracts()``; telemetry
-    registers the observed-driver rows, PR 8)."""
-    from . import broadcast, counter, kafka, telemetry
+    registers the observed-driver rows, PR 8; provenance the
+    stamp-carrying rows, PR 9)."""
+    from . import broadcast, counter, kafka, provenance, telemetry
     out: list[ProgramContract] = []
-    for mod in (broadcast, counter, kafka, telemetry):
+    for mod in (broadcast, counter, kafka, telemetry, provenance):
         out.extend(mod.audit_contracts())
     names = [c.name for c in out]
     if len(set(names)) != len(names):
@@ -442,20 +443,31 @@ def _telemetry_roots() -> str:
             + ")$")
 
 
+def _provenance_roots() -> str:
+    # provenance.py declares its split the same way (PR 9; totality
+    # pinned by tests/test_provenance.py)
+    from . import provenance
+    return ("^(" + "|".join(re.escape(n)
+                            for n in provenance.TRACED_EVALUATORS)
+            + ")$")
+
+
 _TRACED_ROOTS: dict[str, str] = {
     "tpu_sim/broadcast.py":
         r"^(_round|flood_step$|_wm_round_single$|_sharded_round"
         r"|_live_rows$|_edge_live$|_popcount$|_flood_loop$"
         r"|_flood_ledger$|_traffic_inject$|_traffic_done$"
-        r"|_tel_series$|_traffic_tel$)",
+        r"|_tel_series$|_traffic_tel$|_prov_attribute$)",
     "tpu_sim/counter.py":
-        r"^(_round$|_reach$|_traffic_round$|_tel_series$)",
+        r"^(_round$|_reach$|_traffic_round$|_tel_series$"
+        r"|_prov_record$)",
     "tpu_sim/kafka.py":
         r"^(_round$|_rank_within_key$|_alloc$|_traffic_round$"
-        r"|_tel_series$)",
+        r"|_tel_series$|_prov_record$)",
     "tpu_sim/faults.py": _faults_roots(),
     "tpu_sim/traffic.py": _traffic_roots(),
     "tpu_sim/telemetry.py": _telemetry_roots(),
+    "tpu_sim/provenance.py": _provenance_roots(),
     "tpu_sim/engine.py":
         r"^(sharded_roll$|sharded_shift$|collectives$|fori_rounds$"
         r"|windows_fold$|scan_blocks$|scan_rounds$|while_converge$)",

@@ -43,11 +43,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import faults, telemetry, traffic
+from . import faults, provenance, telemetry, traffic
 from .engine import (collectives, donate_argnums_for, fori_rounds,
                      jit_program, resolve_block, scan_blocks,
-                     shard_map, stepwise_converge, while_converge,
-                     windows_fold)
+                     shard_map, stepwise_converge, unpack_bits,
+                     while_converge, windows_fold)
 from .structured import _take_delayed
 
 WORD = 32
@@ -311,6 +311,35 @@ def _flood_ledger(state: BroadcastState, rec, fr, degs, masks,
                           msgs=state.msgs + reduce_sum(sent))
 
 
+def _prov_attribute(prov, new: jnp.ndarray, nbrs: jnp.ndarray,
+                    term_fn, t_next):
+    """Causal provenance write for one gather round (PR 9): stamp
+    ``arrival = t_next`` and ``parent = nbrs[:, d]`` at exactly the
+    per-(node, value) cells where the round's ``new`` bits landed,
+    ``d`` being the FIRST direction whose delivery term carries the
+    bit (``term_fn(d)`` -> the (rows, W) delivered words of direction
+    ``d`` — the same terms the round's inbox OR already summed, so the
+    recorder re-reads state in scope and adds no collectives).  Writes
+    are first-incarnation (:func:`provenance.stamp` semantics): a bit
+    re-learned after an amnesia wipe keeps its original arrival and
+    parent, which is what keeps ``arrival[parent] < arrival[child]``
+    true across crash/restart.  Shard-local throughout: ``nbrs`` holds
+    global ids, the (rows, V) stamps shard with the node axis."""
+    nv = prov.arrival.shape[1]
+    fresh = unpack_bits(new, nv) & (prov.arrival < 0)
+    parent = prov.parent
+    remaining = new
+    for d in range(nbrs.shape[1]):
+        hit = term_fn(d) & remaining
+        remaining = remaining & ~hit
+        src = lax.dynamic_index_in_dim(nbrs, d, axis=1,
+                                       keepdims=True)    # (rows, 1)
+        parent = jnp.where(unpack_bits(hit, nv) & fresh, src, parent)
+    arrival = jnp.where(fresh, jnp.asarray(t_next, jnp.int32),
+                        prov.arrival)
+    return provenance.BroadcastProv(arrival=arrival, parent=parent)
+
+
 def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
            nbrs: jnp.ndarray, nbr_mask: jnp.ndarray, parts: Partitions,
            sync_every: int,
@@ -323,7 +352,8 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
            plan: "faults.FaultPlan | None" = None,
            dup_on: bool = False,
            union_block: int | None = None,
-           ) -> BroadcastState:
+           prov: "provenance.BroadcastProv | None" = None,
+           ) -> "BroadcastState | tuple":
     """One simulation round == one base network hop — the single source
     of the node-major (adjacency-gather) round semantics, shared by the
     single-device and sharded paths.  (Structured topologies use the
@@ -356,6 +386,14 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
     ``msgs`` ledger, whose per-slab partial sums are exact modular
     adds.  Applies to 1-hop faulted rounds with the srv ledger off
     (``delays`` rings and the srv pass keep the materialized shape).
+
+    ``prov`` (PR 9): a :class:`provenance.BroadcastProv` record — the
+    round additionally returns ``(state, prov)`` with per-(node,
+    value) arrival-round + parent stamps written where the ``new``
+    bits land (:func:`_prov_attribute`; 1-hop AND per-edge ``delays``
+    paths).  Provenance runs the materialized round (the blocked
+    streaming branch is bit-identical, so the observed drivers simply
+    pass ``union_block=None``).
     """
     if plan is None:
         rec0, fr0 = state.received, state.frontier
@@ -370,7 +408,8 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
     payload = jnp.where(is_sync, rec0, fr0)
     payload_full = widen(payload)
     if (union_block is not None and plan is not None
-            and delays is None and state.srv_msgs is None):
+            and delays is None and state.srv_msgs is None
+            and prov is None):
         # -- streaming faulted round (see docstring) ------------------
         rows = nbrs.shape[0]
         ub = union_block
@@ -524,12 +563,69 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
                 faults.node_up(plan, state.t, row_ids)[:, None],
                 inbox, jnp.uint32(0))
     new = inbox & ~rec0
-    return BroadcastState(received=rec0 | new,
-                          frontier=new,
-                          t=state.t + 1,
-                          msgs=state.msgs + sent,
-                          history=history,
-                          srv_msgs=srv)
+    out = BroadcastState(received=rec0 | new,
+                         frontier=new,
+                         t=state.t + 1,
+                         msgs=state.msgs + sent,
+                         history=history,
+                         srv_msgs=srv)
+    if prov is None:
+        return out
+    # -- provenance attribution (PR 9): re-read the round's own
+    #    per-direction delivery terms (payload_full / received_full /
+    #    the ring slices are all in scope — XLA CSEs the shared
+    #    subexpressions, so this adds ZERO collectives) and stamp the
+    #    new bits' arrival + parent
+    if delays is None:
+        def term(d):
+            idx = lax.dynamic_index_in_dim(nbrs, d, axis=1,
+                                           keepdims=False)
+            ok = lax.dynamic_index_in_dim(live_del, d, axis=1,
+                                          keepdims=True)
+            rows_d = payload_full[jnp.clip(idx, 0,
+                                           payload_full.shape[0] - 1)]
+            t_ = jnp.where(ok, rows_d, jnp.uint32(0))
+            if dup is not None:
+                okd = lax.dynamic_index_in_dim(dup, d, axis=1,
+                                               keepdims=True)
+                src_rows = received_full[
+                    jnp.clip(idx, 0, received_full.shape[0] - 1)]
+                t_ = t_ | jnp.where(okd, src_rows, jnp.uint32(0))
+            return t_
+    else:
+        # per-delay-class coins + ring slices, shared across
+        # directions (the _gather_or_delayed evaluation, re-read);
+        # dup never contributes NEW bits under delays (it re-delivers
+        # the identical in-flight block), so the terms skip it
+        ring = history.shape[0]
+        coins = {v: _live_split(state.t - (v - 1), row_ids, nbrs,
+                                nbr_mask, parts, plan, False)[1]
+                 for v in delay_set}
+        slices = {v: widen(lax.dynamic_index_in_dim(
+            history, (state.t - (v - 1)) % ring, axis=0,
+            keepdims=False)) for v in delay_set}
+        up_recv = (faults.node_up(plan, state.t, row_ids)[:, None]
+                   if plan is not None else None)
+
+        def term(d):
+            idx = lax.dynamic_index_in_dim(nbrs, d, axis=1,
+                                           keepdims=False)
+            dly = lax.dynamic_index_in_dim(delays, d, axis=1,
+                                           keepdims=False)
+            t_ = None
+            for v in delay_set:
+                src_t = state.t - (v - 1)
+                ok = (lax.dynamic_index_in_dim(coins[v], d, axis=1,
+                                               keepdims=False)
+                      & (dly == v) & (src_t >= 0))
+                rows_d = slices[v][jnp.clip(idx, 0,
+                                            slices[v].shape[0] - 1)]
+                one = jnp.where(ok[:, None], rows_d, jnp.uint32(0))
+                t_ = one if t_ is None else t_ | one
+            if up_recv is not None:
+                t_ = jnp.where(up_recv, t_, jnp.uint32(0))
+            return t_
+    return out, _prov_attribute(prov, new, nbrs, term, state.t + 1)
 
 
 def flood_step(state: BroadcastState, *, nbrs: jnp.ndarray,
@@ -539,9 +635,10 @@ def flood_step(state: BroadcastState, *, nbrs: jnp.ndarray,
                delay_set: tuple = (),
                plan: "faults.FaultPlan | None" = None,
                dup_on: bool = False,
-               union_block: int | None = None) -> BroadcastState:
+               union_block: int | None = None,
+               prov=None) -> "BroadcastState | tuple":
     """Single-device node-major round (the ``entry()`` compile-check
-    target)."""
+    target).  With ``prov`` returns ``(state, prov)`` (PR 9)."""
     row_ids = jnp.arange(nbrs.shape[0], dtype=jnp.int32)
     if delays is not None and not delay_set:
         # convenience for direct callers (entry(), tests): derive the
@@ -550,7 +647,7 @@ def flood_step(state: BroadcastState, *, nbrs: jnp.ndarray,
     return _round(state, row_ids=row_ids, nbrs=nbrs, nbr_mask=nbr_mask,
                   parts=parts, sync_every=sync_every, delays=delays,
                   delay_set=delay_set, plan=plan, dup_on=dup_on,
-                  union_block=union_block)
+                  union_block=union_block, prov=prov)
 
 
 def _round_wm(state: BroadcastState, *, deg: jnp.ndarray, sync_every: int,
@@ -1364,12 +1461,14 @@ class BroadcastSim:
 
     def _sharded_round(self, state: BroadcastState, nbrs, nbr_mask,
                        parts: Partitions,
-                       delays=None, plan=None) -> BroadcastState:
+                       delays=None, plan=None,
+                       prov=None) -> "BroadcastState | tuple":
         """The node-major round inside shard_map: global row ids from the
         shard index, payload all_gather-ed along 'nodes' (the gossip
         collective riding ICI), ledger psum-ed.  ``plan``: the traced
         FaultPlan operand (replicated; masks evaluated on global ids
-        per shard)."""
+        per shard).  With ``prov`` returns ``(state, prov)`` — the
+        stamps shard with the node axis, the attribution is local."""
         mesh_axes = tuple(self.mesh.axis_names)
         block = nbrs.shape[0]
         start = lax.axis_index("nodes") * block
@@ -1388,7 +1487,9 @@ class BroadcastSim:
             reduce_sum=lambda s: lax.psum(s, mesh_axes),
             delays=delays, delay_set=self._delay_set,
             sync_base_once=sync_base_once, plan=plan,
-            dup_on=self._fp_dup, union_block=self._ub)
+            dup_on=self._fp_dup,
+            union_block=None if prov is not None else self._ub,
+            prov=prov)
 
     @staticmethod
     def _live_rows(exists, same, starts, ends):
@@ -2082,70 +2183,122 @@ class BroadcastSim:
         return (telemetry.live_count(plan, s0.t, self.n_nodes),
                 g[0], g[1], g[2], s1.msgs)
 
-    def _build_observed(self, tspec: "telemetry.TelemetrySpec",
-                        donate: bool):
-        """The telemetry-on fused driver: the generic fixed-loop round
-        bodies (gather and words-major, single-device and mesh)
-        unchanged, a (state, ring) carry with a DYNAMIC trip count,
-        the ring donated with the state.  Delay-ring modes are not
-        wired (the traffic drivers' restriction)."""
-        if tspec.workload != "broadcast" or tspec.traffic:
+    def _build_observed(self, tspec: "telemetry.TelemetrySpec | None",
+                        pspec, donate: bool):
+        """The telemetry-/provenance-on fused driver (PR 8 / PR 9):
+        the generic fixed-loop round bodies unchanged, a
+        ``(state, tel?, prov?)`` carry with a DYNAMIC trip count,
+        every carry leaf donated together.  Telemetry rides the 1-hop
+        gather, per-edge ``delays`` gather, and words-major 1-hop
+        paths; provenance (``pspec``) rides the GATHER paths only —
+        the structured exchanges fold their per-direction terms
+        internally, so attribution there would re-run the exchange D
+        times.  Words-major delay-ring modes stay unwired."""
+        tl = tspec is not None
+        pv = pspec is not None
+        if not (tl or pv):
+            raise ValueError(
+                "observed drivers need a TelemetrySpec and/or a "
+                "ProvenanceSpec")
+        if tl and (tspec.workload != "broadcast" or tspec.traffic):
             raise ValueError(
                 "run_observed needs a TelemetrySpec(workload="
                 "'broadcast', traffic=False); open-loop runs record "
                 "through run_traffic(tel=...)")
-        if (self.delays is not None or self._delayed is not None
-                or self._edge is not None or self._nem_delayed):
+        if pv and self.words_major:
             raise ValueError(
-                "observed drivers run the 1-hop gather and "
-                "words-major paths; delay-ring modes are not wired")
+                "broadcast provenance rides the gather path (the "
+                "structured words-major exchanges fold their "
+                "direction terms internally — see "
+                "tpu_sim/provenance.py); drop exchange= for a "
+                "provenance-on run")
+        if pv and self.mesh is not None \
+                and "words" in self.mesh.axis_names:
+            raise ValueError(
+                "broadcast provenance runs on 1-D node meshes (the "
+                "(N, V) stamps shard with the node axis only)")
+        if self._delayed is not None or self._edge is not None \
+                or self._nem_delayed:
+            raise ValueError(
+                "observed drivers run the gather (1-hop and per-edge "
+                "delays) and 1-hop words-major paths; words-major "
+                "delay-ring modes are not wired")
         parts, sync_every = self.parts, self.sync_every
         wm = self.words_major
         mesh = self.mesh
-        dn = donate_argnums_for(donate, 0, 1)
-        tel_mask = tspec.static_mask
+        n_carry = 1 + int(tl) + int(pv)
+        dn = donate_argnums_for(donate, *range(n_carry))
+        tel_mask = tspec.static_mask if tl else None
         has_nem = self._nem is not None
+        ip = 1 + int(tl)             # prov position in the carry
+
+        def carry_of(state, tel, prov):
+            return ((state,) + ((tel,) if tl else ())
+                    + ((prov,) if pv else ()))
+
+        def mk_one(round_fn, plan, rs):
+            """The observed round body: run the round (provenance
+            threaded INTO it when on — the recorder re-reads the
+            delivery terms in scope), then append the telemetry row."""
+            def one(c):
+                s = c[0]
+                r = round_fn(s, c[ip] if pv else None)
+                s2, p2 = r if pv else (r, None)
+                out = (s2,)
+                if tl:
+                    out += (telemetry.record(
+                        c[1], s.t, self._tel_series(s, s2, plan, rs),
+                        tel_mask),)
+                if pv:
+                    out += (p2,)
+                return out
+
+            return one
 
         if mesh is None:
             extra = self._wm_extra_args() + self._fp_mesh_extra()[1]
 
             @functools.partial(jax.jit, donate_argnums=dn)
-            def run(state: BroadcastState, tel, n, nbrs, nbr_mask,
-                    deg, *rest):
+            def run(*a):
+                a = list(a)
+                state = a.pop(0)
+                tel = a.pop(0) if tl else None
+                prov0 = a.pop(0) if pv else None
+                n, nbrs, nbr_mask, deg = a[0], a[1], a[2], a[3]
+                rest = tuple(a[4:])
                 if wm:
                     plan = rest[3] if has_nem else None
                 else:
                     plan = rest[0] if rest else None
 
-                def one(c):
-                    s, tl = c
+                def round_fn(s, p):
                     if wm:
-                        s2 = self._wm_round_single(s, deg,
-                                                   rest or None)
-                    else:
-                        s2 = flood_step(
-                            s, nbrs=nbrs, nbr_mask=nbr_mask,
-                            parts=parts, sync_every=sync_every,
-                            delays=self.delays,
-                            delay_set=self._delay_set, plan=plan,
-                            dup_on=self._fp_dup, union_block=self._ub)
-                    return (s2, telemetry.record(
-                        tl, s.t,
-                        self._tel_series(s, s2, plan, lambda x: x),
-                        tel_mask))
+                        return self._wm_round_single(s, deg,
+                                                     rest or None)
+                    return flood_step(
+                        s, nbrs=nbrs, nbr_mask=nbr_mask,
+                        parts=parts, sync_every=sync_every,
+                        delays=self.delays,
+                        delay_set=self._delay_set, plan=plan,
+                        dup_on=self._fp_dup,
+                        union_block=None if pv else self._ub,
+                        prov=p)
 
-                return fori_rounds(one, (state, tel), n)
+                one = mk_one(round_fn, plan, lambda x: x)
+                return fori_rounds(one, carry_of(state, tel, prov0),
+                                   n)
 
-            def args_fn(state, tel, n):
-                return (state, tel, n, self.nbrs, self.nbr_mask,
-                        self.deg) + extra
+            def args_fn(state, tel, prov, n):
+                return carry_of(state, tel, prov) + (
+                    n, self.nbrs, self.nbr_mask, self.deg) + extra
 
-            runner = lambda state, tel, n: run(*args_fn(state, tel,
-                                                        n))
+            runner = lambda state, tel, prov, n: run(
+                *args_fn(state, tel, prov, n))
             return run, args_fn, runner
 
         state_spec, node_spec, part_spec = self._specs()
-        tel_in = telemetry.state_specs()
+        tel_in = (telemetry.state_specs(),) if tl else ()
+        prov_in = (provenance.broadcast_specs(),) if pv else ()
         axes = tuple(mesh.axis_names)
 
         if wm:
@@ -2154,88 +2307,117 @@ class BroadcastSim:
             @functools.partial(jax.jit, donate_argnums=dn)
             @functools.partial(
                 shard_map, mesh=mesh,
-                in_specs=(state_spec, tel_in, P(), P("nodes"))
-                + extra_specs,
-                out_specs=(state_spec, tel_in), check_vma=False,
+                in_specs=(state_spec,) + tel_in
+                + (P(), P("nodes")) + extra_specs,
+                out_specs=(state_spec,) + tel_in, check_vma=False,
             )
             def run_wm(state: BroadcastState, tel, n, deg, *masks):
                 plan = masks[3] if has_nem else None
                 rs = lambda s: lax.psum(s, axes)   # noqa: E731
+                one = mk_one(
+                    lambda s, p: self._sharded_round_wm(
+                        s, deg, masks or None), plan, rs)
+                return fori_rounds(one, carry_of(state, tel, None),
+                                   n)
 
-                def one(c):
-                    s, tl = c
-                    s2 = self._sharded_round_wm(s, deg,
-                                                masks or None)
-                    return (s2, telemetry.record(
-                        tl, s.t, self._tel_series(s, s2, plan, rs),
-                        tel_mask))
-
-                return fori_rounds(one, (state, tel), n)
-
-            def args_fn(state, tel, n):
+            def args_fn(state, tel, prov, n):
                 return (state, tel, n, self.deg) + extra_args
 
-            runner = lambda state, tel, n: run_wm(
-                *args_fn(state, tel, n))
+            runner = lambda state, tel, prov, n: run_wm(
+                *args_fn(state, tel, prov, n))
             return run_wm, args_fn, runner
 
+        dl_in = (node_spec,) if self.delays is not None else ()
         fp_specs, fp_args = self._fp_mesh_extra()
 
         @functools.partial(jax.jit, donate_argnums=dn)
         @functools.partial(
             shard_map, mesh=mesh,
-            in_specs=(state_spec, tel_in, P(), node_spec, node_spec,
-                      part_spec) + fp_specs,
-            out_specs=(state_spec, tel_in), check_vma=False,
+            in_specs=(state_spec,) + tel_in + prov_in
+            + (P(), node_spec, node_spec, part_spec) + dl_in
+            + fp_specs,
+            out_specs=(state_spec,) + tel_in + prov_in,
+            check_vma=False,
         )
-        def run_g(state: BroadcastState, tel, n, nbrs, nbr_mask,
-                  parts_: Partitions, *fp):
-            plan = fp[0] if fp else None
+        def run_g(*a):
+            a = list(a)
+            state = a.pop(0)
+            tel = a.pop(0) if tl else None
+            prov0 = a.pop(0) if pv else None
+            n, nbrs, nbr_mask, parts_ = a[0], a[1], a[2], a[3]
+            a = a[4:]
+            delays_ = a.pop(0) if self.delays is not None else None
+            plan = a[0] if a else None
             rs = lambda s: lax.psum(s, axes)       # noqa: E731
+            one = mk_one(
+                lambda s, p: self._sharded_round(
+                    s, nbrs, nbr_mask, parts_, delays_, plan,
+                    prov=p), plan, rs)
+            return fori_rounds(one, carry_of(state, tel, prov0), n)
 
-            def one(c):
-                s, tl = c
-                s2 = self._sharded_round(s, nbrs, nbr_mask, parts_,
-                                         None, plan)
-                return (s2, telemetry.record(
-                    tl, s.t, self._tel_series(s, s2, plan, rs),
-                    tel_mask))
+        def args_fn(state, tel, prov, n):
+            return carry_of(state, tel, prov) + (
+                n, self.nbrs, self.nbr_mask, self.parts) \
+                + ((self.delays,) if self.delays is not None else ()) \
+                + fp_args
 
-            return fori_rounds(one, (state, tel), n)
-
-        def args_fn(state, tel, n):
-            return (state, tel, n, self.nbrs, self.nbr_mask,
-                    self.parts) + fp_args
-
-        runner = lambda state, tel, n: run_g(*args_fn(state, tel, n))
+        runner = lambda state, tel, prov, n: run_g(
+            *args_fn(state, tel, prov, n))
         return run_g, args_fn, runner
 
     def telemetry_state(self, tspec) -> "telemetry.TelemetryState":
         return telemetry.init_state(tspec)
 
+    def provenance_state(self, pspec, inject
+                         ) -> "provenance.BroadcastProv":
+        """Fresh (N, V) provenance record for this sim, origin cells
+        stamped from the round-0 ``inject`` bitset (node-sharded on a
+        mesh, like the state)."""
+        prov = provenance.init_broadcast(
+            self.n_nodes, self.n_values, np.asarray(inject, np.uint32))
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P("nodes", None))
+            prov = provenance.BroadcastProv(
+                *(jax.device_put(a, sh) for a in prov))
+        return prov
+
     def run_observed(self, state: BroadcastState, tel, tspec,
-                     n_rounds: int, *, donate: bool = False):
-        """Telemetry-on fused driver: ``n_rounds`` rounds as one
-        device program with the per-round metrics ring recorded next
-        to the state — bit-exact to the telemetry-off drivers (the
-        recorder only reads state).  Returns ``(state, tel)``."""
-        key = (tspec, donate)
+                     n_rounds: int, *, donate: bool = False,
+                     prov=None, prov_spec=None):
+        """Telemetry-/provenance-on fused driver: ``n_rounds`` rounds
+        as one device program with the per-round metrics ring and/or
+        the per-(node, value) provenance stamps recorded next to the
+        state — bit-exact to the plain drivers (the recorders only
+        read state).  Returns the carry in order: ``(state, tel?,
+        prov?)``."""
+        if (tel is None) != (tspec is None):
+            raise ValueError(
+                "pass tel and tel_spec together (build the ring with "
+                "telemetry.init_state(spec))")
+        provenance.prov_key(prov, prov_spec, "broadcast")
+        key = (tspec, prov_spec, donate)
         if key not in self._obs_progs:
-            self._obs_progs[key] = self._build_observed(tspec, donate)
-        return self._obs_progs[key][2](state, tel,
+            self._obs_progs[key] = self._build_observed(
+                tspec, prov_spec, donate)
+        return self._obs_progs[key][2](state, tel, prov,
                                        jnp.int32(n_rounds))
 
-    def audit_observed_program(self, tspec, *, donate: bool = True):
+    def audit_observed_program(self, tspec, *, donate: bool = True,
+                               prov_spec=None):
         """(jitted, example_args) of the observed driver — the handle
         the contract auditor lowers."""
-        key = (tspec, donate)
+        key = (tspec, prov_spec, donate)
         if key not in self._obs_progs:
-            self._obs_progs[key] = self._build_observed(tspec, donate)
+            self._obs_progs[key] = self._build_observed(
+                tspec, prov_spec, donate)
         prog, args_fn, _ = self._obs_progs[key]
-        state = self.init_state(
-            np.zeros((self.n_nodes, self.n_words), np.uint32))
-        return prog, args_fn(state, telemetry.init_state(tspec),
-                             jnp.int32(4))
+        inj = np.zeros((self.n_nodes, self.n_words), np.uint32)
+        state = self.init_state(inj)
+        tel = (telemetry.init_state(tspec) if tspec is not None
+               else None)
+        prov = (self.provenance_state(prov_spec, inj)
+                if prov_spec is not None else None)
+        return prog, args_fn(state, tel, prov, jnp.int32(4))
 
     # -- drivers -----------------------------------------------------------
 
@@ -2251,11 +2433,13 @@ class BroadcastSim:
                 "traffic drivers keep no server ledger (open-loop "
                 "ops have no reference srv accounting): build the "
                 "sim with srv_ledger=False")
-        if (self.delays is not None or self._delayed is not None
-                or self._edge is not None or self._nem_delayed):
-            raise ValueError(
-                "traffic drivers run the 1-hop gather and words-major "
-                "paths; delay-ring modes are not wired")
+        # delay-ring modes (gather per-edge `delays`, words-major
+        # `delayed`/`edge_delayed`/nemesis dir_delays) take traffic
+        # since PR 9: injection lands in received+frontier BEFORE the
+        # round pushes the payload into the history ring, so a
+        # mid-run client value floods with the edge's latency like
+        # any other bit — serving curves cover delayed topologies
+        # (the ROADMAP item-1 leftover).
         need = tspec.n_clients * tspec.ops_per_client
         if need > self.n_values:
             raise ValueError(
@@ -2415,7 +2599,9 @@ class BroadcastSim:
                         lambda s: flood_step(
                             s, nbrs=nbrs, nbr_mask=nbr_mask,
                             parts=self.parts,
-                            sync_every=self.sync_every, plan=plan,
+                            sync_every=self.sync_every,
+                            delays=self.delays,
+                            delay_set=self._delay_set, plan=plan,
                             dup_on=self._fp_dup,
                             union_block=self._ub), plan, coll)
                     return fori_rounds(body, carry_of(state, ts, tel),
@@ -2467,19 +2653,24 @@ class BroadcastSim:
         else:
             fp_specs, fp_args = self._fp_mesh_extra()
 
+            dl_in = ((node_spec,) if self.delays is not None else ())
+
             def run_g(state, *rest):
                 rest = list(rest)
                 tel = rest.pop(0) if tl else None
                 ts, n, tplan, nbrs, nbr_mask, parts = (
                     rest[0], rest[1], rest[2], rest[3], rest[4],
                     rest[5])
-                fp = tuple(rest[6:])
+                rest = rest[6:]
+                delays_ = (rest.pop(0) if self.delays is not None
+                           else None)
+                fp = tuple(rest)
                 coll = collectives(nbrs.shape[0], mesh)
                 plan = fp[0] if fp else None
                 body = mk_body(
                     lambda s: self._sharded_round(
-                        s, nbrs, nbr_mask, parts, None, plan), plan,
-                    coll)
+                        s, nbrs, nbr_mask, parts, delays_, plan),
+                    plan, coll)
                 return fori_rounds(body, carry_of(state, ts, tel), n,
                                    operand=tplan)
 
@@ -2487,14 +2678,16 @@ class BroadcastSim:
                 run_g, mesh=mesh,
                 in_specs=(state_spec,) + tel_in
                 + (t_specs, P(), traffic.plan_specs(), node_spec,
-                   node_spec, part_spec) + fp_specs,
+                   node_spec, part_spec) + dl_in + fp_specs,
                 out_specs=(state_spec, t_specs) + tel_in,
                 check_vma=False, donate_argnums=dn)
 
             def args_fn(state, ts, n, tplan, tel=None):
                 pre = (state, tel) if tl else (state,)
                 return pre + (ts, n, tplan, self.nbrs, self.nbr_mask,
-                              self.parts) + fp_args
+                              self.parts) \
+                    + ((self.delays,)
+                       if self.delays is not None else ()) + fp_args
 
         runner = lambda state, ts, n, tplan, tel=None: prog(
             *args_fn(state, ts, n, tplan, tel))

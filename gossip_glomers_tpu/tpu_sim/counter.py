@@ -42,7 +42,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import faults, telemetry, traffic
+from . import faults, provenance, telemetry, traffic
 from .engine import (Collectives, collectives, donate_argnums_for,
                      fori_rounds, jit_program, resolve_block,
                      scan_blocks)
@@ -487,89 +487,179 @@ class CounterSim:
                 s1.kv.astype(jnp.uint32),
                 s1.msgs)
 
-    def _build_run_obs(self, tspec: "telemetry.TelemetrySpec",
-                       donate: bool):
-        """The telemetry-on fused driver: the round unchanged, a
-        (state, ring) carry, the ring donated WITH the state."""
-        if tspec.workload != "counter" or tspec.traffic:
+    def _prov_record(self, s0: CounterState, s2: CounterState, prov,
+                     coll: Collectives, sched: KVReach, plan):
+        """One round's provenance stamps (PR 9), traced: a PURE reader
+        like :meth:`_tel_series` — the flush gates are recomputed from
+        the same stateless evaluators the round used, so the record
+        can never drift from the round.  Per node, first-occurrence
+        (:func:`provenance.stamp`):
+
+        - ``flush_round``: the node's positive pending first drained
+          to zero through a REACHABLE flush (an amnesia wipe is not a
+          flush: the wiping node is down, so ``reach`` is False);
+        - ``flush_kv``: the KV value that flush landed in (``s2.kv``);
+        - ``visible_round``: every node's cache has caught up to the
+          node's flush value (``min(cached) >= flush_kv`` — one extra
+          pmin, no gather)."""
+        row_ids = coll.row_ids
+        reach = _reach(s0.t, row_ids, sched)
+        pend0 = s0.pending
+        if plan is not None:
+            wipe = faults.amnesia(plan, s0.t, row_ids)
+            pend0 = jnp.where(wipe, 0, pend0)
+            reach = (reach & faults.node_up(plan, s0.t, row_ids)
+                     & ~faults.kv_drop(plan, s0.t, row_ids))
+        flushed = (pend0 > 0) & reach & (s2.pending == 0)
+        newf = flushed & (prov.flush_round < 0)
+        fr = jnp.where(newf, s2.t, prov.flush_round)
+        fk = jnp.where(newf, s2.kv, prov.flush_kv)
+        min_cached = coll.reduce_min(jnp.min(s2.cached))
+        vr = provenance.stamp(
+            prov.visible_round,
+            (fr >= 0) & (min_cached >= fk), s2.t)
+        return provenance.CounterProv(flush_round=fr, flush_kv=fk,
+                                      visible_round=vr)
+
+    def _build_run_obs(self, tspec: "telemetry.TelemetrySpec | None",
+                       pspec, donate: bool):
+        """The telemetry-/provenance-on fused driver (PR 8 / PR 9):
+        the round unchanged, a ``(state, tel?, prov?)`` carry donated
+        together."""
+        tl = tspec is not None
+        pv = pspec is not None
+        if not (tl or pv):
+            raise ValueError(
+                "observed drivers need a TelemetrySpec and/or a "
+                "ProvenanceSpec")
+        if tl and (tspec.workload != "counter" or tspec.traffic):
             raise ValueError(
                 "run_observed needs a TelemetrySpec(workload="
                 "'counter', traffic=False); open-loop runs record "
                 "through run_traffic(tel=...)")
         mesh = self.mesh
-        dn = donate_argnums_for(donate, 0, 1)
+        n_carry = 1 + int(tl) + int(pv)
+        dn = donate_argnums_for(donate, *range(n_carry))
         fp_specs, fp_args = self._fp_extra()
-        tel_mask = tspec.static_mask
+        tel_mask = tspec.static_mask if tl else None
+        ip = 1 + int(tl)
+
+        def carry_of(state, tel, prov):
+            return ((state,) + ((tel,) if tl else ())
+                    + ((prov,) if pv else ()))
 
         def one(carry, sched, coll, plan):
-            s, tel = carry
+            s = carry[0]
             s2 = self._round(s, coll, sched, plan)
-            return (s2, telemetry.record(
-                tel, s.t,
-                self._tel_series(s, s2, coll, sched, plan), tel_mask))
+            out = (s2,)
+            if tl:
+                out += (telemetry.record(
+                    carry[1], s.t,
+                    self._tel_series(s, s2, coll, sched, plan),
+                    tel_mask),)
+            if pv:
+                out += (self._prov_record(s, s2, carry[ip], coll,
+                                          sched, plan),)
+            return out
 
         if mesh is None:
-            def run_n(state, tel, n, *fp):
+            def run_n(*a):
+                a = list(a)
+                state = a.pop(0)
+                tel = a.pop(0) if tl else None
+                prov0 = a.pop(0) if pv else None
+                n = a.pop(0)
+                fp = tuple(a)
                 coll = collectives(self.n_nodes)
                 plan = fp[0] if fp else None
                 return fori_rounds(
                     lambda c: one(c, self.kv_sched, coll, plan),
-                    (state, tel), n)
+                    carry_of(state, tel, prov0), n)
 
             prog = jit_program(run_n, donate_argnums=dn)
 
-            def args_fn(state, tel, n):
-                return (state, tel, n) + fp_args
+            def args_fn(state, tel, prov, n):
+                return carry_of(state, tel, prov) + (n,) + fp_args
         else:
             sched_spec = KVReach(P(), P(), P(None, None))
+            tel_in = ((telemetry.state_specs(),) if tl else ())
+            prov_in = ((provenance.counter_specs(),) if pv else ())
 
-            def run_n(state, tel, sched, n, *fp):
+            def run_n(*a):
+                a = list(a)
+                state = a.pop(0)
+                tel = a.pop(0) if tl else None
+                prov0 = a.pop(0) if pv else None
+                sched, n = a.pop(0), a.pop(0)
+                fp = tuple(a)
                 coll = collectives(state.pending.shape[0], mesh)
                 plan = fp[0] if fp else None
                 return fori_rounds(lambda c: one(c, sched, coll, plan),
-                                   (state, tel), n)
+                                   carry_of(state, tel, prov0), n)
 
             prog = jit_program(
                 run_n, mesh=mesh,
-                in_specs=(self._state_spec(), telemetry.state_specs(),
-                          sched_spec, P()) + fp_specs,
-                out_specs=(self._state_spec(),
-                           telemetry.state_specs()),
+                in_specs=(self._state_spec(),) + tel_in + prov_in
+                + (sched_spec, P()) + fp_specs,
+                out_specs=(self._state_spec(),) + tel_in + prov_in,
                 check_vma=False, donate_argnums=dn)
 
-            def args_fn(state, tel, n):
-                return (state, tel, self.kv_sched, n) + fp_args
+            def args_fn(state, tel, prov, n):
+                return carry_of(state, tel, prov) \
+                    + (self.kv_sched, n) + fp_args
 
-        runner = lambda state, tel, n: prog(*args_fn(state, tel, n))
+        runner = lambda state, tel, prov, n: prog(
+            *args_fn(state, tel, prov, n))
         return prog, args_fn, runner
 
     def telemetry_state(self, tspec) -> "telemetry.TelemetryState":
         return telemetry.init_state(tspec)
 
+    def provenance_state(self, pspec) -> "provenance.CounterProv":
+        prov = provenance.init_counter(self.n_nodes)
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, self._node_spec)
+            prov = provenance.CounterProv(
+                *(jax.device_put(a, sh) for a in prov))
+        return prov
+
     def run_observed(self, state: CounterState, tel, tspec,
-                     n_rounds: int, *, donate: bool = False):
-        """Telemetry-on :meth:`run_fused`: ``n_rounds`` rounds as one
-        device program with the per-round metrics ring recorded next
-        to the state (tpu_sim/telemetry.py) — bit-exact to the
-        telemetry-off drivers (the recorder only reads state).  With
-        ``donate`` both the state and the ring are consumed.  Returns
-        ``(state, tel)``."""
-        key = (tspec, donate)
+                     n_rounds: int, *, donate: bool = False,
+                     prov=None, prov_spec=None):
+        """Telemetry-/provenance-on :meth:`run_fused`: ``n_rounds``
+        rounds as one device program with the per-round metrics ring
+        and/or the per-node flush→kv→visibility stamps recorded next
+        to the state — bit-exact to the plain drivers (the recorders
+        only read state).  With ``donate`` every carry leaf is
+        consumed.  Returns the carry in order: ``(state, tel?,
+        prov?)``."""
+        if (tel is None) != (tspec is None):
+            raise ValueError(
+                "pass tel and tel_spec together (build the ring with "
+                "telemetry.init_state(spec))")
+        provenance.prov_key(prov, prov_spec, "counter")
+        key = (tspec, prov_spec, donate)
         if key not in self._obs_progs:
-            self._obs_progs[key] = self._build_run_obs(tspec, donate)
-        return self._obs_progs[key][2](state, tel,
+            self._obs_progs[key] = self._build_run_obs(
+                tspec, prov_spec, donate)
+        return self._obs_progs[key][2](state, tel, prov,
                                        jnp.int32(n_rounds))
 
-    def audit_observed_program(self, tspec, *, donate: bool = True):
+    def audit_observed_program(self, tspec, *, donate: bool = True,
+                               prov_spec=None):
         """(jitted, example_args) of the observed driver — the handle
         the contract auditor lowers (census + donation of the EXACT
         program :meth:`run_observed` executes)."""
-        key = (tspec, donate)
+        key = (tspec, prov_spec, donate)
         if key not in self._obs_progs:
-            self._obs_progs[key] = self._build_run_obs(tspec, donate)
+            self._obs_progs[key] = self._build_run_obs(
+                tspec, prov_spec, donate)
         prog, args_fn, _ = self._obs_progs[key]
-        return prog, args_fn(self.init_state(),
-                             telemetry.init_state(tspec),
+        tel = (telemetry.init_state(tspec) if tspec is not None
+               else None)
+        prov = (self.provenance_state(prov_spec)
+                if prov_spec is not None else None)
+        return prog, args_fn(self.init_state(), tel, prov,
                              jnp.int32(8))
 
     # -- open-loop traffic (PR 7) -----------------------------------------

@@ -449,6 +449,36 @@ def resolve_block(rows: int, setting=None, *, per_row_bytes: int = 1,
     return max(d for d in _divisors(rows) if d <= b)
 
 
+def unpack_bits(words: jnp.ndarray, n_bits: int | None = None
+                ) -> jnp.ndarray:
+    """Unpack a packed-uint32 bitset's last axis: ``(..., W)`` uint32
+    -> ``(..., W*32)`` bool, bit ``b`` of word ``w`` landing at column
+    ``w*32 + b`` — the layout every packed state in the repo uses
+    (broadcast received words, kafka presence words).  ``n_bits``
+    slices the tail padding off (``W*32 >= n_bits``).  Pure
+    elementwise shifts — no gather, shard-local under shard_map; the
+    provenance recorders (PR 9) expand their masked per-(row, value)
+    stamps through this."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = ((words[..., None] >> shifts) & jnp.uint32(1)).astype(bool)
+    out = bits.reshape(*words.shape[:-1], words.shape[-1] * 32)
+    return out if n_bits is None else out[..., :n_bits]
+
+
+def host_unpack_bits(words, n_bits: int | None = None):
+    """Numpy host twin of :func:`unpack_bits` — same bit layout
+    (bit ``b`` of word ``w`` at column ``w*32 + b``), for host-side
+    consumers (checkers, provenance init) that must not round-trip
+    through the device."""
+    import numpy as np
+
+    w = np.asarray(words, np.uint32)
+    shifts = np.arange(32, dtype=np.uint32)
+    bits = ((w[..., None] >> shifts) & np.uint32(1)).astype(bool)
+    out = bits.reshape(*w.shape[:-1], w.shape[-1] * 32)
+    return out if n_bits is None else out[..., :n_bits]
+
+
 def scan_rounds(round_fn: Callable, state, xs):
     """R pre-staged rounds as one ``lax.scan``: ``round_fn(state, x) ->
     state`` over the leading axis of the ``xs`` pytree."""

@@ -88,12 +88,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import faults, telemetry, traffic
+from . import faults, provenance, telemetry, traffic
 from .counter import KVReach, _reach
 from .engine import (analytic_peak_bytes, collectives,
                      donate_argnums_for, fori_rounds, jit_program,
                      operand_bytes, resolve_block, scan_blocks,
-                     scan_rounds)
+                     scan_rounds, unpack_bits)
 
 
 class KafkaState(NamedTuple):
@@ -946,7 +946,7 @@ class KafkaSim:
     # -- flight-recorder telemetry (PR 8) ----------------------------------
 
     def _tel_series(self, s0: KafkaState, s1: KafkaState, coll,
-                    plan) -> tuple:
+                    plan, full_scan: bool = False) -> tuple:
         """One round's telemetry row (telemetry.SIM_SERIES['kafka']
         order), traced: per-shard LOCAL partials globalized in ONE
         packed ``reduce_sum`` — liveness counted over the local rows,
@@ -954,11 +954,13 @@ class KafkaSim:
         WITNESS node (global row 0): it climbs to ``alloc_total``
         exactly when every allocated send has replicated to node 0,
         so the two series together plot replication lag per round.
-        (A full-presence popcount would re-stream the whole O(N·K·C)
-        bitset every round — measured ~18% of the 1,024/10k sweep
-        round; the witness gauge is O(K·C) on one shard.)  The
-        allocated-slot total reads the replicated log content — no
-        collective at all."""
+        ``present_bits_full`` is the full-cluster presence popcount —
+        it re-streams the whole O(N·K·C) bitset every round (measured
+        ~18% of the 1,024/10k sweep round in PR 8), so it is OPT-IN
+        (telemetry.OPT_IN_SERIES): unselected it is a dead column and
+        XLA prunes the scan; the witness gauge is O(K·C) on one shard
+        and stays the default.  The allocated-slot total reads the
+        replicated log content — no collective at all."""
         row_ids = coll.row_ids
         live_loc = (jnp.ones(row_ids.shape, bool) if plan is None
                     else faults.node_up(plan, s0.t, row_ids))
@@ -967,56 +969,154 @@ class KafkaSim:
             jnp.sum(lax.population_count(s1.present[0])
                     .astype(jnp.uint32), dtype=jnp.uint32),
             jnp.uint32(0))
-        g = coll.reduce_sum(jnp.stack(
-            [jnp.sum(live_loc.astype(jnp.uint32), dtype=jnp.uint32),
-             wit]))
+        # the full scan is a STATIC opt-in: when the column is
+        # unselected it must not even enter the packed psum (a stacked
+        # operand's elements are not individually dead-codeable)
+        parts = [jnp.sum(live_loc.astype(jnp.uint32),
+                         dtype=jnp.uint32), wit]
+        if full_scan:
+            parts.append(jnp.sum(lax.population_count(s1.present)
+                                 .astype(jnp.uint32),
+                                 dtype=jnp.uint32))
+        g = coll.reduce_sum(jnp.stack(parts))
         alloc = jnp.sum((s1.log_vals >= 0).astype(jnp.uint32),
                         dtype=jnp.uint32)       # replicated — no psum
-        return (g[0], alloc, g[1], s1.msgs)
+        full = g[2] if full_scan else jnp.uint32(0)
+        return (g[0], alloc, g[1], full, s1.msgs)
 
-    def _build_obs_prog(self, tspec: "telemetry.TelemetrySpec",
-                        has_commits: bool, donate: bool):
-        """Telemetry-on :meth:`_run_prog`: same scan body, a
-        (state, ring) carry donated together."""
-        if tspec.workload != "kafka" or tspec.traffic:
+    def _prov_record(self, s0: KafkaState, s2: KafkaState, prov,
+                     sk, coll, sched: KVReach, plan, witness: int):
+        """One round's provenance stamps (PR 9), traced: a PURE
+        reader.  The allocation side mirrors the round's own
+        :func:`_alloc` evaluation (the PR-7 tracker trick — same pure
+        function of (kv_val, batch, gates), so the recorded (key,
+        slot) → (round, origin) map can never drift from the round);
+        the witness side reads the bits that became newly present at
+        the witness node this round.  Per-shard partials are DISJOINT
+        (offsets are globally unique; the witness lives on one
+        shard), so the ``reduce_sum`` psums produce identical
+        replicated (K, C) stamps — no gather anywhere."""
+        row_ids = coll.row_ids
+        rows, s_dim = sk.shape
+        k_dim, cap = self.n_keys, self.capacity
+        reach = _reach(s0.t, row_ids, sched)
+        up_rows = None
+        if plan is not None:
+            up_rows = faults.node_up(plan, s0.t, row_ids)
+            reach = reach & up_rows & ~faults.kv_drop(plan, s0.t,
+                                                     row_ids)
+        _t, _v, keys_c, _r, slot, ok = _alloc(
+            s0.kv_val, sk, reach, up_rows, coll.exclusive_sum, k_dim,
+            cap)
+        scat_k = jnp.where(ok, keys_c, jnp.int32(k_dim))
+        scat_c = jnp.where(ok, slot, 0)
+        origin_flat = jnp.repeat(row_ids, s_dim)
+        t1 = s2.t                        # stamps are t+1 throughout
+        # BOTH stamp scatters packed into ONE (2, K, C) psum operand
+        # (disjoint per-shard partials — offsets are globally unique)
+        parts = jnp.zeros((2, k_dim, cap), jnp.int32)
+        parts = parts.at[0, scat_k, scat_c].add(
+            jnp.where(ok, t1, 0), mode="drop")
+        parts = parts.at[1, scat_k, scat_c].add(
+            jnp.where(ok, origin_flat + 1, 0), mode="drop")
+        g = coll.reduce_sum(parts)
+        ar, og = g[0], g[1]
+        new_alloc = (ar > 0) & (prov.alloc_round < 0)
+        alloc_round = jnp.where(new_alloc, ar, prov.alloc_round)
+        origin = jnp.where(new_alloc, og - 1, prov.origin)
+        # witness first presence: the bits present at the witness row
+        # AFTER the round — :func:`provenance.stamp` only writes
+        # unstamped cells, so the first round a bit shows up is the
+        # one recorded (re-presence after amnesia never re-stamps).
+        # Deliberately reads ONLY s2: touching s0.present here would
+        # keep the full pre-round O(N·K·C) bitset alive past the
+        # round (the donated update could no longer happen in place —
+        # measured ~15%/round at the 1,024/10k sweep point); the one
+        # witness row is sliced, never the whole bitset
+        loc = jnp.int32(witness) - row_ids[0]
+        inb = (loc >= 0) & (loc < rows)
+        lc = jnp.clip(loc, 0, rows - 1)
+        wrow = lax.dynamic_index_in_dim(s2.present, lc, axis=0,
+                                        keepdims=False)
+        wit = coll.reduce_sum(jnp.where(inb, wrow, jnp.uint32(0)))
+        first = provenance.stamp(
+            prov.first_present, unpack_bits(wit, cap), t1)
+        return provenance.KafkaProv(alloc_round=alloc_round,
+                                    origin=origin,
+                                    first_present=first)
+
+    def _build_obs_prog(self, tspec: "telemetry.TelemetrySpec | None",
+                        has_commits: bool, donate: bool, pspec=None):
+        """Telemetry-/provenance-on :meth:`_run_prog`: same scan body,
+        a ``(state, tel?, prov?)`` carry donated together."""
+        tl = tspec is not None
+        pv = pspec is not None
+        if not (tl or pv):
+            raise ValueError(
+                "observed drivers need a TelemetrySpec and/or a "
+                "ProvenanceSpec")
+        if tl and (tspec.workload != "kafka" or tspec.traffic):
             raise ValueError(
                 "run_observed needs a TelemetrySpec(workload='kafka', "
                 "traffic=False); open-loop runs record through "
                 "run_traffic(tel=...)")
+        if pv and pspec.witness >= self.n_nodes:
+            raise ValueError(
+                f"provenance witness {pspec.witness} out of range "
+                f"for {self.n_nodes} nodes")
         repl_mode = self._repl_mode(None)
         if repl_mode == "matmul":
             raise ValueError(
                 "observed drivers ride the origin-union replication "
                 "paths; repl_fast=False pins the matmul oracle")
-        key = (tspec, has_commits, donate)
+        key = (tspec, pspec, has_commits, donate)
         if key in self._obs_progs:
             return self._obs_progs[key]
         k_dim = self.n_keys
         mesh = self.mesh
-        dn = donate_argnums_for(donate, 0, 1)
+        n_carry = 1 + int(tl) + int(pv)
+        dn = donate_argnums_for(donate, *range(n_carry))
         fp = self._fp_active
-        tel_mask = tspec.static_mask
+        tel_mask = tspec.static_mask if tl else None
+        full_scan = tl and "present_bits_full" in tspec.series
+        witness = pspec.witness if pv else 0
+        ip = 1 + int(tl)
 
-        def run(state, tel, sks, svs, *rest):
-            rest = list(rest)
+        def run(*a):
+            a = list(a)
+            state = a.pop(0)
+            tel = a.pop(0) if tl else None
+            prov0 = a.pop(0) if pv else None
+            sks, svs = a.pop(0), a.pop(0)
+            rest = a
             plan = rest.pop() if fp else None
             sched = rest.pop()
             coll = collectives(sks.shape[1], mesh)
 
             def body(c, xs):
-                s, tl = c
+                s = c[0]
                 sk, sv = xs[0], xs[1]
                 cr = (xs[2] if has_commits else jnp.full(
                     (sk.shape[0], k_dim), -1, jnp.int32))
                 s2 = self._round(s, sk, sv, cr, None, sched, coll,
                                  repl_mode=repl_mode, plan=plan)
-                return (s2, telemetry.record(
-                    tl, s.t, self._tel_series(s, s2, coll, plan),
-                    tel_mask))
+                out = (s2,)
+                if tl:
+                    out += (telemetry.record(
+                        c[1], s.t,
+                        self._tel_series(s, s2, coll, plan,
+                                         full_scan=full_scan),
+                        tel_mask),)
+                if pv:
+                    out += (self._prov_record(s, s2, c[ip], sk, coll,
+                                              sched, plan, witness),)
+                return out
 
             xs = ((sks, svs) + ((rest[0],) if has_commits else ()))
+            carry = ((state,) + ((tel,) if tl else ())
+                     + ((prov0,) if pv else ()))
             out, _ = lax.scan(lambda c, x: (body(c, x), None),
-                              (state, tel), xs)
+                              carry, xs)
             return out
 
         if mesh is None:
@@ -1024,14 +1124,16 @@ class KafkaSim:
         else:
             node3 = P(None, "nodes", None)
             state_spec = self._state_spec()
-            in_specs = ((state_spec, telemetry.state_specs(), node3,
-                         node3)
+            tel_in = ((telemetry.state_specs(),) if tl else ())
+            prov_in = ((provenance.kafka_specs(),) if pv else ())
+            in_specs = ((state_spec,) + tel_in + prov_in
+                        + (node3, node3)
                         + ((node3,) if has_commits else ())
                         + (KVReach(P(), P(), P(None, None)),)
                         + ((faults.plan_specs(),) if fp else ()))
             prog = jit_program(
                 run, mesh=mesh, in_specs=in_specs,
-                out_specs=(state_spec, telemetry.state_specs()),
+                out_specs=(state_spec,) + tel_in + prov_in,
                 check_vma=False, donate_argnums=dn)
         self._obs_progs[key] = prog
         return prog
@@ -1039,14 +1141,24 @@ class KafkaSim:
     def telemetry_state(self, tspec) -> "telemetry.TelemetryState":
         return telemetry.init_state(tspec)
 
+    def provenance_state(self, pspec) -> "provenance.KafkaProv":
+        # replicated like log_vals/kv_val — no sharding to apply
+        return provenance.init_kafka(self.n_keys, self.capacity)
+
     def run_observed(self, state: KafkaState, tel, tspec,
                      send_key: np.ndarray, send_val: np.ndarray,
                      commit_req: np.ndarray | None = None, *,
-                     donate: bool = False):
-        """Telemetry-on :meth:`run_rounds`: the R staged rounds as one
-        scan with the per-round metrics ring recorded next to the
-        state — bit-exact to the telemetry-off driver (the recorder
-        only reads state).  Returns ``(state, tel)``."""
+                     donate: bool = False, prov=None, prov_spec=None):
+        """Telemetry-/provenance-on :meth:`run_rounds`: the R staged
+        rounds as one scan with the per-round metrics ring and/or the
+        per-(key, slot) provenance stamps recorded next to the state —
+        bit-exact to the plain driver (the recorders only read state).
+        Returns the carry in order: ``(state, tel?, prov?)``."""
+        if (tel is None) != (tspec is None):
+            raise ValueError(
+                "pass tel and tel_spec together (build the ring with "
+                "telemetry.init_state(spec))")
+        provenance.prov_key(prov, prov_spec, "kafka")
         has_commits = commit_req is not None
         args = [jnp.asarray(send_key, jnp.int32),
                 jnp.asarray(send_val, jnp.int32)]
@@ -1058,18 +1170,21 @@ class KafkaSim:
         args.append(self.kv_sched)
         if self._fp_active:
             args.append(self.fault_plan)
-        prog = self._build_obs_prog(tspec, has_commits, donate)
-        return prog(state, tel, *args)
+        prog = self._build_obs_prog(tspec, has_commits, donate,
+                                    prov_spec)
+        pre = ((state,) + ((tel,) if tspec is not None else ())
+               + ((prov,) if prov_spec is not None else ()))
+        return prog(*pre, *args)
 
     def audit_observed_program(self, tspec, *, donate: bool = True,
-                               rounds: int = 8):
+                               rounds: int = 8, prov_spec=None):
         """(jitted, example_args) of the observed driver — the handle
         the contract auditor lowers."""
         n, s = self.n_nodes, self.max_sends
         sks = np.full((rounds, n, s), -1, np.int32)
         sks[:, 0, 0] = 0
         svs = np.zeros((rounds, n, s), np.int32)
-        prog = self._build_obs_prog(tspec, False, donate)
+        prog = self._build_obs_prog(tspec, False, donate, prov_spec)
         args = [jnp.asarray(sks), jnp.asarray(svs)]
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(None, "nodes", None))
@@ -1077,8 +1192,12 @@ class KafkaSim:
         args.append(self.kv_sched)
         if self._fp_active:
             args.append(self.fault_plan)
-        return prog, (self.init_state(), telemetry.init_state(tspec),
-                      *args)
+        pre = ((self.init_state(),)
+               + ((telemetry.init_state(tspec),)
+                  if tspec is not None else ())
+               + ((self.provenance_state(prov_spec),)
+                  if prov_spec is not None else ()))
+        return prog, (*pre, *args)
 
     def step(self, state: KafkaState,
              send_key: np.ndarray | None = None,
@@ -1112,7 +1231,8 @@ class KafkaSim:
 
     def _traffic_round(self, state: KafkaState, ts, tspec, tplan,
                        sched: KVReach, coll, plan, repl_mode: str,
-                       ub: int, tel=None, tel_mask=None):
+                       ub: int, tel=None, tel_mask=None,
+                       tel_full: bool = False):
         """One traffic-injected round (traced): stage this round's
         arrivals as a shard-local send batch (op (client, k) sends a
         seeded key with its op id as the value — globally unique, like
@@ -1209,7 +1329,8 @@ class KafkaSim:
         ts = traffic.done_scan(ts, bit_fn, s2.t, coll.reduce_sum, ub)
         if tel is None:
             return s2, ts
-        vals = (self._tel_series(state, s2, coll, plan)
+        vals = (self._tel_series(state, s2, coll, plan,
+                                 full_scan=tel_full)
                 + traffic.tel_series(ts, coll.reduce_sum))
         return s2, ts, telemetry.record(tel, state.t, vals, tel_mask)
 
@@ -1234,6 +1355,7 @@ class KafkaSim:
         ub = traffic.traffic_block(tspec.n_clients // n_sh)
         tl = tel_spec is not None
         mask = tel_spec.static_mask if tl else None
+        tel_full = tl and "present_bits_full" in tel_spec.series
         dn = donate_argnums_for(donate, *((0, 1, 2) if tl else (0, 1)))
         fp = self._fp_active
 
@@ -1250,7 +1372,8 @@ class KafkaSim:
                 if tl:
                     return self._traffic_round(
                         c[0], c[1], tspec, op, sched, coll, plan,
-                        repl_mode, ub, tel=c[2], tel_mask=mask)
+                        repl_mode, ub, tel=c[2], tel_mask=mask,
+                        tel_full=tel_full)
                 return self._traffic_round(
                     c[0], c[1], tspec, op, sched, coll, plan,
                     repl_mode, ub)

@@ -1,0 +1,377 @@
+"""Causal provenance tracing for the vectorized backend (PR 9):
+device-resident dissemination trees riding the donated carry, the same
+way the telemetry ring (telemetry.py) does.
+
+PR 8's telemetry answers *how much* happened per round (aggregate
+counters + conservation identities); this module answers *why*: which
+edge first delivered a value, which hop chain is a run's critical
+path, which origin a kafka slot replicated from.  Per-message
+causality is exactly the observability a TPU-native design can afford
+that a process-per-node harness cannot — the recorder is a handful of
+masked elementwise writes next to state the round already computed.
+
+- **`ProvenanceSpec`** (the `TelemetrySpec` shape): a tiny JSON-able
+  host spec naming the workload (and kafka's witness node).  STATIC —
+  it keys the compiled provenance-on programs; the carry is state.
+- **per-workload `*Prov` state**, node-sharded where the data is:
+
+  * broadcast (:class:`BroadcastProv`): per-(node, value) **arrival
+    round** (-1 unseen; 0 = injected at the origin; t+1 = first
+    present in the state after round t) and **parent node id** (-1 =
+    origin) — written MASKED exactly where the round's ``new`` bits
+    land, the parent chosen shard-locally as the first delivering
+    direction (the per-direction terms the gather round already sums;
+    the recorder re-reads them in scope, so provenance adds ZERO
+    all-gathers and ZERO host callbacks).  Amnesia never wipes the
+    record: stamps are first-incarnation (``arrival < 0`` gates every
+    write), which keeps causality intact across crash/restart — a
+    parent's first arrival always precedes any round it delivered in.
+  * counter (:class:`CounterProv`): per-node flush → kv → visibility
+    stamps — the round a node's acked deltas first drained into the
+    KV, the KV value they landed in, and the round every cache had
+    caught up to that value.
+  * kafka (:class:`KafkaProv`): per-(key, slot) allocation round +
+    origin node (from the same pure ``_alloc`` evaluation the round
+    performs — the PR-7 mirror trick) and the slot's first-presence
+    round at the WITNESS node (default global row 0, matching the
+    ``present_bits`` telemetry gauge).
+
+- **host-verifiable against the fault model itself**
+  (harness/checkers.py ``check_provenance``): the loss/liveness coins
+  are stateless ``(t, src, dst)`` hashes with exact numpy twins
+  (faults.host_node_up / host_edge_drop), so the host re-evaluates
+  whether each claimed parent edge was actually LIVE and UN-DROPPED at
+  the claimed round — plus causality (``arrival[parent] <
+  arrival[child]``), reachability (every held value has a recorded
+  arrival), and tree/msgs-ledger consistency — all ANDed into the
+  observed verdicts.  A forged parent on a dead or dropped edge fails
+  loudly (tests/test_provenance.py).
+
+The host side (harness/observe.py) rebuilds per-value spanning trees,
+critical-path hop latency, and per-edge utilization
+(``dissemination_tree`` / ``provenance_summary``), adds Perfetto FLOW
+events (causal arrows) to the timelines, and folds the record into the
+flight-recorder bundle so ``replay_bundle`` reports the
+first-divergence round (the item-2 fuzzer's shrinker signal).
+
+Paths: broadcast provenance rides the GATHER path (1-hop and per-edge
+``delays`` ring modes, single-device and mesh) — the structured
+words-major exchanges are opaque sums of direction terms, so
+per-direction attribution there would re-run the exchange D times;
+counter and kafka ride their ordinary fused drivers (kafka: the
+origin-union replication paths).
+
+Env knob: ``GG_PROVENANCE`` (0/1, default off, the loud ``_env_int``
+contract — the scenario runners consult it like ``GG_TELEMETRY``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .engine import _env_int
+
+# The module's host/device split, DECLARED (the PR-6 faults.py
+# pattern): the determinism lint (tpu_sim/audit.py) treats exactly
+# TRACED_EVALUATORS as traced scope; tests/test_provenance.py pins the
+# split TOTAL.
+TRACED_EVALUATORS = ("stamp",)
+HOST_SIDE = (
+    "init_broadcast", "init_counter", "init_kafka",
+    "broadcast_specs", "counter_specs", "kafka_specs",
+    "enabled", "default_spec", "prov_key", "arrays_of", "from_arrays",
+    "audit_contracts")
+
+WORKLOADS = ("broadcast", "counter", "kafka")
+
+
+@dataclass(frozen=True)
+class ProvenanceSpec:
+    """Host-side provenance spec — JSON-able (:meth:`to_meta`), STATIC
+    (it keys the compiled provenance-on programs).  ``witness``: the
+    kafka first-presence observer node (global id; the telemetry
+    ``present_bits`` witness by default)."""
+
+    workload: str
+    witness: int = 0
+
+    def __post_init__(self) -> None:
+        if self.workload not in WORKLOADS:
+            raise ValueError(
+                f"unknown provenance workload {self.workload!r}; one "
+                f"of {list(WORKLOADS)}")
+        if self.witness < 0:
+            raise ValueError("witness must be a node id >= 0")
+
+    def to_meta(self) -> dict:
+        return {"workload": self.workload, "witness": self.witness}
+
+    @staticmethod
+    def from_meta(meta: dict) -> "ProvenanceSpec":
+        return ProvenanceSpec(workload=str(meta["workload"]),
+                              witness=int(meta.get("witness", 0)))
+
+
+class BroadcastProv(NamedTuple):
+    """Node-sharded (N, V) int32 stamps (module docstring)."""
+
+    arrival: jnp.ndarray   # -1 unseen / 0 origin / t+1 first present
+    parent: jnp.ndarray    # -1 origin / global node id that delivered
+
+
+class CounterProv(NamedTuple):
+    """Node-sharded (N,) int32 stamps."""
+
+    flush_round: jnp.ndarray    # -1 / t+1 first full pending drain
+    flush_kv: jnp.ndarray       # -1 / the KV value the flush landed in
+    visible_round: jnp.ndarray  # -1 / t+1 every cache >= flush_kv
+
+
+class KafkaProv(NamedTuple):
+    """Replicated (K, C) int32 stamps (disjoint per-shard partials
+    psum into identical replicas, like ``log_vals``)."""
+
+    alloc_round: jnp.ndarray    # -1 / t+1 the slot was allocated
+    origin: jnp.ndarray         # -1 / global node id of the sender
+    first_present: jnp.ndarray  # -1 / t+1 first present at witness
+
+
+def init_broadcast(n_nodes: int, n_values: int,
+                   inject: np.ndarray | None = None) -> BroadcastProv:
+    """Fresh broadcast record; ``inject`` ((N, W) uint32, the round-0
+    injection bitset) stamps the origin cells arrival=0, parent=-1."""
+    from .engine import host_unpack_bits
+
+    arrival = np.full((n_nodes, n_values), -1, np.int32)
+    if inject is not None:
+        arrival[host_unpack_bits(inject, n_values)] = 0
+    # jnp.array (copy), NOT jnp.asarray: the record is donated, and a
+    # zero-copy numpy-backed view must never be the donated buffer
+    # (see init_kafka)
+    return BroadcastProv(
+        arrival=jnp.array(arrival),
+        parent=jnp.full((n_nodes, n_values), -1, jnp.int32))
+
+
+def init_counter(n_nodes: int) -> CounterProv:
+    # three DISTINCT buffers: the observed drivers donate the whole
+    # pytree and XLA rejects donating one buffer twice
+    return CounterProv(*(jnp.full((n_nodes,), -1, jnp.int32)
+                         for _ in range(3)))
+
+
+def init_kafka(n_keys: int, capacity: int) -> KafkaProv:
+    # device-native buffers (jnp.full, not jnp.asarray over a host
+    # array): the record is DONATED into the observed drivers, and on
+    # CPU a numpy-backed jax array can be a zero-copy view — donating
+    # the view while the output aliases it corrupts the stamps as
+    # soon as any later dispatch reuses the freed pages
+    return KafkaProv(*(jnp.full((n_keys, capacity), -1, jnp.int32)
+                       for _ in range(3)))
+
+
+def broadcast_specs() -> BroadcastProv:
+    """shard_map in/out_specs: node-sharded with the gather state."""
+    return BroadcastProv(P("nodes", None), P("nodes", None))
+
+
+def counter_specs() -> CounterProv:
+    return CounterProv(P("nodes"), P("nodes"), P("nodes"))
+
+
+def kafka_specs() -> KafkaProv:
+    return KafkaProv(P(None, None), P(None, None), P(None, None))
+
+
+def stamp(cur: jnp.ndarray, mask: jnp.ndarray, val) -> jnp.ndarray:
+    """Masked FIRST-occurrence write (traced): ``cur`` where already
+    stamped (>= 0), ``val`` where ``mask`` and unstamped — the one
+    write shape every provenance recorder uses, which is what makes
+    the record first-incarnation under amnesia."""
+    return jnp.where(mask & (cur < 0),
+                     jnp.asarray(val, cur.dtype), cur)
+
+
+# -- env knob -------------------------------------------------------------
+
+
+def enabled(default: bool = False) -> bool:
+    """The ``GG_PROVENANCE`` master switch (default OFF).  Loud
+    contract: any value other than 0/1 raises a ValueError naming the
+    variable."""
+    raw = os.environ.get("GG_PROVENANCE")
+    if raw is None:
+        return default
+    v = _env_int("GG_PROVENANCE", raw)
+    if v not in (0, 1):
+        raise ValueError(
+            f"GG_PROVENANCE={v} must be 0 or 1 (provenance off/on)")
+    return bool(v)
+
+
+def default_spec(workload: str) -> ProvenanceSpec:
+    return ProvenanceSpec(workload=workload)
+
+
+def prov_key(prov, prov_spec, workload: str):
+    """Validate a driver's ``(prov, prov_spec)`` pair (both or
+    neither; the spec must name this workload) and return the
+    program-cache key component."""
+    if (prov is None) != (prov_spec is None):
+        raise ValueError(
+            "pass prov and prov_spec together (build the record with "
+            "the sim's provenance_state(spec, ...))")
+    if prov_spec is not None and prov_spec.workload != workload:
+        raise ValueError(
+            f"run_observed provenance needs ProvenanceSpec(workload="
+            f"{workload!r}), got {prov_spec.to_meta()}")
+    return prov_spec
+
+
+# -- host-side readout ----------------------------------------------------
+
+
+_FIELDS = {"broadcast": ("arrival", "parent"),
+           "counter": ("flush_round", "flush_kv", "visible_round"),
+           "kafka": ("alloc_round", "origin", "first_present")}
+
+
+def arrays_of(prov) -> dict:
+    """{field: numpy int32 array} — the JSON-able-after-``tolist``
+    payload the checkers, summaries, and flight bundles consume.
+    Always a COPY (np.array), never a zero-copy view of the device
+    buffer: the record rides donated carries, and a view would read
+    freed pages once a later dispatch reuses them."""
+    return {name: np.array(arr)
+            for name, arr in zip(type(prov)._fields, prov)}
+
+
+def from_arrays(workload: str, arrays: dict):
+    """Rebuild the device record from a bundle's JSON arrays."""
+    cls = {"broadcast": BroadcastProv, "counter": CounterProv,
+           "kafka": KafkaProv}[workload]
+    return cls(*(jnp.array(np.asarray(arrays[f], np.int32))
+                 for f in _FIELDS[workload]))
+
+
+# -- program contracts (tpu_sim/audit.py registry) -----------------------
+
+
+def audit_contracts():
+    """Provenance-on driver rows: the recorders must add no gathers
+    (counter/kafka stay all-gather-FREE — cap-0 census; the broadcast
+    gather path keeps EXACTLY its plain 2-widen census, i.e. the
+    per-direction attribution re-reads the widened payloads already in
+    scope), keep the donation alias table covering BOTH the sim state
+    and the provenance carry, and sit inside the analytic memory
+    band."""
+    from ..parallel.topology import to_padded_neighbors, tree
+    from . import faults
+    from .audit import AuditProgram, ProgramContract
+    from .broadcast import BroadcastSim
+    from .counter import CounterSim
+    from .engine import analytic_peak_bytes
+    from .engine import operand_bytes as engine_operand_bytes
+    from .kafka import KafkaSim
+
+    def _spec(n):
+        return faults.NemesisSpec(
+            n_nodes=n, seed=5, crash=((2, 4, (1, n // 2)),),
+            loss_rate=0.1, loss_until=6, dup_rate=0.1, dup_until=6)
+
+    def counter_prov(mesh):
+        n = 1024
+        pspec = ProvenanceSpec("counter")
+        sim = CounterSim(n, mode="cas", poll_every=2, mesh=mesh,
+                         fault_plan=_spec(n).compile())
+        prog, args = sim.audit_observed_program(None, prov_spec=pspec)
+        n_sh = 1 if mesh is None else 8
+        state_bytes = (2 * n * 4 + 3 * n * 4) // n_sh
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes,
+            operand_bytes=engine_operand_bytes(sim.fault_plan))
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    def broadcast_prov(mesh):
+        n, nv = 256, 256
+        pspec = ProvenanceSpec("broadcast")
+        sim = BroadcastSim(
+            to_padded_neighbors(tree(n, branching=4)), n_values=nv,
+            sync_every=4, srv_ledger=False, mesh=mesh,
+            fault_plan=_spec(n).compile())
+        prog, args = sim.audit_observed_program(None, prov_spec=pspec)
+        n_sh = 1 if mesh is None else 8
+        w = nv // 32
+        state_bytes = (2 * n * w * 4 + 2 * n * nv * 4) // n_sh
+        # slab: the two payload widens + the per-direction unpack
+        # temps ((rows, V) bools and int32 selects)
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes,
+            operand_bytes=engine_operand_bytes(sim.fault_plan),
+            slab_bytes=2 * n * w * 4 + 6 * (n // n_sh) * nv)
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    def kafka_prov(mesh):
+        n, k, cap = 64, 8, 64
+        pspec = ProvenanceSpec("kafka")
+        sim = KafkaSim(n, k, capacity=cap, max_sends=2,
+                       fault_plan=_spec(n).compile(),
+                       resync_every=4, union_block=4, mesh=mesh)
+        prog, args = sim.audit_observed_program(None, prov_spec=pspec)
+        n_sh = 1 if mesh is None else 8
+        wc = (cap + 31) // 32
+        state_bytes = (n * k * wc * 4 + n * k * 4) // n_sh \
+            + k * cap * 4 + k * 4 + 3 * k * cap * 4
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes,
+            operand_bytes=engine_operand_bytes(sim.fault_plan),
+            slab_bytes=(n // n_sh) * n * 2 * 4
+            + (n // n_sh) * k * wc * 4 + 3 * k * cap * 4)
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    return [
+        ProgramContract(
+            name="counter/provenance-run",
+            build=counter_prov,
+            collectives={"all-reduce": None},
+            donation=True,
+            mem_lo=0.05, mem_hi=8.0,
+            notes="provenance-on donated counter driver under "
+                  "crash+loss+dup: the flush/visibility stamps are "
+                  "masked elementwise writes next to the round's own "
+                  "psums/pmins — NO gather (cap-0), (state, prov) "
+                  "alias in place"),
+        ProgramContract(
+            name="broadcast/provenance-run-gather-nem",
+            build=broadcast_prov,
+            collectives={"all-gather": 2, "all-reduce": None},
+            donation=True,
+            mem_lo=0.02, mem_hi=8.0,
+            notes="provenance-on gather driver under crash+loss+dup: "
+                  "EXACTLY the plain round's two widens (payload + "
+                  "dup source set) — per-direction parent attribution "
+                  "re-reads them in scope and adds ZERO gathers"),
+        ProgramContract(
+            name="kafka/provenance-run-union-nem",
+            build=kafka_prov,
+            collectives={"all-reduce": None,
+                         "collective-permute": None},
+            donation=True,
+            mem_lo=0.05, mem_hi=8.0,
+            notes="provenance-on blocked faulted-union driver: the "
+                  "_alloc mirror rides the existing ppermute prefix "
+                  "scan, the (K, C) stamp partials psum — the sharded "
+                  "observed step stays all-gather-free (cap-0)"),
+    ]

@@ -78,15 +78,27 @@ HOST_SIDE = (
 # conflicts), pending backlog, the KV cell.  kafka: allocated sends
 # (running total) and `present_bits` — the presence popcount at the
 # WITNESS node (global row 0), which climbs to alloc_total exactly
-# when replication to node 0 has caught up (a full-presence popcount
-# would re-stream the O(N·K·C) bitset every round; see
-# KafkaSim._tel_series).
+# when replication to node 0 has caught up; `present_bits_full` is the
+# full-cluster presence popcount (sum over ALL nodes), which
+# re-streams the whole O(N·K·C) bitset every round — measured ~18%
+# of the 1,024/10k sweep round in PR 8, so it is OPT-IN (see
+# OPT_IN_SERIES): the default spec records the ~free witness gauge
+# and the full scan runs only when named explicitly (a
+# TelemetrySpec(series=...) subset or GG_TELEMETRY_SERIES).
 SIM_SERIES = {
     "broadcast": ("live_nodes", "frontier_bits", "new_bits",
                   "known_bits", "msgs"),
     "counter": ("live_nodes", "pending_total", "flush_attempts",
                 "flush_acks", "cas_conflicts", "kv_total", "msgs"),
-    "kafka": ("live_nodes", "alloc_total", "present_bits", "msgs"),
+    "kafka": ("live_nodes", "alloc_total", "present_bits",
+              "present_bits_full", "msgs"),
+}
+# canonical series that a default spec (series=()) does NOT record:
+# they stay in the ring layout (so explicit subsets can select them)
+# but their per-round cost is opt-in — the PR-9 witness-default
+# contract for kafka's full presence scan.
+OPT_IN_SERIES = {
+    "kafka": ("present_bits_full",),
 }
 # appended when the spec records an open-loop traffic run (PR 7):
 # lifted straight from the TrafficState tracker's loud accounting
@@ -116,8 +128,11 @@ class TelemetrySpec:
     semantics; ``TelemetryState.wrote`` counts total recorded rounds
     so the host can detect the wrap).  ``series``: subset of
     :func:`series_names` to record — unselected columns are statically
-    zeroed, so XLA prunes their evaluation.  ``traffic``: the run is
-    open-loop (appends the tracker columns)."""
+    zeroed, so XLA prunes their evaluation; an EMPTY subset selects
+    every canonical series except the ``OPT_IN_SERIES`` (kafka's
+    ``present_bits_full`` full-presence scan stays off unless named).
+    ``traffic``: the run is open-loop (appends the tracker
+    columns)."""
 
     workload: str
     rounds: int
@@ -128,7 +143,9 @@ class TelemetrySpec:
         known = series_names(self.workload, self.traffic)
         if self.rounds < 1:
             raise ValueError("telemetry ring needs rounds >= 1")
-        sel = tuple(self.series) or known
+        opt_in = OPT_IN_SERIES.get(self.workload, ())
+        sel = tuple(self.series) or tuple(s for s in known
+                                          if s not in opt_in)
         bad = [s for s in sel if s not in known]
         if bad:
             raise ValueError(

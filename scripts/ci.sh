@@ -41,6 +41,16 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu \
 # its own JSON.  (CPU, seconds.)
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/telemetry_smoke.py || rc=1
+# Provenance smoke (PR 9): one certified crash+loss run per sim on
+# the PROVENANCE-ON observed drivers — check_provenance certifies the
+# causal stamps against the fault model's own coins, the broadcast
+# dissemination-tree artifact + flow-event timeline are written and
+# schema-validated (uploaded as a CI artifact), a forged dead-edge
+# parent must FAIL, and the flight-bundle replay must report the
+# first-divergence round (None faithful / the tampered round).
+# (CPU, seconds.)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/provenance_smoke.py || rc=1
 # Program-contract audit (PR 6): every registered driver contract
 # (collective census, donation alias table, host boundary, memory
 # band) on the CPU 8-way virtual mesh, plus the AST determinism lint

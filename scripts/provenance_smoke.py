@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""CI provenance smoke (PR 9): one certified crash+loss run per
+stateful sim on the PROVENANCE-ON observed drivers, end to end on
+CPU, seconds — the budget-safe slice the tier-1 gate runs on every
+push:
+
+1. each run records the causal stamps next to the state
+   (tpu_sim/provenance.py) and ``checkers.check_provenance``
+   certifies them against the fault model itself (the host
+   re-evaluates the liveness/loss coins of every claimed edge);
+2. the broadcast dissemination-tree artifact is WRITTEN and
+   schema-validated (``observe.validate_tree``) and the timeline
+   carries the causal flow arrows — the artifact directory is
+   uploaded as a CI build artifact;
+3. falsifiability probe: a forged parent on a dead edge must FAIL
+   the checker (a checker that cannot fail certifies nothing);
+4. the first-divergence hook: a forced failure's flight bundle
+   replays with ``first_divergence_round`` None, and a tampered
+   record fires.
+
+Exits nonzero on any failure.  Output dir: ``GG_OBSERVE_DIR``
+(default ``artifacts/provenance_smoke``).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gossip_glomers_tpu.parallel.mesh import force_virtual_devices  # noqa: E402
+
+force_virtual_devices(8)
+
+import numpy as np                                            # noqa: E402
+
+from gossip_glomers_tpu.harness import nemesis as NM          # noqa: E402
+from gossip_glomers_tpu.harness import observe                # noqa: E402
+from gossip_glomers_tpu.harness.checkers import check_provenance  # noqa: E402
+from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec     # noqa: E402
+
+N = 16
+# certified crash+loss scenarios (counter's crash window opens after
+# the acked deltas drained — amnesia before the flush is a REAL loss)
+SPECS = {
+    "broadcast": NemesisSpec(n_nodes=N, seed=5,
+                             crash=((2, 5, (1, 8)),),
+                             loss_rate=0.15, loss_until=8),
+    "counter": NemesisSpec(n_nodes=N, seed=3,
+                           crash=((12, 16, (1,)),),
+                           loss_rate=0.1, loss_until=6),
+    "kafka": NemesisSpec(n_nodes=N, seed=5, crash=((2, 5, (1, 8)),),
+                         loss_rate=0.15, loss_until=8),
+}
+RUNNERS = {"broadcast": NM.run_broadcast_nemesis,
+           "counter": NM.run_counter_nemesis,
+           "kafka": NM.run_kafka_nemesis}
+
+
+def main() -> int:
+    out = pathlib.Path(os.environ.get("GG_OBSERVE_DIR",
+                                      "artifacts/provenance_smoke"))
+    out.mkdir(parents=True, exist_ok=True)
+    failed = []
+
+    for kind in ("broadcast", "counter", "kafka"):
+        res = RUNNERS[kind](SPECS[kind], provenance=True,
+                            telemetry=True, observe_dir=str(out))
+        p = res.get("provenance", {})
+        chk = p.get("check", {})
+        print(f"provenance-smoke {kind:10s} "
+              f"{'ok' if res['ok'] else 'FAIL'}  "
+              f"converged={res['converged_round']} "
+              f"check={ {k: v for k, v in chk.items() if k != 'problems'} }")
+        if not res["ok"]:
+            failed.append((kind, chk.get("problems",
+                                         res["n_lost_writes"])))
+            continue
+        if kind == "broadcast":
+            tree = p["tree"]
+            observe.validate_tree(tree)
+            tpath = observe.write_json_atomic(
+                str(out / "dissemination_tree_broadcast.json"), tree)
+            tl = observe.run_timeline(res)
+            observe.validate_timeline(tl)
+            flows = sum(1 for e in tl["traceEvents"]
+                        if e["ph"] == "s")
+            if not flows:
+                failed.append((kind, "timeline has no flow events"))
+            observe.write_json_atomic(
+                str(out / "timeline_broadcast_flows.json"), tl)
+            print(f"  tree={os.path.basename(tpath)} "
+                  f"edges={tree['n_tree_edges']} "
+                  f"critical_path={tree['critical_path']['span_rounds']}"
+                  f"r/{tree['critical_path']['hops']}h flows={flows}")
+
+    # falsifiability probe: forged parent on a dead edge fails loudly
+    spec = NemesisSpec(n_nodes=3, seed=1, crash=((2, 20, (1,)),))
+    nbrs = np.array([[1, -1], [0, 2], [1, -1]], np.int32)
+    forged = {"arrival": np.array([[0], [2], [5]], np.int32),
+              "parent": np.array([[-1], [0], [1]], np.int32)}
+    ok_f, det_f = check_provenance(
+        "broadcast", forged, spec=spec, nbrs=nbrs,
+        received=np.ones((3, 1), bool), msgs_total=100)
+    print(f"provenance-smoke falsifiable "
+          f"{'ok' if not ok_f else 'FAIL'}  "
+          f"problems={len(det_f['problems'])}")
+    if ok_f:
+        failed.append(("falsifiability",
+                       "forged dead-edge parent passed"))
+
+    # first-divergence hook: forced failure -> bundle -> faithful
+    # replay reports None; a tampered stamp fires
+    spec_k = NemesisSpec(n_nodes=8, seed=3, crash=((2, 6, (1, 5)),),
+                         loss_rate=0.2, loss_until=8)
+    bad = NM.run_kafka_nemesis(spec_k, provenance=True,
+                               telemetry=True, observe_dir=str(out),
+                               max_recovery_rounds=0)
+    if bad["ok"] or "flight_bundle" not in bad:
+        failed.append(("divergence", "forced failure wrote no "
+                       "bundle"))
+    else:
+        replay = observe.replay_bundle(bad["flight_bundle"])
+        faithful = replay["first_divergence_round"] is None
+        bundle = observe.load_bundle(bad["flight_bundle"])
+        tampered = {k: [list(r) for r in v]
+                    for k, v in bundle["provenance"].items()}
+        fired = None
+        for row in tampered["alloc_round"]:
+            for i, r in enumerate(row):
+                if r >= 1 and fired is None:
+                    row[i] = r + 7
+                    fired = r
+        replay2 = observe.replay_bundle(
+            dict(bundle, provenance=tampered))
+        hit = replay2["first_divergence_round"] == fired
+        print(f"provenance-smoke divergence "
+              f"{'ok' if faithful and hit else 'FAIL'}  "
+              f"faithful={replay['first_divergence_round']} "
+              f"tampered={replay2['first_divergence_round']}=={fired}")
+        if not (faithful and hit):
+            failed.append(("divergence", (faithful, hit)))
+
+    if failed:
+        print(f"provenance-smoke: {len(failed)} leg(s) failed: "
+              f"{failed}", file=sys.stderr)
+        return 1
+    print("provenance-smoke: all legs ok, artifacts in", out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,730 @@
+"""Causal provenance tracing (tpu_sim/provenance.py +
+harness/observe.py + checkers.check_provenance, PR 9):
+provenance-on == provenance-off state bit-exactness for all three
+sims (stepwise vs donated fused, single-device and 8-way mesh, the
+broadcast per-edge ``delays`` ring included), the checker certified
+against real certified crash+loss+dup runs AND proven falsifiable
+(a forged parent on a dropped/dead edge, a causality-violating
+arrival, a tree-inconsistent msgs ledger — each fails loudly),
+dissemination-tree / hop-latency summaries, Perfetto flow events
+validated against the ONE shared timeline golden for both the
+virtual-harness and tpu_sim export paths, the first-divergence
+shrinker hook (check_recovery / check_telemetry / replay_bundle),
+traffic through the delay-ring broadcast modes, the kafka
+``present_bits_full`` opt-in, loud env knobs, and the traced/host
+split totality that keeps the PR-6 determinism lint covering the
+new module.
+"""
+
+import ast as ast_mod
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from gossip_glomers_tpu.harness import nemesis as NM
+from gossip_glomers_tpu.harness import observe, tracing
+from gossip_glomers_tpu.harness.checkers import (
+    check_provenance, check_recovery, check_telemetry,
+    provenance_divergence_round, series_divergence_round)
+from gossip_glomers_tpu.parallel.topology import (to_padded_neighbors,
+                                                  tree)
+from gossip_glomers_tpu.tpu_sim import audit
+from gossip_glomers_tpu.tpu_sim import provenance as PV
+from gossip_glomers_tpu.tpu_sim import telemetry as TM
+from gossip_glomers_tpu.tpu_sim import traffic as T
+from gossip_glomers_tpu.tpu_sim.broadcast import (BroadcastSim,
+                                                  make_inject)
+from gossip_glomers_tpu.tpu_sim.counter import CounterSim
+from gossip_glomers_tpu.tpu_sim.engine import unpack_bits
+from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec
+from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim
+
+
+def mesh_1d():
+    return Mesh(np.array(jax.devices()).reshape(8), ("nodes",))
+
+
+def full_spec(n, seed=7):
+    """crash + loss + dup — the full fault model."""
+    return NemesisSpec(n_nodes=n, seed=seed,
+                       crash=((2, 5, (1, n // 2)),),
+                       loss_rate=0.15, loss_until=8,
+                       dup_rate=0.1, dup_until=8)
+
+
+def leaves_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        if not (np.asarray(x) == np.asarray(y)).all():
+            return False
+    return True
+
+
+def received_bool(sim, state):
+    rec = sim.received_node_major(state)
+    v = np.arange(sim.n_values)
+    return ((rec[:, v // 32] >> (v % 32).astype(np.uint32)) & 1) \
+        .astype(bool)
+
+
+# -- spec ----------------------------------------------------------------
+
+
+def test_spec_validation_and_meta_roundtrip():
+    spec = PV.ProvenanceSpec("kafka", witness=3)
+    assert PV.ProvenanceSpec.from_meta(spec.to_meta()) == spec
+    with pytest.raises(ValueError, match="workload"):
+        PV.ProvenanceSpec("paxos")
+    with pytest.raises(ValueError, match="witness"):
+        PV.ProvenanceSpec("kafka", witness=-1)
+    with pytest.raises(ValueError, match="together"):
+        PV.prov_key(None, PV.ProvenanceSpec("counter"), "counter")
+    with pytest.raises(ValueError, match="workload"):
+        PV.prov_key(object(), PV.ProvenanceSpec("kafka"), "counter")
+
+
+def test_unpack_bits_layout():
+    words = np.array([[0b101, 0]], np.uint32)
+    bits = np.asarray(unpack_bits(words))
+    assert bits.shape == (1, 64)
+    assert bits[0, 0] and not bits[0, 1] and bits[0, 2]
+    assert np.asarray(unpack_bits(words, 3)).shape == (1, 3)
+
+
+# -- bit-exactness: provenance-on == provenance-off ----------------------
+
+
+@pytest.mark.parametrize("mesh_on", [False, True])
+def test_broadcast_provenance_bit_exact(mesh_on):
+    n, nv, rounds = 32, 64, 12
+    mesh = mesh_1d() if mesh_on else None
+    spec = full_spec(n)
+    nbrs = to_padded_neighbors(tree(n, branching=4))
+    sim = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                       srv_ledger=False, fault_plan=spec.compile(),
+                       mesh=mesh)
+    inj = make_inject(n, nv)
+    psp = PV.ProvenanceSpec("broadcast")
+    s0, _ = sim.stage(inj)
+    plain = sim.run_staged_fixed(s0, rounds, donate=True)
+    s1, _ = sim.stage(inj)
+    obs, prov = sim.run_observed(
+        s1, None, None, rounds, donate=True,
+        prov=sim.provenance_state(psp, inj), prov_spec=psp)
+    assert leaves_equal(plain, obs)
+    # stepwise (1-round programs) records the identical stamps
+    s2, _ = sim.stage(inj)
+    p2 = sim.provenance_state(psp, inj)
+    for _ in range(rounds):
+        s2, p2 = sim.run_observed(s2, None, None, 1, prov=p2,
+                                  prov_spec=psp)
+    assert leaves_equal(s2, obs) and leaves_equal(p2, prov)
+    # the record certifies against the fault model itself
+    ok, det = check_provenance(
+        "broadcast", PV.arrays_of(prov), spec=spec, nbrs=nbrs,
+        received=received_bool(sim, obs), msgs_total=int(obs.msgs))
+    assert ok, det["problems"]
+    assert det["n_origins"] == nv
+    # and composes with telemetry in the same carry
+    tsp = TM.TelemetrySpec("broadcast", rounds=rounds)
+    s3, _ = sim.stage(inj)
+    obs3, tel3, prov3 = sim.run_observed(
+        s3, sim.telemetry_state(tsp), tsp, rounds, donate=True,
+        prov=sim.provenance_state(psp, inj), prov_spec=psp)
+    assert leaves_equal(plain, obs3) and leaves_equal(prov, prov3)
+    assert TM.series_arrays(tel3, tsp)["msgs"][-1] == int(obs3.msgs)
+
+
+@pytest.mark.parametrize("mesh_on", [False, True])
+def test_broadcast_delays_provenance_bit_exact(mesh_on):
+    """The per-edge ``delays`` ring path: stamps record the edge's
+    latency class (arrival - send = delay(edge)) and the checker
+    re-evaluates the coins at the SEND round."""
+    n, nv, rounds = 32, 64, 16
+    mesh = mesh_1d() if mesh_on else None
+    nbrs = to_padded_neighbors(tree(n, branching=4))
+    rng = np.random.default_rng(0)
+    delays = np.where(np.asarray(nbrs) >= 0,
+                      rng.integers(1, 4, nbrs.shape), 1) \
+        .astype(np.int32)
+    spec = NemesisSpec(n_nodes=n, seed=5, crash=((3, 6, (2,)),),
+                       loss_rate=0.1, loss_until=8)
+    sim = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                       srv_ledger=False, delays=delays,
+                       fault_plan=spec.compile(), mesh=mesh)
+    inj = make_inject(n, nv)
+    psp = PV.ProvenanceSpec("broadcast")
+    s0, _ = sim.stage(inj)
+    plain = sim.run_staged_fixed(s0, rounds, donate=True)
+    s1, _ = sim.stage(inj)
+    obs, prov = sim.run_observed(
+        s1, None, None, rounds, donate=True,
+        prov=sim.provenance_state(psp, inj), prov_spec=psp)
+    assert leaves_equal(plain, obs)
+    ok, det = check_provenance(
+        "broadcast", PV.arrays_of(prov), spec=spec, nbrs=nbrs,
+        received=received_bool(sim, obs), msgs_total=int(obs.msgs),
+        delays=delays)
+    assert ok, det["problems"]
+    assert det["n_tree_edges"] > 0
+
+
+@pytest.mark.parametrize("mesh_on", [False, True])
+def test_counter_provenance_bit_exact(mesh_on):
+    n, rounds = 16, 16
+    mesh = mesh_1d() if mesh_on else None
+    spec = full_spec(n)
+    sim = CounterSim(n, mode="cas", poll_every=2,
+                     fault_plan=spec.compile(), mesh=mesh)
+    deltas = np.arange(1, n + 1, dtype=np.int32)
+    plain = sim.run_fused(sim.add(sim.init_state(), deltas), rounds)
+    psp = PV.ProvenanceSpec("counter")
+    obs, prov = sim.run_observed(
+        sim.add(sim.init_state(), deltas), None, None, rounds,
+        donate=True, prov=sim.provenance_state(psp), prov_spec=psp)
+    assert leaves_equal(plain, obs)
+    s2 = sim.add(sim.init_state(), deltas)
+    p2 = sim.provenance_state(psp)
+    for _ in range(rounds):
+        s2, p2 = sim.run_observed(s2, None, None, 1, prov=p2,
+                                  prov_spec=psp)
+    assert leaves_equal(s2, obs) and leaves_equal(p2, prov)
+    ok, det = check_provenance("counter", PV.arrays_of(prov),
+                               spec=spec,
+                               final_kv=int(sim.kv_value(obs)))
+    assert ok, det["problems"]
+    assert det["n_flushed"] > 0
+    # visibility never precedes the flush (stamp semantics)
+    arrs = PV.arrays_of(prov)
+    vis = arrs["visible_round"]
+    assert (vis[vis >= 0] >= arrs["flush_round"][vis >= 0]).all()
+
+
+@pytest.mark.parametrize("mesh_on", [False, True])
+def test_kafka_provenance_bit_exact(mesh_on):
+    n, k, rounds = 16, 4, 12
+    mesh = mesh_1d() if mesh_on else None
+    spec = full_spec(n)
+    sks, svs, crs = NM.stage_kafka_ops(spec, rounds, n_keys=k,
+                                       max_sends=2, workload_seed=0)
+    sim = KafkaSim(n, k, capacity=64, max_sends=2,
+                   fault_plan=spec.compile(), resync_every=4,
+                   mesh=mesh)
+    plain = sim.run_fused(sim.init_state(), sks, svs, crs)
+    psp = PV.ProvenanceSpec("kafka")
+    obs, prov = sim.run_observed(
+        sim.init_state(), None, None, sks, svs, crs, donate=True,
+        prov=sim.provenance_state(psp), prov_spec=psp)
+    assert leaves_equal(plain, obs)
+    ok, det = check_provenance(
+        "kafka", PV.arrays_of(prov), spec=spec, n_nodes=n,
+        resync_every=4, resync_mode="pull", witness=0)
+    assert ok, det["problems"]
+    assert det["n_allocated"] == int(
+        (np.asarray(obs.log_vals) >= 0).sum())
+    # the alloc stamps mirror the round's own allocator: every
+    # allocated slot has a round + origin, unallocated have neither
+    arrs = PV.arrays_of(prov)
+    allocated = np.asarray(obs.log_vals) >= 0
+    assert ((arrs["alloc_round"] >= 1) == allocated).all()
+    assert ((arrs["origin"] >= 0) == allocated).all()
+
+
+# -- falsifiability (the acceptance negatives) ---------------------------
+
+
+def _certified_broadcast():
+    n, nv = 16, 32
+    spec = NemesisSpec(n_nodes=n, seed=3, crash=((2, 5, (1,)),),
+                       loss_rate=0.2, loss_until=8)
+    nbrs = to_padded_neighbors(tree(n, branching=4))
+    sim = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                       srv_ledger=False, fault_plan=spec.compile())
+    inj = make_inject(n, nv)
+    psp = PV.ProvenanceSpec("broadcast")
+    s, _ = sim.stage(inj)
+    s, prov = sim.run_observed(
+        s, None, None, 16, donate=True,
+        prov=sim.provenance_state(psp, inj), prov_spec=psp)
+    arrs = {k: v.copy() for k, v in PV.arrays_of(prov).items()}
+    ctx = dict(spec=spec, nbrs=nbrs, received=received_bool(sim, s),
+               msgs_total=int(s.msgs))
+    ok, det = check_provenance("broadcast", arrs, **ctx)
+    assert ok, det["problems"]
+    return arrs, ctx
+
+
+def test_forged_parent_on_dead_edge_fails():
+    """A parent claim on an edge whose endpoint was DOWN at the send
+    round must fail — the host re-evaluates the liveness columns.
+    Line 0-1-2, node 1 down rounds [2, 20): node 2 claiming a round-4
+    delivery from node 1 is a forged parent on a dead edge."""
+    spec = NemesisSpec(n_nodes=3, seed=1, crash=((2, 20, (1,)),))
+    nbrs = np.array([[1, -1], [0, 2], [1, -1]], np.int32)
+    arrs = {"arrival": np.array([[0], [2], [5]], np.int32),
+            "parent": np.array([[-1], [0], [1]], np.int32)}
+    ok, det = check_provenance(
+        "broadcast", arrs, spec=spec, nbrs=nbrs,
+        received=np.ones((3, 1), bool), msgs_total=100)
+    assert not ok
+    assert any("dead or dropped" in p for p in det["problems"])
+    # the same claim BEFORE the crash window is legitimate
+    arrs["arrival"][2, 0] = 3      # send round 2?  no: round 2 down
+    arrs["arrival"][2, 0] = 2 + 1  # delivered by send round 2 — down
+    ok2, _ = check_provenance(
+        "broadcast", arrs, spec=spec, nbrs=nbrs,
+        received=np.ones((3, 1), bool), msgs_total=100)
+    assert not ok2
+    arrs2 = {"arrival": np.array([[0], [1], [2]], np.int32),
+             "parent": np.array([[-1], [0], [1]], np.int32)}
+    ok3, det3 = check_provenance(
+        "broadcast", arrs2, spec=spec, nbrs=nbrs,
+        received=np.ones((3, 1), bool), msgs_total=100)
+    assert ok3, det3["problems"]
+
+
+def test_forged_parent_on_dropped_edge_fails():
+    """A parent claim on an edge whose loss coin DROPPED the delivery
+    must fail — the coins are stateless (t, src, dst) hashes the host
+    re-evaluates exactly."""
+    n, nv = 2, 1
+    spec = NemesisSpec(n_nodes=n, seed=1, loss_rate=1.0,
+                       loss_until=100)
+    nbrs = np.array([[1], [0]], np.int32)
+    # value 0 injected at node 0 only; every delivery coin drops, so
+    # node 1 never legitimately receives it
+    arrs = {"arrival": np.array([[0], [3]], np.int32),
+            "parent": np.array([[-1], [0]], np.int32)}
+    ok, det = check_provenance(
+        "broadcast", arrs, spec=spec, nbrs=nbrs,
+        received=np.array([[True], [True]]), msgs_total=100)
+    assert not ok
+    assert any("dropped" in p for p in det["problems"])
+
+
+def test_causality_violating_arrival_fails():
+    arrs, ctx = _certified_broadcast()
+    ii, vv = np.nonzero((arrs["arrival"] > 0) & (arrs["parent"] >= 0))
+    i, v = ii[0], vv[0]
+    p = arrs["parent"][i, v]
+    # the parent now claims to have learned the value AFTER the child
+    arrs["arrival"][p, v] = arrs["arrival"][i, v] + 1
+    ok, det = check_provenance("broadcast", arrs, **ctx)
+    assert not ok
+    assert any("causality" in p_ for p_ in det["problems"])
+
+
+def test_tree_inconsistent_msgs_ledger_fails():
+    arrs, ctx = _certified_broadcast()
+    ctx["msgs_total"] = 3        # < the tree's first-delivery edges
+    ok, det = check_provenance("broadcast", arrs, **ctx)
+    assert not ok
+    assert any("msgs" in p and "ledger" in p
+               for p in det["problems"])
+    # reachability: a held bit with no recorded arrival
+    arrs2, ctx2 = _certified_broadcast()
+    i = int(np.argmax(arrs2["arrival"].max(axis=1)))
+    v = int(np.argmax(arrs2["arrival"][i]))
+    arrs2["arrival"][i, v] = -1
+    arrs2["parent"][i, v] = -1
+    ok2, det2 = check_provenance("broadcast", arrs2, **ctx2)
+    assert not ok2
+    assert any("no recorded arrival" in p for p in det2["problems"])
+
+
+def test_counter_forged_flush_fails():
+    n = 16
+    spec = NemesisSpec(n_nodes=n, seed=3, crash=((2, 6, (1,)),))
+    arrs = {"flush_round": np.full(n, -1, np.int32),
+            "flush_kv": np.full(n, -1, np.int32),
+            "visible_round": np.full(n, -1, np.int32)}
+    # node 1 claims a flush at round 4 — inside its crash window
+    arrs["flush_round"][1] = 4
+    arrs["flush_kv"][1] = 2
+    ok, det = check_provenance("counter", arrs, spec=spec,
+                               final_kv=10)
+    assert not ok
+    assert any("forged flush" in p for p in det["problems"])
+    # a flush into a value the monotone KV never passed
+    arrs["flush_round"][1] = 10
+    arrs["flush_kv"][1] = 99
+    ok, det = check_provenance("counter", arrs, spec=spec,
+                               final_kv=10)
+    assert not ok and any("monotone" in p for p in det["problems"])
+
+
+def test_kafka_forged_stamps_fail():
+    n, k, cap = 8, 2, 8
+    spec = NemesisSpec(n_nodes=n, seed=3, crash=((2, 6, (1,)),))
+    base = {f: np.full((k, cap), -1, np.int32)
+            for f in ("alloc_round", "origin", "first_present")}
+
+    def forged(**cells):
+        arrs = {f: a.copy() for f, a in base.items()}
+        for f, (kk, cc, val) in cells.items():
+            arrs[f][kk, cc] = val
+        return check_provenance(
+            "kafka", arrs, spec=spec, n_nodes=n, resync_every=4,
+            resync_mode="pull", witness=0)
+
+    # allocation claimed by a node that was down at the send round
+    ok, det = forged(alloc_round=(0, 0, 4), origin=(0, 0, 1),
+                     first_present=(0, 0, 4))
+    assert not ok and any("forged allocation" in p
+                          for p in det["problems"])
+    # witness presence BEFORE allocation
+    ok, det = forged(alloc_round=(0, 0, 7), origin=(0, 0, 2),
+                     first_present=(0, 0, 3))
+    assert not ok and any("BEFORE its allocation" in p
+                          for p in det["problems"])
+    # a late presence at a non-resync round
+    ok, det = forged(alloc_round=(0, 0, 7), origin=(0, 0, 2),
+                     first_present=(0, 0, 10))
+    assert not ok and any("not a resync round" in p
+                          for p in det["problems"])
+
+
+# -- dissemination trees + timelines (the shared golden) -----------------
+
+
+def _golden():
+    path = os.path.join(os.path.dirname(__file__), "data",
+                        "timeline_golden.json")
+    return json.load(open(path))
+
+
+def _validate_against_golden(tl, golden, *, require_flows):
+    observe.validate_timeline(tl)
+    assert tl["schema"] == golden["schema"]
+    assert tl["displayTimeUnit"] == golden["displayTimeUnit"]
+    for key in golden["required_top"]:
+        assert key in tl, key
+    seen = {e["ph"] for e in tl["traceEvents"]}
+    required = set(golden["required_phases"])
+    if not require_flows:
+        required -= {"s", "f"}
+    assert required <= seen, (required, seen)
+    for ev in tl["traceEvents"]:
+        fields = golden["phase_fields"].get(ev["ph"])
+        if fields is None:
+            continue
+        for f in fields:
+            if f == "args" and ev["ph"] == "M":
+                pass
+            assert f in ev, (ev["ph"], f, ev)
+
+
+def test_timeline_golden_parity_both_paths():
+    """Satellite: ONE shared golden validates the Perfetto export of
+    BOTH backends — a tpu_sim provenance-on nemesis run (flow events
+    from the dissemination trees) and a virtual-harness trace (flow
+    events per routed message)."""
+    golden = _golden()
+    spec = NemesisSpec(n_nodes=16, seed=5, crash=((2, 5, (1, 8)),),
+                       loss_rate=0.15, loss_until=8)
+    res = NM.run_broadcast_nemesis(spec, provenance=True,
+                                   telemetry=True)
+    assert res["ok"], res.get("provenance", {}).get("check")
+    tl = observe.run_timeline(res)
+    _validate_against_golden(tl, golden, require_flows=True)
+    flows = [e for e in tl["traceEvents"] if e["ph"] == "s"]
+    assert flows and all(e["cat"] == "flow" for e in flows)
+
+    from gossip_glomers_tpu.protocol import Message
+    trace = [(0.001, Message("n0", "n1", {"type": "broadcast"})),
+             (0.002, Message("n1", "n2", {"type": "broadcast"})),
+             (0.003, Message("n2", "n1", {"type": "broadcast_ok"}))]
+    tl_v = tracing.to_timeline(trace)
+    _validate_against_golden(tl_v, golden, require_flows=True)
+    # same arrow count as messages
+    assert len([e for e in tl_v["traceEvents"]
+                if e["ph"] == "s"]) == 3
+
+
+def test_dissemination_tree_summary():
+    spec = NemesisSpec(n_nodes=16, seed=5, crash=((2, 5, (1, 8)),),
+                       loss_rate=0.15, loss_until=8)
+    res = NM.run_broadcast_nemesis(spec, provenance=True)
+    assert res["ok"]
+    d = res["provenance"]["tree"]
+    observe.validate_tree(d)
+    assert d["n_tree_edges"] == res["provenance"]["check"][
+        "n_tree_edges"]
+    # hop latency: every per-value span bounds its depth
+    for row in d["values"]:
+        assert row["span_rounds"] >= row["depth_hops"] >= 0
+        assert row["n_reached"] >= 1
+    cp = d["critical_path"]
+    assert cp["span_rounds"] == d["max_span_rounds"]
+    assert cp["chain"][0]["round"] == 0          # rooted at an origin
+    assert cp["chain"][-1]["round"] == cp["span_rounds"]
+    assert d["edges"] and all(e["n_values"] >= 1 for e in d["edges"])
+    # the tree artifact is JSON-able as committed
+    json.dumps(d)
+
+
+def test_validate_timeline_rejects_acausal_flow():
+    tb = observe.TimelineBuilder("bad")
+    tb.slice("a", "x", 0.0, 1.0)
+    tb.flow("v", "a", 5.0, "a", 1.0)     # finishes before it starts
+    with pytest.raises(ValueError, match="causality"):
+        observe.validate_timeline(tb.to_dict())
+    tb2 = observe.TimelineBuilder("bad2")
+    tb2.events.append({"ph": "s", "pid": 1, "tid": 1, "id": 9,
+                       "name": "v", "ts": 0.0})
+    with pytest.raises(ValueError, match="pair"):
+        observe.validate_timeline(tb2.to_dict())
+
+
+# -- the first-divergence shrinker hook ----------------------------------
+
+
+def test_divergence_rounds_and_checker_hooks():
+    exp = {"_round": [0, 1, 2], "msgs": [4, 8, 12],
+           "live_nodes": [8, 8, 8]}
+    assert series_divergence_round(exp, exp) is None
+    got = {"_round": [0, 1, 2], "msgs": [4, 8, 13],
+           "live_nodes": [8, 8, 8]}
+    assert series_divergence_round(exp, got) == 2
+    # check_telemetry surfaces it loudly under expected=
+    ok, det = check_telemetry(got, expected=exp)
+    assert not ok and det["first_divergence_round"] == 2
+    assert any("diverge" in p for p in det["problems"])
+    ok, det = check_telemetry(exp, expected=exp)
+    assert ok and det["first_divergence_round"] is None
+    # provenance stamps: earliest differing stamp round wins
+    a = {"arrival": np.array([[0, 3], [2, -1]], np.int32)}
+    b = {"arrival": np.array([[0, 3], [2, -1]], np.int32)}
+    assert provenance_divergence_round(a, b) is None
+    b["arrival"][1, 0] = 5
+    assert provenance_divergence_round(a, b) == 2
+    assert provenance_divergence_round(
+        a, {"arrival": np.zeros((3, 3), np.int32)}) == 0
+    # check_recovery passes the divergence through to details
+    ok, det = check_recovery(clear_round=4, converged_round=6,
+                             max_recovery_rounds=8, lost_writes=[],
+                             divergence=3)
+    assert det["first_divergence_round"] == 3
+
+
+def test_flight_bundle_replay_reports_first_divergence(tmp_path):
+    """A certified crash+loss campaign forced to fail (impossible
+    recovery budget) bundles its provenance + series; the replay is
+    deterministic, so the reported first-divergence round is None —
+    and a TAMPERED record fires at the tampered round (the negative
+    proof the shrinker hook works)."""
+    spec = NemesisSpec(n_nodes=8, seed=3, crash=((2, 6, (1, 5)),),
+                       loss_rate=0.2, loss_until=8)
+    bad = NM.run_kafka_nemesis(spec, telemetry=True, provenance=True,
+                               observe_dir=str(tmp_path),
+                               max_recovery_rounds=0)
+    assert not bad["ok"] and "flight_bundle" in bad
+    bundle = observe.load_bundle(bad["flight_bundle"])
+    assert bundle["provenance_spec"]["workload"] == "kafka"
+    assert bundle["provenance"]["alloc_round"]
+    replay = observe.replay_bundle(bad["flight_bundle"])
+    assert not replay["ok"]
+    assert replay["first_divergence_round"] is None
+    assert replay["converged_round"] == bad["converged_round"]
+    # tamper the recorded provenance: the replay must report the
+    # forged round as the first divergence
+    forged = {k: [r[:] for r in v]
+              for k, v in bundle["provenance"].items()}
+    rounds = [r for row in forged["alloc_round"] for r in row
+              if r >= 1]
+    target = max(rounds)
+    done = False
+    for row in forged["alloc_round"]:
+        for i, r in enumerate(row):
+            if r == target and not done:
+                row[i] = r + 7
+                done = True
+    tampered = dict(bundle, provenance=forged)
+    replay2 = observe.replay_bundle(tampered)
+    assert replay2["first_divergence_round"] == target
+    # tampered telemetry fires too, at the earlier of the two
+    t_series = {k: (v[:] if isinstance(v, list) else v)
+                for k, v in bundle["telemetry_series"].items()}
+    t_series["msgs"] = list(t_series["msgs"])
+    t_series["msgs"][0] += 1
+    first_round = t_series["_round"][0]
+    replay3 = observe.replay_bundle(
+        dict(bundle, telemetry_series=t_series))
+    assert replay3["first_divergence_round"] == first_round
+
+
+# -- runner integration + env knobs --------------------------------------
+
+
+def test_env_switch_drives_runners(monkeypatch):
+    spec = NemesisSpec(n_nodes=8, seed=3, crash=((12, 16, (1,)),))
+    monkeypatch.setenv("GG_PROVENANCE", "1")
+    res = NM.run_counter_nemesis(spec)
+    assert res["ok"] and "provenance" in res
+    assert res["provenance"]["check"]["n_flushed"] > 0
+    monkeypatch.delenv("GG_PROVENANCE")
+    res_off = NM.run_counter_nemesis(spec)
+    assert "provenance" not in res_off
+    # provenance-on is pinned bit-exact to provenance-off
+    assert res_off["converged_round"] == res["converged_round"]
+    assert res_off["msgs_total"] == res["msgs_total"]
+
+
+def test_env_knob_is_loud(monkeypatch):
+    monkeypatch.setenv("GG_PROVENANCE", "yes")
+    with pytest.raises(ValueError, match="GG_PROVENANCE"):
+        PV.enabled()
+    monkeypatch.setenv("GG_PROVENANCE", "2")
+    with pytest.raises(ValueError, match="GG_PROVENANCE"):
+        PV.enabled()
+    monkeypatch.setenv("GG_PROVENANCE", "1")
+    assert PV.enabled() is True
+    monkeypatch.delenv("GG_PROVENANCE")
+    assert PV.enabled() is False
+
+
+def test_runner_rejections_are_loud():
+    spec = NemesisSpec(n_nodes=8, seed=3, crash=((2, 4, (1,)),))
+    tspec = T.TrafficSpec(n_nodes=8, n_clients=8, ops_per_client=2,
+                          until=4, rate=0.5, seed=1)
+    with pytest.raises(ValueError, match="traffic"):
+        NM.run_counter_nemesis(spec, traffic=tspec, provenance=True)
+    with pytest.raises(ValueError, match="gather"):
+        NM.run_broadcast_nemesis(spec, structured=True,
+                                 provenance=True)
+    nbrs = to_padded_neighbors(tree(8, branching=4))
+    from gossip_glomers_tpu.tpu_sim import structured as S
+    sim = BroadcastSim(nbrs, n_values=16,
+                       exchange=S.make_exchange("tree", 8,
+                                                branching=4))
+    psp = PV.ProvenanceSpec("broadcast")
+    with pytest.raises(ValueError, match="words-major|gather"):
+        sim.run_observed(
+            sim.init_state(np.zeros((8, 1), np.uint32)), None, None,
+            2, prov=sim.provenance_state(psp, np.zeros((8, 1),
+                                                       np.uint32)),
+            prov_spec=psp)
+
+
+# -- traffic through the delay-ring modes (satellite) --------------------
+
+
+@pytest.mark.parametrize("mesh_on", [False, True])
+def test_traffic_through_delay_ring_modes(mesh_on):
+    """The ROADMAP item-1 leftover: broadcast's per-edge ``delays``
+    gather mode takes open-loop traffic — ops flood with the edge
+    latency and the loud backpressure identity holds."""
+    n, nv = 32, 256
+    mesh = mesh_1d() if mesh_on else None
+    nbrs = to_padded_neighbors(tree(n, branching=4))
+    rng = np.random.default_rng(0)
+    delays = np.where(np.asarray(nbrs) >= 0,
+                      rng.integers(1, 4, nbrs.shape), 1) \
+        .astype(np.int32)
+    spec = NemesisSpec(n_nodes=n, seed=5, crash=((3, 6, (2,)),),
+                       loss_rate=0.1, loss_until=8)
+    tspec = T.TrafficSpec(n_nodes=n, n_clients=8, ops_per_client=6,
+                          until=12, rate=0.4, seed=1)
+    sim = BroadcastSim(nbrs, n_values=nv, sync_every=4,
+                       srv_ledger=False, delays=delays,
+                       fault_plan=spec.compile(), mesh=mesh)
+    st, ts = sim.run_traffic(
+        sim.init_state(np.zeros((n, nv // 32), np.uint32)),
+        sim.traffic_state(tspec), tspec, 30, donate=True)
+    issued = int((np.asarray(ts.issue_round) >= 0).sum())
+    assert int(ts.arrived) == issued + int(ts.deferred)
+    assert int(ts.completed) == issued       # all drained
+    assert int(ts.completed) > 0
+    # delayed completion: with min edge delay 1 and diameter > 1, an
+    # op cannot complete in under 2 rounds
+    lat = T.latency_summary(ts)
+    assert lat["lat_p50"] >= 2
+
+
+# -- kafka present_bits_full opt-in (satellite) --------------------------
+
+
+def test_present_bits_full_is_opt_in():
+    # the default spec records the witness gauge, NOT the full scan
+    dsp = TM.TelemetrySpec("kafka", rounds=8)
+    assert "present_bits" in dsp.series
+    assert "present_bits_full" not in dsp.series
+    # explicit selection still works, and the column records the
+    # full-cluster popcount
+    full = TM.TelemetrySpec(
+        "kafka", rounds=8,
+        series=("present_bits", "present_bits_full", "alloc_total"))
+    assert "present_bits_full" in full.series
+    n, k = 8, 2
+    sim = KafkaSim(n, k, capacity=32, max_sends=2)
+    sks = np.full((8, n, 2), -1, np.int32)
+    sks[:, 0, 0] = 0
+    svs = np.zeros((8, n, 2), np.int32)
+    plain = sim.run_fused(sim.init_state(), sks, svs, None)
+    obs, tel = sim.run_observed(sim.init_state(),
+                                sim.telemetry_state(full), full,
+                                sks, svs, None, donate=True)
+    assert leaves_equal(plain, obs)
+    arrs = TM.series_arrays(tel, full)
+    pres = np.asarray(obs.present)
+    total = int(np.unpackbits(pres.view(np.uint8)).sum())
+    assert arrs["present_bits_full"][-1] == total
+    # full-presence == N x witness once replication has caught up
+    assert arrs["present_bits_full"][-1] == n * arrs[
+        "present_bits"][-1]
+    # the default spec leaves the opt-in column zeroed in the ring
+    obs2, tel2 = sim.run_observed(sim.init_state(),
+                                  sim.telemetry_state(dsp), dsp,
+                                  sks, svs, None, donate=True)
+    ring = np.asarray(tel2.ring)
+    col = dsp.names.index("present_bits_full")
+    assert (ring[:, col] == 0).all()
+
+
+# -- lint split + registry ----------------------------------------------
+
+
+def test_provenance_traced_host_split_is_total():
+    import gossip_glomers_tpu
+    pkg = os.path.dirname(os.path.abspath(
+        gossip_glomers_tpu.__file__))
+    src = open(os.path.join(pkg, "tpu_sim", "provenance.py")).read()
+    tree_ = ast_mod.parse(src)
+    top_fns = {n.name for n in tree_.body
+               if isinstance(n, ast_mod.FunctionDef)}
+    declared = set(PV.TRACED_EVALUATORS) | set(PV.HOST_SIDE)
+    assert top_fns == declared, (
+        f"undeclared: {sorted(top_fns - declared)}, "
+        f"stale: {sorted(declared - top_fns)}")
+    pat = audit._root_pattern_for("tpu_sim/provenance.py")
+    for name in PV.TRACED_EVALUATORS:
+        assert pat.match(name), name
+    for name in PV.HOST_SIDE:
+        assert not pat.match(name), name
+    # the sims' provenance recorders are traced roots too
+    assert audit._root_pattern_for(
+        "tpu_sim/broadcast.py").match("_prov_attribute")
+    assert audit._root_pattern_for(
+        "tpu_sim/counter.py").match("_prov_record")
+    assert audit._root_pattern_for(
+        "tpu_sim/kafka.py").match("_prov_record")
+
+
+def test_provenance_contracts_registered():
+    rows = {c.name: c for c in audit.default_registry()}
+    for expected in ("counter/provenance-run",
+                     "broadcast/provenance-run-gather-nem",
+                     "kafka/provenance-run-union-nem"):
+        assert expected in rows
+        c = rows[expected]
+        assert c.donation
+        # cap-0 all-gather census for counter/kafka; the broadcast
+        # gather row pins EXACTLY its plain two widens
+        if "broadcast" in expected:
+            assert c.collectives["all-gather"] == 2
+        else:
+            assert "all-gather" not in c.collectives
