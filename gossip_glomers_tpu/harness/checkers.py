@@ -143,6 +143,72 @@ def check_recovery(*, clear_round: int, converged_round: int | None,
     return ok, details
 
 
+def check_recovery_batch(*, clear_rounds, converged_rounds,
+                         max_recovery_rounds: int, lost_writes,
+                         msgs_at_clear=None, msgs_at_converged=None,
+                         ) -> tuple[bool, dict]:
+    """Batched :func:`check_recovery` over per-SCENARIO row arrays
+    (PR 10, the scenario-axis fuzzer's verdict layer): every input is
+    an (S,) array — ``converged_rounds`` uses -1 for "never converged
+    within bound" (the device-side sentinel of
+    tpu_sim/scenario.py ``certify_loop``) — except ``lost_writes``, a
+    list of S per-scenario evidence lists.  The rows come straight
+    off the ONE batched device transfer (no per-scenario device
+    dispatch anywhere); each row's verdict is the scalar
+    :func:`check_recovery` itself, so the batched and sequential
+    certifiers cannot drift.  The details dict carries:
+
+    - ``scenarios``: the :func:`check_recovery` verdict dict per
+      scenario (the scalar checker itself runs per row, so the two
+      can never drift) with ``ok`` folded in;
+    - ``failing``: the indices of every failing scenario — a single
+      planted bad scenario in a batch fails LOUDLY and is named by
+      index (``problems`` strings; tests/test_scenario.py proves it).
+    """
+    import numpy as np
+
+    clear = np.asarray(clear_rounds, np.int64)
+    conv = np.asarray(converged_rounds, np.int64)
+    s = clear.shape[0]
+    if conv.shape[0] != s or len(lost_writes) != s:
+        raise ValueError(
+            f"batch shape mismatch: {s} clear rounds, "
+            f"{conv.shape[0]} converged rounds, "
+            f"{len(lost_writes)} lost-writes lists")
+    mc = (None if msgs_at_clear is None
+          else np.asarray(msgs_at_clear, np.int64))
+    mv = (None if msgs_at_converged is None
+          else np.asarray(msgs_at_converged, np.int64))
+    rows: list[dict] = []
+    problems: list[str] = []
+    failing: list[int] = []
+    for i in range(s):
+        ok_i, det = check_recovery(
+            clear_round=int(clear[i]),
+            converged_round=(int(conv[i]) if conv[i] >= 0 else None),
+            max_recovery_rounds=max_recovery_rounds,
+            lost_writes=list(lost_writes[i]),
+            msgs_at_clear=(None if mc is None else int(mc[i])),
+            msgs_at_converged=(None if mv is None else int(mv[i])))
+        rows.append({"ok": ok_i, **det})
+        if not ok_i:
+            failing.append(i)
+            if len(problems) < 10:
+                why = ("never converged" if conv[i] < 0
+                       else f"lost {len(lost_writes[i])} acked writes"
+                       if lost_writes[i] else
+                       f"recovery took {int(conv[i] - clear[i])} "
+                       f"rounds (> {max_recovery_rounds})")
+                problems.append(f"scenario {i}: {why}")
+    return not failing, {
+        "n_scenarios": s,
+        "n_ok": s - len(failing),
+        "failing": failing,
+        "problems": problems,
+        "scenarios": rows,
+    }
+
+
 def check_op_latency(summary: dict, *, p99_max_rounds: float,
                      max_rounds: int | None = None,
                      min_completed: int = 1) -> tuple[bool, dict]:

@@ -1,0 +1,585 @@
+"""Fault-space fuzzer (PR 10): thousands of certified crash x loss x
+dup x partition x delay campaigns per dispatch sequence, auto-shrunk
+repros for every failure.
+
+The fuzzer closes ROADMAP item 2 end to end:
+
+1. **sample** — :func:`sample_scenarios` draws scenario cells from a
+   SEEDED generator over the five fault axes (crash windows, loss
+   rate, dup rate, partition windows, per-edge delays — the last two
+   broadcast-only), each cell a JSON-able
+   :class:`~..tpu_sim.scenario.Scenario`;
+2. **dispatch** — :func:`fuzz_run` packs them into
+   :class:`~..tpu_sim.scenario.ScenarioBatch`es and certifies each
+   batch in ONE compiled vmapped program (scenario-sharded across the
+   mesh), reading back per-scenario verdicts through the batched
+   recovery certifier (checkers.check_recovery_batch);
+3. **repro** — every failing scenario is re-run SEQUENTIALLY with
+   telemetry on (the batched drivers are pinned bit-exact to the
+   sequential runners, so the failure reproduces) and the flight
+   recorder writes its one-file JSON bundle (harness/observe.py);
+4. **shrink** — :func:`shrink_scenario` greedily reduces the failing
+   cell (drop crash windows, drop crashed nodes, shorten durations,
+   lower/zero the loss/dup rates, drop partition windows, flatten
+   delays), accepting a move only when the reduced cell still fails
+   with the IDENTICAL failure signature; the terminal cell gets its
+   own bundle, a MINIMALITY certificate — removing any retained
+   component makes the failure vanish or visibly moves the replayed
+   trajectory's first-divergence round against the shrunk bundle's
+   recorded series (``checkers.series_divergence_round``, the PR-9
+   shrinker signal) — and a final ``replay_bundle`` check that the
+   shrunk repro reproduces the same failure from JSON alone.
+
+Everything is a pure function of the fuzzer seed: the same seed
+replays the identical campaign set, batch packing, and shrink
+sequence.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from ..tpu_sim import scenario as SC
+from ..tpu_sim import telemetry as TM
+from ..tpu_sim.faults import NemesisSpec, random_spec
+
+# The module's host/device split, DECLARED (the PR-6 faults.py
+# pattern): the fuzzer is PURE HOST code — sampling, dispatch, and
+# shrinking all run before/after tracing (the traced scope lives in
+# tpu_sim/scenario.py's certify_loop and the sims' rounds).  The
+# determinism lint (tpu_sim/audit.py) still walks this file; the empty
+# traced tuple pins that nothing here may claim traced scope, and
+# tests/test_scenario.py pins the split TOTAL.
+TRACED_EVALUATORS: tuple = ()
+HOST_SIDE = (
+    "_sample_partition", "sample_scenarios", "planted_failure",
+    "_canon_lost", "failure_signature", "scenario_weight",
+    "run_sequential", "_shrink_moves", "_components",
+    "shrink_scenario", "fuzz_run")
+
+# the sampled axis grids (each cell draws one value per axis)
+LOSS_GRID = (0.0, 0.05, 0.1, 0.2)
+DUP_GRID = (0.0, 0.05, 0.1)
+CRASH_GRID = (0, 1, 2)
+DELAY_CLASSES = (1, 2)
+
+
+# -- sampling ------------------------------------------------------------
+
+
+def _sample_partition(rng, n_nodes: int, horizon: int) -> dict:
+    """One random bipartition window inside the horizon (JSON meta)."""
+    s = int(rng.integers(1, max(2, horizon - 2)))
+    e = int(rng.integers(s + 1, horizon + 1))
+    group = (rng.random(n_nodes) < 0.5).astype(np.int8)
+    # both sides non-empty, else the window is inert
+    if group.all() or not group.any():
+        group[0] = 1 - group[0]
+    return {"starts": [s], "ends": [e],
+            "group": [group.astype(int).tolist()]}
+
+
+def sample_scenarios(workload: str, n_scenarios: int, *,
+                     n_nodes: int, seed: int, horizon: int,
+                     nbrs_shape=None, delay_axis: bool = False,
+                     partition_axis: bool = True) -> list:
+    """Seeded scenario cells over the fault-space grid.  Scenario
+    ``i``'s spec seed is ``seed * 100003 + i`` — distinct seeds,
+    bit-replayable.  ``delay_axis`` samples per-edge delays over
+    ``DELAY_CLASSES`` for EVERY cell (batches must be homogeneous in
+    the delay dimension — the delays-on round carries a history
+    ring); ``nbrs_shape`` is the (N, D) adjacency shape the delay
+    matrix must match."""
+    if delay_axis and nbrs_shape is None:
+        raise ValueError("delay_axis sampling needs nbrs_shape")
+    out = []
+    for i in range(n_scenarios):
+        cell_seed = seed * 100003 + i
+        rng = np.random.default_rng(cell_seed)
+        n_crash = int(rng.choice(CRASH_GRID))
+        loss = float(rng.choice(LOSS_GRID))
+        dup = (float(rng.choice(DUP_GRID))
+               if workload == "broadcast" else 0.0)
+        if n_crash == 0:
+            spec = NemesisSpec(
+                n_nodes=n_nodes, seed=cell_seed, loss_rate=loss,
+                loss_until=horizon if loss else None,
+                dup_rate=dup, dup_until=horizon if dup else None)
+        else:
+            spec = random_spec(
+                n_nodes, seed=cell_seed, horizon=horizon,
+                n_crash_windows=n_crash, loss_rate=loss,
+                dup_rate=dup)
+        if workload == "counter" and spec.crash:
+            # the sweep's counter convention (fault_sweep._shift_crash):
+            # the cas flush drains one contender per round, so a crash
+            # window landing before round N provably kills
+            # acked-but-unflushed deltas — the ack-before-durability
+            # loss the certifier exists to flag, but a RECOVERY fuzz
+            # grid should measure recovery, not guaranteed loss
+            shift = n_nodes + 2
+            meta = spec.to_meta()
+            meta["crash"] = [[s + shift, e + shift, ns]
+                             for s, e, ns in meta["crash"]]
+            if spec.loss_rate:
+                meta["loss_until"] += shift
+            if spec.dup_rate:
+                meta["dup_until"] += shift
+            spec = NemesisSpec.from_meta(meta)
+        parts = None
+        delays = None
+        if workload == "broadcast":
+            if partition_axis and rng.random() < 0.5:
+                parts = _sample_partition(rng, n_nodes, horizon)
+            if delay_axis:
+                d = rng.choice(DELAY_CLASSES,
+                               size=nbrs_shape).astype(np.int32)
+                delays = tuple(tuple(int(v) for v in row)
+                               for row in d)
+        out.append(SC.Scenario(spec=spec, parts=parts, delays=delays,
+                               workload_seed=cell_seed))
+    return out
+
+
+def planted_failure(workload: str, n_nodes: int,
+                    horizon: int) -> SC.Scenario:
+    """A scenario that PROVABLY fails: a crash window opening at round
+    0 takes the sole copies its nodes hold down with them (broadcast:
+    origin values wiped before the first flood — lost acked writes),
+    dressed with non-load-bearing loss/dup/partition components the
+    shrinker must strip."""
+    if workload == "kafka":
+        raise ValueError(
+            "the planted-failure cell targets broadcast/counter "
+            "(kafka allocations require a live origin, so a round-0 "
+            "crash stages no acked writes to lose)")
+    spec = NemesisSpec(
+        n_nodes=n_nodes, seed=424242,
+        crash=((0, horizon, (0, 1)),),
+        loss_rate=0.1, loss_until=horizon,
+        dup_rate=0.05 if workload == "broadcast" else 0.0,
+        dup_until=horizon if workload == "broadcast" else None)
+    parts = None
+    if workload == "broadcast":
+        group = (np.arange(n_nodes) % 2).astype(int)
+        parts = {"starts": [1], "ends": [3],
+                 "group": [group.tolist()]}
+    return SC.Scenario(spec=spec, parts=parts,
+                       workload_seed=424242)
+
+
+# -- failure signatures & spec weight ------------------------------------
+
+
+def _canon_lost(lost) -> tuple:
+    """Canonical JSON-stable form of a lost-writes evidence list
+    (entries survive a bundle's JSON round trip: tuples become
+    lists)."""
+    def canon(e):
+        if isinstance(e, (list, tuple)):
+            return json.dumps([canon(x) for x in e])
+        if isinstance(e, dict):
+            return json.dumps(
+                {k: canon(v) for k, v in sorted(e.items())})
+        return json.dumps(e)
+
+    return tuple(sorted(canon(e) for e in lost))
+
+
+def failure_signature(result: dict) -> dict | None:
+    """What makes two failures "the same" for the shrinker: the
+    workload, whether the run converged at all, and the canonical
+    lost-writes evidence.  None for a PASSING run (nothing to
+    shrink)."""
+    if result.get("ok"):
+        return None
+    return {"workload": result.get("workload"),
+            "converged": result.get("converged_round") is not None,
+            "n_lost": result.get("n_lost_writes", 0),
+            "lost": _canon_lost(result.get("lost_writes", []))}
+
+
+def scenario_weight(sc: SC.Scenario) -> int:
+    """Size metric the shrinker drives down: crash windows + crashed
+    nodes + window rounds + active rates/horizons + partition windows
+    + non-unit delay edges.  A shrunk repro must weigh strictly less
+    than its original (asserted by scripts/fuzz_smoke.py)."""
+    spec = sc.spec
+    w = 0
+    for s, e, nodes in spec.crash:
+        w += 1 + len(nodes) + (e - s)
+    if spec.loss_rate > 0:
+        w += 1 + spec._until(spec.loss_until, spec.loss_rate)
+    if spec.dup_rate > 0:
+        w += 1 + spec._until(spec.dup_until, spec.dup_rate)
+    if sc.parts is not None:
+        w += len(sc.parts["starts"])
+    if sc.delays is not None:
+        w += int(sum(1 for row in sc.delays for v in row if v != 1))
+    return w
+
+
+# -- sequential repro ----------------------------------------------------
+
+
+def run_sequential(workload: str, sc: SC.Scenario, runner_kw: dict,
+                   max_recovery_rounds: int, *, telemetry=None,
+                   observe_dir=None) -> dict:
+    """One scenario through the ordinary ``run_*_nemesis`` runner —
+    the repro/shrink path (bit-exact twin of the batched driver,
+    pinned by tests/test_scenario.py)."""
+    from . import nemesis as NM
+
+    kw = dict(runner_kw)
+    if workload == "broadcast":
+        return NM.run_broadcast_nemesis(
+            sc.spec, n_values=kw.get("n_values"),
+            topology=kw.get("topology", "grid"),
+            sync_every=int(kw.get("sync_every", 4)),
+            parts=sc.parts,
+            delays=(None if sc.delays is None
+                    else np.asarray(sc.delays, np.int32)),
+            max_recovery_rounds=max_recovery_rounds,
+            telemetry=telemetry, observe_dir=observe_dir)
+    if workload == "counter":
+        return NM.run_counter_nemesis(
+            sc.spec, mode=kw.get("mode", "cas"),
+            poll_every=int(kw.get("poll_every", 2)),
+            max_recovery_rounds=max_recovery_rounds,
+            telemetry=telemetry, observe_dir=observe_dir)
+    return NM.run_kafka_nemesis(
+        sc.spec, n_keys=int(kw.get("n_keys", 4)),
+        capacity=int(kw.get("capacity", 64)),
+        max_sends=int(kw.get("max_sends", 2)),
+        resync_every=int(kw.get("resync_every", 4)),
+        workload_seed=sc.workload_seed, commits=False,
+        send_prob=float(kw.get("send_prob", 0.7)),
+        rounds=kw.get("rounds"),
+        max_recovery_rounds=max_recovery_rounds,
+        telemetry=telemetry, observe_dir=observe_dir)
+
+
+# -- the auto-shrinker ---------------------------------------------------
+
+
+def _shrink_moves(sc: SC.Scenario):
+    """Candidate reductions of one scenario, most-aggressive first.
+    Every move yields ``(description, reduced Scenario)``; the greedy
+    loop accepts a move iff the reduced cell still fails with the
+    identical signature."""
+    spec = sc.spec
+    meta = spec.to_meta()
+
+    def with_spec(m):
+        return SC.Scenario(spec=NemesisSpec.from_meta(m),
+                           parts=sc.parts, delays=sc.delays,
+                           workload_seed=sc.workload_seed)
+
+    # drop whole crash windows
+    for i in range(len(meta["crash"])):
+        m = dict(meta)
+        m["crash"] = [w for j, w in enumerate(meta["crash"])
+                      if j != i]
+        yield f"drop crash window {i}", with_spec(m)
+    # drop individual crashed nodes
+    for i, (s, e, nodes) in enumerate(meta["crash"]):
+        if len(nodes) <= 1:
+            continue
+        for j in range(len(nodes)):
+            m = dict(meta)
+            m["crash"] = [list(w) for w in meta["crash"]]
+            m["crash"][i] = [s, e,
+                             [x for k, x in enumerate(nodes)
+                              if k != j]]
+            yield (f"drop node {nodes[j]} from crash window {i}",
+                   with_spec(m))
+    # halve crash-window durations (toward 1 round)
+    for i, (s, e, nodes) in enumerate(meta["crash"]):
+        if e - s > 1:
+            m = dict(meta)
+            m["crash"] = [list(w) for w in meta["crash"]]
+            m["crash"][i] = [s, s + max(1, (e - s) // 2), list(nodes)]
+            yield (f"halve crash window {i} duration", with_spec(m))
+    # zero, then halve, the loss/dup rates
+    for rate_key, until_key in (("loss_rate", "loss_until"),
+                                ("dup_rate", "dup_until")):
+        if meta[rate_key] > 0:
+            m = dict(meta)
+            m[rate_key] = 0.0
+            m[until_key] = None
+            yield f"zero {rate_key}", with_spec(m)
+            m2 = dict(meta)
+            m2[rate_key] = meta[rate_key] / 2
+            yield f"halve {rate_key}", with_spec(m2)
+    # drop partition windows
+    if sc.parts is not None:
+        n_w = len(sc.parts["starts"])
+        for i in range(n_w):
+            if n_w == 1:
+                reduced = None
+            else:
+                reduced = {
+                    "starts": [v for j, v in
+                               enumerate(sc.parts["starts"]) if j != i],
+                    "ends": [v for j, v in
+                             enumerate(sc.parts["ends"]) if j != i],
+                    "group": [g for j, g in
+                              enumerate(sc.parts["group"]) if j != i]}
+            yield (f"drop partition window {i}",
+                   SC.Scenario(spec=spec, parts=reduced,
+                               delays=sc.delays,
+                               workload_seed=sc.workload_seed))
+    # flatten the delay matrix to uniform 1 (drop the delay axis)
+    if sc.delays is not None \
+            and any(v != 1 for row in sc.delays for v in row):
+        ones = tuple(tuple(1 for _ in row) for row in sc.delays)
+        yield ("flatten delays to 1",
+               SC.Scenario(spec=spec, parts=sc.parts, delays=ones,
+                           workload_seed=sc.workload_seed))
+
+
+def _components(sc: SC.Scenario):
+    """The retained fault components of a (shrunk) scenario, each with
+    the scenario-with-it-removed — the minimality certificate re-runs
+    every one."""
+    for desc, cand in _shrink_moves(sc):
+        # removal moves only (halving is a reduction, not a removal)
+        if desc.startswith(("drop", "zero", "flatten")):
+            yield desc, cand
+
+
+def shrink_scenario(workload: str, sc: SC.Scenario, runner_kw: dict,
+                    max_recovery_rounds: int, *, observe_dir,
+                    tel_rounds: int, max_iters: int = 200) -> dict:
+    """Greedy auto-shrink of one failing scenario (module docstring).
+    Returns the shrink record: original/shrunk cells + weights, the
+    accepted move trail, the shrunk cell's flight bundle path, the
+    per-component minimality certificate, and the final
+    replay-from-JSON verdict."""
+    from . import observe
+    from .checkers import series_divergence_round
+
+    tel_spec = TM.TelemetrySpec(workload, rounds=tel_rounds)
+    base = run_sequential(workload, sc, runner_kw,
+                          max_recovery_rounds)
+    sig0 = failure_signature(base)
+    if sig0 is None:
+        raise ValueError(
+            "shrink_scenario needs a FAILING scenario (the batch "
+            "verdict said this one failed but the sequential rerun "
+            "passed — a batch/sequential divergence, which the parity "
+            "tests pin against)")
+    cur = sc
+    trail = []
+    iters = 0
+    progress = True
+    while progress and iters < max_iters:
+        progress = False
+        for desc, cand in _shrink_moves(cur):
+            iters += 1
+            if iters > max_iters:
+                break
+            res = run_sequential(workload, cand, runner_kw,
+                                 max_recovery_rounds)
+            if failure_signature(res) == sig0:
+                cur = cand
+                trail.append(desc)
+                progress = True
+                break
+    # the shrunk cell's own bundle (telemetry on, so the bundle
+    # carries the series the divergence checks diff against)
+    shrunk_res = run_sequential(workload, cur, runner_kw,
+                                max_recovery_rounds,
+                                telemetry=tel_spec,
+                                observe_dir=observe_dir)
+    if failure_signature(shrunk_res) != sig0:
+        raise AssertionError(
+            "shrunk scenario changed its failure under telemetry — "
+            "the observed drivers are pinned bit-exact, so this is a "
+            "recorder bug")
+    bundle_path = shrunk_res.get("flight_bundle")
+    bundle = observe.load_bundle(bundle_path)
+    # minimality: removing ANY retained component must make the
+    # failure vanish or visibly move the trajectory against the
+    # shrunk bundle's recorded series
+    minimality = []
+    for desc, cand in _components(cur):
+        res = run_sequential(workload, cand, runner_kw,
+                             max_recovery_rounds, telemetry=tel_spec)
+        changed = failure_signature(res) != sig0
+        div = None
+        series = (res.get("telemetry") or {}).get("series")
+        if bundle.get("telemetry_series") and series:
+            div = series_divergence_round(
+                bundle["telemetry_series"], series)
+        minimality.append({
+            "component": desc,
+            "load_bearing": bool(changed or div is not None),
+            "ok_after_removal": bool(res["ok"]),
+            "signature_changed": bool(changed),
+            "first_divergence_round": div,
+        })
+    # the repro contract: the shrunk bundle replays to the SAME
+    # failure from its JSON alone, with a faithful (divergence-free)
+    # record
+    replay = observe.replay_bundle(bundle_path)
+    replay_ok = (not replay["ok"]
+                 and failure_signature(replay) == sig0
+                 and replay.get("first_divergence_round") is None)
+    return {
+        "workload": workload,
+        "original": sc.to_meta(),
+        "shrunk": cur.to_meta(),
+        "weight_before": scenario_weight(sc),
+        "weight_after": scenario_weight(cur),
+        "signature": {k: (list(v) if isinstance(v, tuple) else v)
+                      for k, v in sig0.items()},
+        "moves_accepted": trail,
+        "n_candidate_runs": iters,
+        "bundle": bundle_path,
+        "minimality": minimality,
+        "all_components_load_bearing": all(
+            m["load_bearing"] for m in minimality),
+        "replay_same_failure": bool(replay_ok),
+    }
+
+
+# -- the fuzzer ----------------------------------------------------------
+
+
+def fuzz_run(workload: str = "broadcast", n_scenarios: int = 256, *,
+             n_nodes: int = 24, batch_size: int = 64,
+             horizon: int = 8, max_recovery_rounds: int = 32,
+             seed: int = 0, mesh=None, runner_kw: dict | None = None,
+             delay_axis: str = "alternate",
+             plant_failure: bool = False,
+             shrink: bool = True, max_shrinks: int | None = None,
+             observe_dir: str | None = None,
+             ) -> dict:
+    """The fault-space fuzzer (module docstring): sample
+    ``n_scenarios`` cells, certify them in ``batch_size``-scenario
+    compiled dispatches, emit a flight bundle + auto-shrunk minimal
+    repro for every failure.
+
+    ``delay_axis`` (broadcast): ``"alternate"`` — every other batch
+    samples per-edge delays (batches are homogeneous in the delay
+    dimension); ``"on"`` / ``"off"`` force it.  ``plant_failure``
+    prepends :func:`planted_failure` (a provably failing cell) —
+    the CI smoke's end-to-end shrink probe."""
+    if workload not in ("broadcast", "counter", "kafka"):
+        raise ValueError(f"unknown fuzz workload {workload!r}")
+    kw = dict(runner_kw or {})
+    if workload == "broadcast":
+        kw.setdefault("n_values", 2 * n_nodes)
+        kw.setdefault("topology", "grid")
+        kw.setdefault("sync_every", 4)
+        from ..parallel.topology import (grid, to_padded_neighbors,
+                                         tree)
+        nbrs_shape = to_padded_neighbors(
+            {"grid": grid, "tree": tree}[kw["topology"]](
+                n_nodes)).shape
+    else:
+        nbrs_shape = None
+
+    n_batches = (n_scenarios + batch_size - 1) // batch_size
+    t_sample = time.perf_counter()
+    batches = []
+    for b in range(n_batches):
+        count = min(batch_size, n_scenarios - b * batch_size)
+        delays_on = (workload == "broadcast"
+                     and {"alternate": b % 2 == 1,
+                          "on": True, "off": False}[delay_axis])
+        cells = sample_scenarios(
+            workload, count, n_nodes=n_nodes,
+            seed=seed * 1000 + b, horizon=horizon,
+            nbrs_shape=nbrs_shape, delay_axis=delays_on)
+        if plant_failure and b == 0:
+            cells[0] = planted_failure(workload, n_nodes, horizon)
+            if delays_on:
+                ones = tuple(tuple(1 for _ in range(nbrs_shape[1]))
+                             for _ in range(nbrs_shape[0]))
+                cells[0] = SC.Scenario(
+                    spec=cells[0].spec, parts=cells[0].parts,
+                    delays=ones,
+                    workload_seed=cells[0].workload_seed)
+        batches.append(SC.ScenarioBatch(
+            workload=workload, scenarios=tuple(cells),
+            runner_kw=kw, max_recovery_rounds=max_recovery_rounds))
+    sample_s = time.perf_counter() - t_sample
+
+    rows = []
+    failing = []
+    batch_walls = []
+    batch_shapes = []
+    t0 = time.perf_counter()
+    for b, batch in enumerate(batches):
+        tb = time.perf_counter()
+        res = SC.run_scenario_batch(batch, mesh=mesh)
+        wall = time.perf_counter() - tb
+        batch_walls.append(round(wall, 3))
+        # program-shape key: a batch with a new shape (scenario
+        # count, delays on/off, padded window counts) compiles fresh
+        # — the steady-state rate must exclude its compile
+        batch_shapes.append((
+            len(batch.scenarios),
+            any(sc.delays is not None for sc in batch.scenarios),
+            max(len(sc.spec.crash) for sc in batch.scenarios),
+            max((0 if sc.parts is None else len(sc.parts["starts"]))
+                for sc in batch.scenarios)))
+        for i, row in enumerate(res["scenarios"]):
+            row = dict(row)
+            row.pop("final", None)
+            row["batch"] = b
+            rows.append(row)
+            if not row["ok"]:
+                failing.append((b, i, batch.scenarios[i]))
+    dispatch_s = time.perf_counter() - t0
+
+    distinct = len({json.dumps(r["spec"], sort_keys=True)
+                    + json.dumps(r.get("parts"), sort_keys=True)
+                    + json.dumps(r.get("delays"), sort_keys=True)
+                    for r in rows})
+    shrinks = []
+    if shrink and failing:
+        tel_rounds = horizon + max_recovery_rounds
+        todo = (failing if max_shrinks is None
+                else failing[:max_shrinks])
+        for b, i, sc in todo:
+            shrinks.append(shrink_scenario(
+                workload, sc, kw, max_recovery_rounds,
+                observe_dir=observe_dir or "artifacts/fuzz",
+                tel_rounds=tel_rounds))
+    total_s = time.perf_counter() - t0
+    n_ok = sum(1 for r in rows if r["ok"])
+    # steady-state throughput over batches whose PROGRAM SHAPE already
+    # ran (compiled-program reuse — the first batch of each distinct
+    # shape pays its XLA compile and is excluded)
+    reused = [i for i in range(len(batches))
+              if batch_shapes[i] in batch_shapes[:i]]
+    steady = (round(sum(len(batches[i].scenarios) for i in reused)
+                    / max(1e-9, sum(batch_walls[i] for i in reused)),
+                    2) if reused else None)
+    return {
+        "workload": workload,
+        "n_scenarios": len(rows),
+        "n_distinct": distinct,
+        "n_certified_ok": n_ok,
+        "n_failing": len(failing),
+        "failing": [{"batch": b, "index": i,
+                     "scenario": sc.to_meta()}
+                    for b, i, sc in failing],
+        "n_batches": len(batches),
+        "batch_size": batch_size,
+        "batch_walls_s": batch_walls,
+        "sample_s": round(sample_s, 3),
+        "dispatch_s": round(dispatch_s, 3),
+        "total_s": round(total_s, 3),
+        "scenarios_per_sec": round(len(rows) / max(1e-9,
+                                                   dispatch_s), 2),
+        "scenarios_per_sec_steady": steady,
+        "shrinks": shrinks,
+        "rows": rows,
+    }

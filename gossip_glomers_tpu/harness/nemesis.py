@@ -154,6 +154,8 @@ def _no_traffic_provenance(provenance):
 def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                           topology: str = "grid", sync_every: int = 4,
                           parts: Partitions | None = None,
+                          delays=None,
+                          dir_delays=None,
                           max_recovery_rounds: int = 96,
                           mesh=None,
                           structured: "bool | str" = False,
@@ -207,6 +209,16 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
     if isinstance(parts, dict):
         # a replayed flight bundle carries the schedule as JSON
         parts = Partitions.from_meta(parts)
+    if delays is not None:
+        # per-edge delay matrix (PR 10: a scenario-axis fault
+        # dimension — the fuzzer's flight bundles carry it as nested
+        # lists, so a delayed-campaign failure replays from JSON)
+        delays = np.asarray(delays, np.int32)
+        if structured is True:
+            raise ValueError(
+                "per-edge delays ride the gather path; drop "
+                "structured= for a delayed campaign")
+        structured = False          # "auto" resolves to gather too
     if traffic is not None:
         from . import serving
         _no_traffic_provenance(provenance)
@@ -221,6 +233,18 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                 // 32) == "structured")
         sim_kw = dict(topology=topology, sync_every=sync_every,
                       structured=bool(structured))
+        if delays is not None:
+            # gather-path per-edge delays under open-loop traffic:
+            # forwarded as JSON-able lists so a serving flight bundle
+            # replays the DELAYED campaign (make_serving_sim coerces
+            # back to the (N, D) array)
+            sim_kw["delays"] = delays.tolist()
+        if dir_delays is not None:
+            # words-major delay-ring serving (PR 10, the item-1
+            # leftover): traffic injects into the structured delayed
+            # exchanges — make_serving_sim builds the bundle
+            sim_kw.update(structured=True,
+                          dir_delays=tuple(dir_delays))
         if n_values is not None:
             sim_kw["n_values"] = nv
         return serving.run_serving(
@@ -237,11 +261,18 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
         n_shards = (int(mesh.shape["nodes"])
                     if mesh is not None else None)
         kw = dict(exchange=S.make_exchange(topology, n),
-                  nemesis=S.make_nemesis(topology, n, spec,
-                                         groups=groups,
-                                         n_shards=n_shards))
+                  nemesis=S.make_nemesis(
+                      topology, n, spec, groups=groups,
+                      n_shards=n_shards,
+                      dir_delays=(None if dir_delays is None
+                                  else tuple(dir_delays))))
+    elif dir_delays is not None:
+        raise ValueError(
+            "dir_delays is the words-major delay-ring mode: pass "
+            "structured=True (per-edge gather delays ride delays=)")
     sim = BroadcastSim(_neighbors(topology, n), n_values=nv,
                        sync_every=sync_every, parts=parts,
+                       delays=delays,
                        fault_plan=spec.compile(), srv_ledger=False,
                        mesh=mesh, **kw)
     inject = make_inject(n, nv)
@@ -307,7 +338,11 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                      structured=bool(structured),
                      max_recovery_rounds=max_recovery_rounds,
                      parts=(None if parts is None
-                            else parts.to_meta()))
+                            else parts.to_meta()),
+                     delays=(None if delays is None
+                             else delays.tolist()),
+                     dir_delays=(None if dir_delays is None
+                                 else list(dir_delays)))
     ok = _finish_observed(
         ok, details, tel, tel_spec, msgs_total=int(state.msgs),
         observe_dir=observe_dir, workload="broadcast", spec=spec,

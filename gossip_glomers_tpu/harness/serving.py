@@ -66,15 +66,55 @@ def make_serving_sim(kind: str, tspec: "traffic.TrafficSpec", *,
         sync_every = sim_kw.pop("sync_every", 4)
         n_values = sim_kw.pop(
             "n_values", tspec.n_clients * tspec.ops_per_client)
+        # words-major delay-ring modes (PR 10, the ROADMAP item-1
+        # leftover): open-loop traffic runs through the structured
+        # delayed exchanges too — injection lands in received+frontier
+        # BEFORE the round pushes the payload into the history ring,
+        # so a mid-run client value floods with the direction's (or
+        # edge's) latency like any other bit.  `dir_delays` is the
+        # per-direction-class delay tuple (make_delayed fault-free,
+        # make_nemesis(dir_delays=) composed with a crash/loss spec);
+        # `edge_delay_rows` the (D, N) random per-edge delay rows
+        # (make_edge_delayed — fault-free only: the FaultPlan nemesis
+        # has no edge-delayed composition).
+        dir_delays = sim_kw.pop("dir_delays", None)
+        edge_delay_rows = sim_kw.pop("edge_delay_rows", None)
+        if sim_kw.get("delays") is not None:
+            # gather-path per-edge delays arrive as JSON-able nested
+            # lists (flight bundles, run_broadcast_nemesis(traffic=));
+            # BroadcastSim wants the (N, D) array
+            sim_kw["delays"] = np.asarray(sim_kw["delays"], np.int32)
+        if (dir_delays is not None or edge_delay_rows is not None) \
+                and not structured:
+            raise ValueError(
+                "dir_delays/edge_delay_rows are words-major "
+                "structured modes: pass structured=True (per-edge "
+                "gather delays ride run_broadcast_nemesis(delays=))")
         kw = dict(sync_every=sync_every, srv_ledger=False, mesh=mesh,
                   fault_plan=plan, **sim_kw)
         if structured:
             n_sh = (int(mesh.shape["nodes"]) if mesh is not None
                     else None)
             kw["exchange"] = S.make_exchange(topology, n)
-            if nemesis is not None:
-                kw["nemesis"] = S.make_nemesis(topology, n, nemesis,
-                                               n_shards=n_sh)
+            if edge_delay_rows is not None:
+                if nemesis is not None:
+                    raise ValueError(
+                        "edge-delayed structured serving has no "
+                        "FaultPlan composition (partition windows "
+                        "compose via make_edge_delayed_faulted); "
+                        "use dir_delays= for a faulted delayed run")
+                kw["edge_delayed"] = S.make_edge_delayed(
+                    topology, n,
+                    np.asarray(edge_delay_rows, np.int32),
+                    n_shards=n_sh)
+            elif nemesis is not None:
+                kw["nemesis"] = S.make_nemesis(
+                    topology, n, nemesis, n_shards=n_sh,
+                    dir_delays=(None if dir_delays is None
+                                else tuple(dir_delays)))
+            elif dir_delays is not None:
+                kw["delayed"] = S.make_delayed(
+                    topology, n, tuple(dir_delays), n_shards=n_sh)
             elif n_sh is not None:
                 kw["sharded_exchange"] = S.make_sharded_exchange(
                     topology, n, n_sh)

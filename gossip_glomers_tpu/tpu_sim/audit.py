@@ -378,9 +378,11 @@ def default_registry() -> list[ProgramContract]:
     stateful sim module owns its own ``audit_contracts()``; telemetry
     registers the observed-driver rows, PR 8; provenance the
     stamp-carrying rows, PR 9)."""
-    from . import broadcast, counter, kafka, provenance, telemetry
+    from . import (broadcast, counter, kafka, provenance, scenario,
+                   telemetry)
     out: list[ProgramContract] = []
-    for mod in (broadcast, counter, kafka, telemetry, provenance):
+    for mod in (broadcast, counter, kafka, telemetry, provenance,
+                scenario):
         out.extend(mod.audit_contracts())
     names = [c.name for c in out]
     if len(set(names)) != len(names):
@@ -452,22 +454,46 @@ def _provenance_roots() -> str:
             + ")$")
 
 
+def _scenario_roots() -> str:
+    # scenario.py declares its split the same way (PR 10; totality
+    # pinned by tests/test_scenario.py).  The batch runners' nested
+    # per-scenario bodies are traced via the _BUILDERS mechanism
+    # (run_*_batch below).
+    from . import scenario
+    return ("^(" + "|".join(re.escape(n)
+                            for n in scenario.TRACED_EVALUATORS)
+            + ")$")
+
+
+def _fuzz_roots() -> str:
+    # harness/fuzz.py is PURE HOST code and declares an EMPTY traced
+    # tuple (PR 10) — the pattern matches nothing, so the lint walks
+    # the file but claims no traced scope there; totality pinned by
+    # tests/test_scenario.py.
+    from ..harness import fuzz
+    return ("^(" + "|".join(re.escape(n)
+                            for n in fuzz.TRACED_EVALUATORS) + ")$")
+
+
 _TRACED_ROOTS: dict[str, str] = {
     "tpu_sim/broadcast.py":
         r"^(_round|flood_step$|_wm_round_single$|_sharded_round"
         r"|_live_rows$|_edge_live$|_popcount$|_flood_loop$"
         r"|_flood_ledger$|_traffic_inject$|_traffic_done$"
-        r"|_tel_series$|_traffic_tel$|_prov_attribute$)",
+        r"|_tel_series$|_traffic_tel$|_prov_attribute$"
+        r"|_batch_converged$)",
     "tpu_sim/counter.py":
         r"^(_round$|_reach$|_traffic_round$|_tel_series$"
-        r"|_prov_record$)",
+        r"|_prov_record$|_batch_converged$)",
     "tpu_sim/kafka.py":
         r"^(_round$|_rank_within_key$|_alloc$|_traffic_round$"
-        r"|_tel_series$|_prov_record$)",
+        r"|_tel_series$|_prov_record$|_batch_converged$)",
     "tpu_sim/faults.py": _faults_roots(),
     "tpu_sim/traffic.py": _traffic_roots(),
     "tpu_sim/telemetry.py": _telemetry_roots(),
     "tpu_sim/provenance.py": _provenance_roots(),
+    "tpu_sim/scenario.py": _scenario_roots(),
+    "harness/fuzz.py": _fuzz_roots(),
     "tpu_sim/engine.py":
         r"^(sharded_roll$|sharded_shift$|collectives$|fori_rounds$"
         r"|windows_fold$|scan_blocks$|scan_rounds$|while_converge$)",
@@ -476,9 +502,11 @@ _TRACED_ROOTS: dict[str, str] = {
 }
 
 # builder methods whose nested `def`s are traced program bodies
+# (run_\w+_batch: the scenario-axis batch runners, PR 10 — their
+# nested per-scenario closures become the vmapped program bodies)
 _BUILDERS = re.compile(
     r"^(_build_\w+|_step_prog|_run_prog|run_rounds|build_fixed"
-    r"|poll_batch_program|alloc_offsets)$")
+    r"|poll_batch_program|alloc_offsets|run_\w+_batch)$")
 # structured.py's exchange/diff/nemesis factories — its make_* arm is
 # scoped to THAT file only: host-side make_* factories elsewhere
 # (harness staging, wire helpers) may nest closures that legitimately

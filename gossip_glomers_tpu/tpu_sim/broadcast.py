@@ -2927,6 +2927,45 @@ class BroadcastSim:
         return out
 
 
+# -- scenario-axis batch hooks (PR 10, tpu_sim/scenario.py) --------------
+
+
+def _build_batch_round(nbrs, nbr_mask, *, sync_every: int,
+                       dup_on: bool, delay_set: tuple = ()):
+    """Per-scenario round closure for the scenario-axis batch drivers:
+    the gather-path :func:`_round` over SHARED adjacency with the
+    scenario's OWN ``(plan, parts[, delays])`` traced operands —
+    ``engine.scenario_program`` vmaps it over the leading scenario
+    axis, so each scenario evaluates exactly its own padded fault
+    data.  ``delay_set`` is the batch-wide static union of per-edge
+    delay values (empty = 1-hop); ``dup_on`` is the batch-wide static
+    dup switch (a scenario with ``dup_num == 0`` draws coins that
+    never fire — bit-identical to a dup-off program)."""
+    row_ids = jnp.arange(nbrs.shape[0], dtype=jnp.int32)
+
+    if delay_set:
+        def rnd_d(state, plan, parts, delays):
+            return _round(state, row_ids=row_ids, nbrs=nbrs,
+                          nbr_mask=nbr_mask, parts=parts,
+                          sync_every=sync_every, delays=delays,
+                          delay_set=delay_set, plan=plan,
+                          dup_on=dup_on)
+        return rnd_d
+
+    def rnd(state, plan, parts):
+        return _round(state, row_ids=row_ids, nbrs=nbrs,
+                      nbr_mask=nbr_mask, parts=parts,
+                      sync_every=sync_every, plan=plan, dup_on=dup_on)
+    return rnd
+
+
+def _batch_converged(state: BroadcastState, target) -> jnp.ndarray:
+    """() bool, traced — one scenario's convergence predicate (every
+    node holds every target bit; node-major layout, the batch drivers
+    run the gather path)."""
+    return jnp.all(state.received == target[None, :])
+
+
 # -- program contracts (tpu_sim/audit.py registry) -----------------------
 
 

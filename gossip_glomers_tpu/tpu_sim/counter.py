@@ -887,6 +887,29 @@ class CounterSim:
         return prog, args_fn(self.init_state(), jnp.int32(rounds))
 
 
+# -- scenario-axis batch hooks (PR 10, tpu_sim/scenario.py) --------------
+
+
+def _build_batch_round(sim: "CounterSim"):
+    """Per-scenario round closure for the scenario-axis batch drivers:
+    the sim's own :meth:`CounterSim._round` with identity collectives
+    (each scenario's node axis is fully local under scenario sharding)
+    and the scenario's OWN plan as the traced operand."""
+    coll = collectives(sim.n_nodes)
+
+    def rnd(state, plan):
+        return sim._round(state, coll, sim.kv_sched, plan)
+    return rnd
+
+
+def _batch_converged(state: CounterState) -> jnp.ndarray:
+    """() bool, traced — one scenario's convergence predicate: pending
+    fully drained AND every node's cached read equals the KV (the
+    traced twin of run_counter_nemesis's host check)."""
+    return ((jnp.sum(state.pending) == 0)
+            & jnp.all(state.cached == state.kv))
+
+
 # -- program contracts (tpu_sim/audit.py registry) -----------------------
 
 

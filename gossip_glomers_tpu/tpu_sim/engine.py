@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 # -- shard_map entry-point compat ---------------------------------------
 
@@ -521,6 +522,64 @@ def stepwise_converge(step: Callable, converged: Callable, state,
         if converged(state):
             break
     return state, rounds
+
+
+# -- scenario-axis batching (PR 10) --------------------------------------
+
+
+def scenario_placement(n_scenarios: int, mesh=None,
+                       axis: str = "nodes") -> str:
+    """Where the SCENARIO axis of a batched fault campaign lives
+    (tpu_sim/scenario.py):
+
+    - ``"scenario"``: the scenario axis is sharded over the mesh's
+      device axis — each device runs ``S / n_devices`` whole scenarios
+      with identity collectives (the node axis is fully local per
+      scenario), so the batched program contains ZERO collectives.
+      Picked whenever a mesh is present and S divides evenly with at
+      least one scenario per device (S >= devices).
+    - ``"single"``: no mesh (or S < devices / uneven) — the vmapped
+      program runs undevided on one device.  Callers that want mesh
+      placement for a small or uneven batch pad S up to a multiple of
+      the device count with inert filler scenarios
+      (scenario.pad_batch) rather than sharding the node axis: a
+      fuzzer's unit of work is the scenario, and padding keeps the
+      single zero-collective program shape.
+    """
+    if mesh is None:
+        return "single"
+    n_sh = int(mesh.shape[axis])
+    if n_scenarios >= n_sh and n_scenarios % n_sh == 0:
+        return "scenario"
+    return "single"
+
+
+def scenario_program(per_scenario_fn: Callable, example_args: tuple,
+                     *, mesh=None, axis: str = "nodes",
+                     donate_argnums=()) -> Callable:
+    """ONE compiled program over a whole scenario batch: ``jax.vmap``
+    of the per-scenario body over every argument's leading axis,
+    scenario-sharded over the mesh when :func:`scenario_placement`
+    says so (shard_map with ``P(axis)`` on every leading axis — the
+    body keeps identity collectives, so the compiled batch program has
+    no collectives at all; cap-0 census rows pin that,
+    tpu_sim/scenario.py ``audit_contracts``).  ``example_args`` fixes
+    the in/out pytree structure (shard_map needs per-leaf specs);
+    ``donate_argnums`` follows :func:`jit_program`'s contract — donate
+    the stacked state carry, never the plan operands."""
+    batched = jax.vmap(per_scenario_fn)
+    n_scenarios = jax.tree_util.tree_leaves(
+        example_args[0])[0].shape[0]
+    if scenario_placement(n_scenarios, mesh, axis) == "single":
+        return jax.jit(batched, donate_argnums=donate_argnums)
+    lead = lambda tree: jax.tree_util.tree_map(         # noqa: E731
+        lambda _leaf: P(axis), tree)
+    in_specs = tuple(lead(a) for a in example_args)
+    out_shape = jax.eval_shape(batched, *example_args)
+    out_specs = lead(out_shape)
+    return jit_program(batched, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False,
+                       donate_argnums=donate_argnums)
 
 
 # -- program accounting -------------------------------------------------

@@ -62,7 +62,7 @@ TRACED_EVALUATORS = (
 HOST_SIDE = (
     "plan_specs", "wm_specs", "_rate_to_num", "random_spec",
     "crash_down_rows", "_mix32_np", "host_node_up", "host_edge_drop",
-    "host_kv_ok")
+    "host_kv_ok", "pad_plan", "batch_plans")
 
 # distinct stream salts: loss and dup draw independent coins from the
 # same (seed, t, src, dst) counter
@@ -257,6 +257,64 @@ def random_spec(n_nodes: int, *, seed: int, horizon: int,
         n_nodes=n_nodes, seed=seed, crash=tuple(windows),
         loss_rate=loss_rate, loss_until=horizon if loss_rate else None,
         dup_rate=dup_rate, dup_until=horizon if dup_rate else None)
+
+
+# -- scenario-axis batching (PR 10) --------------------------------------
+#
+# The scenario-axis fuzzer (tpu_sim/scenario.py) runs S independent
+# NemesisSpecs as ONE compiled program: the per-scenario FaultPlans are
+# PADDED to a common crash-window count and STACKED leaf-by-leaf into a
+# batched plan with a leading scenario axis, which `jax.vmap` then
+# slices back into ordinary (C,)/(C, N)/() leaves per scenario.
+#
+# Padding semantics: a pad window is ``[0, 0)`` with an all-False down
+# row — ``starts[w] <= t < ends[w]`` is unsatisfiable at every t, so
+# windows_fold treats it as never-active and a padded plan is
+# BIT-IDENTICAL to its unpadded original (pinned by
+# tests/test_scenario.py).  All specs in a batch must share n_nodes
+# (one compiled shape); rates/seeds stack into (S,) scalars.
+
+
+def pad_plan(plan: FaultPlan, n_windows: int) -> FaultPlan:
+    """Pad a compiled plan's crash-window axis to ``n_windows`` with
+    never-active ``[0, 0)`` windows (see above).  Evaluation is
+    bit-identical — the pad windows fold as inactive at every round."""
+    c = int(plan.starts.shape[0])
+    if c > n_windows:
+        raise ValueError(
+            f"plan has {c} crash windows, cannot pad to {n_windows}")
+    if c == n_windows:
+        return plan
+    pad = n_windows - c
+    n = int(plan.down.shape[1]) if plan.down.ndim == 2 else 0
+    return plan._replace(
+        starts=jnp.concatenate(
+            [plan.starts, jnp.zeros((pad,), jnp.int32)]),
+        ends=jnp.concatenate(
+            [plan.ends, jnp.zeros((pad,), jnp.int32)]),
+        down=jnp.concatenate(
+            [plan.down, jnp.zeros((pad, n), bool)], axis=0))
+
+
+def batch_plans(specs) -> FaultPlan:
+    """Compile + pad + stack a sequence of :class:`NemesisSpec`s into
+    ONE batched :class:`FaultPlan` with a leading scenario axis:
+    ``starts/ends (S, C)``, ``down (S, C, N)``, scalars ``(S,)``.
+    The scenario drivers vmap over the leading axis, so each scenario
+    evaluates exactly its own (padded) plan."""
+    specs = list(specs)
+    if not specs:
+        raise ValueError("batch_plans needs at least one spec")
+    n = specs[0].n_nodes
+    for sp in specs:
+        if sp.n_nodes != n:
+            raise ValueError(
+                f"scenario batch mixes n_nodes {n} and {sp.n_nodes} "
+                "(one compiled shape per batch)")
+    c_max = max(len(sp.crash) for sp in specs)
+    plans = [pad_plan(sp.compile(), c_max) for sp in specs]
+    return FaultPlan(*(jnp.stack([p[i] for p in plans])
+                       for i in range(len(FaultPlan._fields))))
 
 
 # -- device-side mask evaluation ----------------------------------------

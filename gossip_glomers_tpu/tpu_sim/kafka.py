@@ -1566,6 +1566,35 @@ class KafkaSim:
         return {k: int(c[k]) for k in range(self.n_keys) if c[k] > 0}
 
 
+# -- scenario-axis batch hooks (PR 10, tpu_sim/scenario.py) --------------
+
+
+def _build_batch_round(sim: "KafkaSim"):
+    """Per-scenario round closure for the scenario-axis batch drivers:
+    the sim's own :meth:`KafkaSim._round` on the FAULTED origin-union
+    path with identity collectives (each scenario's node axis is fully
+    local under scenario sharding), the scenario's OWN plan + staged
+    send batch as traced operands, and the commit-free all--1
+    commit_req built inside the trace (the run_rounds commit-free
+    convention — XLA dead-codes the commit pipeline)."""
+    coll = collectives(sim.n_nodes)
+    k_dim = sim.n_keys
+
+    def rnd(state, plan, send_key, send_val):
+        cr = jnp.full((send_key.shape[0], k_dim), -1, jnp.int32)
+        return sim._round(state, send_key, send_val, cr, None,
+                          sim.kv_sched, coll, repl_mode="union_nem",
+                          plan=plan)
+    return rnd
+
+
+def _batch_converged(state: KafkaState) -> jnp.ndarray:
+    """() bool, traced — one scenario's convergence predicate: every
+    node's presence bitset identical (the traced twin of
+    run_kafka_nemesis's host check)."""
+    return jnp.all(state.present == state.present[:1])
+
+
 # -- program contracts (tpu_sim/audit.py registry) -----------------------
 
 

@@ -1,0 +1,899 @@
+"""Scenario-axis fault-space batching (PR 10): S independent nemesis
+campaigns as ONE compiled program.
+
+Every nemesis artifact in this repo is seed-deterministic, stateless-
+hash-driven, and JSON-able (faults.NemesisSpec -> FaultPlan; the
+loss/dup coins are pure (t, src, dst) hashes), and nothing in a faulted
+round depends on host control flow — so a whole *batch* of fault
+campaigns vmaps: the per-scenario FaultPlans (and partition schedules,
+and per-edge delay matrices) are padded to common window counts and
+STACKED leaf-by-leaf with a leading scenario axis (faults.batch_plans /
+:func:`batch_partitions`), and ``jax.vmap`` of the ordinary gather-path
+round slices them back into per-scenario operands.  One dispatch then
+runs hundreds of crash x loss x dup x partition x delay campaigns —
+the scenario-diversity multiplier no process-per-node harness
+(Maelstrom included) can imitate: coverage goes from "27 cells" to
+"the fault space" (benchmarks/fault_sweep.py ``--fuzz``,
+harness/fuzz.py).
+
+**Placement** (engine.scenario_placement): with a mesh and S a
+multiple of the device count, the SCENARIO axis is sharded over the
+mesh — each device runs S/devices whole scenarios with identity
+collectives, so the compiled batch program contains ZERO collective
+ops (cap-0 census rows in :func:`audit_contracts`).  Smaller or uneven
+batches pad up with inert filler scenarios (:func:`pad_batch`) rather
+than shard the node axis: the fuzzer's unit of work is the scenario.
+
+**Certification without host round-trips**: the per-scenario driver
+(:func:`certify_loop`) is a check-then-step ``fori_loop`` that records
+each scenario's FIRST converged round on device and then FREEZES the
+scenario (a per-scenario ``where`` select), reproducing the sequential
+``run_*_nemesis`` loop — which stops stepping at convergence —
+BIT-EXACTLY: final state, msgs ledgers, converged rounds, and (when a
+ring rides the carry) the telemetry series all match the
+single-scenario runners (tests/test_scenario.py, single-device and
+8-way mesh).  The batched outputs are tiny per-scenario rows
+(converged round, msgs at clear, final ledger) plus the stacked final
+states — ONE host transfer after the dispatch, nothing per scenario
+in the hot loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import broadcast as B
+from . import counter as CT
+from . import faults, kafka as KF, telemetry
+from .engine import scenario_placement, scenario_program
+
+# The module's host/device split, DECLARED (the PR-6 faults.py
+# pattern): the determinism lint (tpu_sim/audit.py) treats exactly
+# TRACED_EVALUATORS as traced scope; tests/test_scenario.py pins the
+# split TOTAL.  `_build_batch_program`'s nested defs are traced via
+# the builder mechanism (audit._BUILDERS).
+TRACED_EVALUATORS = ("certify_loop",)
+HOST_SIDE = (
+    "batch_partitions", "pad_batch", "stack_pytrees", "stage_kafka_batch",
+    "run_broadcast_batch", "run_counter_batch", "run_kafka_batch",
+    "run_scenario_batch", "batch_state_bytes", "audit_contracts",
+    "_build_batch_program", "_place", "_verdict_rows",
+    "_audit_program")
+
+
+# -- scenario cases ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One cell of the fault space — JSON-able, seed-deterministic.
+
+    ``spec`` is the crash/loss/dup nemesis; ``parts`` an optional
+    partition-schedule meta dict (broadcast only, the
+    ``Partitions.to_meta`` shape); ``delays`` an optional (N, D)
+    per-edge delay matrix as nested lists (broadcast gather path
+    only); ``workload_seed`` seeds the kafka send staging."""
+
+    spec: faults.NemesisSpec
+    parts: dict | None = None
+    delays: tuple | None = None
+    workload_seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.delays is not None:
+            object.__setattr__(
+                self, "delays",
+                tuple(tuple(int(v) for v in row)
+                      for row in self.delays))
+
+    def to_meta(self) -> dict:
+        return {"spec": self.spec.to_meta(), "parts": self.parts,
+                "delays": (None if self.delays is None
+                           else [list(r) for r in self.delays]),
+                "workload_seed": self.workload_seed}
+
+    @staticmethod
+    def from_meta(meta: dict) -> "Scenario":
+        return Scenario(
+            spec=faults.NemesisSpec.from_meta(meta["spec"]),
+            parts=meta.get("parts"),
+            delays=(None if meta.get("delays") is None
+                    else tuple(tuple(r) for r in meta["delays"])),
+            workload_seed=int(meta.get("workload_seed", 0)))
+
+
+@dataclass(frozen=True)
+class ScenarioBatch:
+    """S scenarios + the static run shape they share — JSON-able
+    (:meth:`to_meta`), dispatched by :func:`run_scenario_batch`.
+    ``runner_kw`` holds the per-workload static knobs (broadcast:
+    ``n_values``/``topology``/``sync_every``; counter: ``mode``/
+    ``poll_every``; kafka: ``n_keys``/``capacity``/``max_sends``/
+    ``resync_every``/``rounds``/``send_prob``)."""
+
+    workload: str
+    scenarios: tuple = field(default_factory=tuple)
+    runner_kw: dict = field(default_factory=dict)
+    max_recovery_rounds: int = 64
+
+    def __post_init__(self) -> None:
+        if self.workload not in ("broadcast", "counter", "kafka"):
+            raise ValueError(
+                f"unknown scenario workload {self.workload!r}")
+        if not self.scenarios:
+            raise ValueError("a ScenarioBatch needs >= 1 scenario")
+        object.__setattr__(self, "scenarios", tuple(
+            sc if isinstance(sc, Scenario) else Scenario(spec=sc)
+            for sc in self.scenarios))
+        n = self.scenarios[0].spec.n_nodes
+        for sc in self.scenarios:
+            if sc.spec.n_nodes != n:
+                raise ValueError(
+                    "scenario batch mixes node counts "
+                    f"{n} and {sc.spec.n_nodes}")
+
+    @property
+    def n_nodes(self) -> int:
+        return self.scenarios[0].spec.n_nodes
+
+    def to_meta(self) -> dict:
+        return {"workload": self.workload,
+                "scenarios": [sc.to_meta() for sc in self.scenarios],
+                "runner_kw": dict(self.runner_kw),
+                "max_recovery_rounds": self.max_recovery_rounds}
+
+    @staticmethod
+    def from_meta(meta: dict) -> "ScenarioBatch":
+        return ScenarioBatch(
+            workload=str(meta["workload"]),
+            scenarios=tuple(Scenario.from_meta(m)
+                            for m in meta["scenarios"]),
+            runner_kw=dict(meta.get("runner_kw", {})),
+            max_recovery_rounds=int(meta.get("max_recovery_rounds",
+                                             64)))
+
+
+def pad_batch(batch: ScenarioBatch, multiple: int) -> tuple:
+    """(padded batch, n_real): pad the scenario list up to a multiple
+    of ``multiple`` with inert fault-free filler scenarios (zero-rate,
+    windowless — they converge immediately and are dropped from the
+    results), so a mesh can always take scenario placement
+    (engine.scenario_placement)."""
+    s = len(batch.scenarios)
+    if multiple <= 1 or s % multiple == 0:
+        return batch, s
+    pad = multiple - s % multiple
+    filler = Scenario(spec=faults.NemesisSpec(n_nodes=batch.n_nodes))
+    has_delays = any(sc.delays is not None for sc in batch.scenarios)
+    if has_delays:
+        d0 = next(sc.delays for sc in batch.scenarios
+                  if sc.delays is not None)
+        ones = tuple(tuple(1 for _ in row) for row in d0)
+        filler = Scenario(spec=filler.spec, delays=ones)
+    return ScenarioBatch(
+        workload=batch.workload,
+        scenarios=batch.scenarios + (filler,) * pad,
+        runner_kw=batch.runner_kw,
+        max_recovery_rounds=batch.max_recovery_rounds), s
+
+
+# -- batched operands ----------------------------------------------------
+
+
+def batch_partitions(metas, n_nodes: int) -> B.Partitions:
+    """Pad + stack per-scenario partition schedules (None = no
+    windows) into one batched :class:`~.broadcast.Partitions` with a
+    leading scenario axis.  Pad windows are never-active ``[0, 0)``
+    with an all-zero group row — the same padding semantics as
+    faults.pad_plan (bit-identical evaluation)."""
+    parts = [B.Partitions.none(n_nodes) if m is None
+             else B.Partitions.from_meta(m) for m in metas]
+    p_max = max(int(p.starts.shape[0]) for p in parts)
+    if p_max == 0:
+        z = jnp.zeros((len(parts), 0), jnp.int32)
+        return B.Partitions(z, z, jnp.zeros(
+            (len(parts), 0, n_nodes), jnp.int8))
+
+    def pad(p: B.Partitions) -> B.Partitions:
+        c = int(p.starts.shape[0])
+        if c == p_max:
+            return p
+        extra = p_max - c
+        return B.Partitions(
+            jnp.concatenate([p.starts,
+                             jnp.zeros((extra,), jnp.int32)]),
+            jnp.concatenate([p.ends, jnp.zeros((extra,), jnp.int32)]),
+            jnp.concatenate([p.group, jnp.zeros((extra, n_nodes),
+                                                jnp.int8)], axis=0))
+
+    parts = [pad(p) for p in parts]
+    return B.Partitions(*(jnp.stack([p[i] for p in parts])
+                          for i in range(3)))
+
+
+def stack_pytrees(trees):
+    """Stack a list of identically-structured pytrees leaf-by-leaf
+    along a new leading scenario axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def stage_kafka_batch(batch: ScenarioBatch, rounds: int, *,
+                      n_keys: int, max_sends: int,
+                      send_prob: float) -> tuple:
+    """(S, R, N, Smax) send batches for a kafka scenario batch —
+    per scenario EXACTLY the vectorized commit-free staging of
+    harness.nemesis.stage_kafka_ops (same rng call order, so the
+    sequential runner replays the identical campaign), padded with -1
+    no-op rounds from the scenario's own clear round to the common
+    horizon ``rounds`` (a padded round stages nothing — the same
+    empty batch the sequential recovery loop drives)."""
+    from ..harness.nemesis import stage_kafka_ops
+
+    sks_all, svs_all = [], []
+    for sc in batch.scenarios:
+        r_s = max(sc.spec.clear_round,
+                  int(batch.runner_kw.get("rounds") or 0))
+        sks, svs, _crs = stage_kafka_ops(
+            sc.spec, r_s, n_keys=n_keys, max_sends=max_sends,
+            send_prob=send_prob, workload_seed=sc.workload_seed,
+            commits=False)
+        if r_s < rounds:
+            pad = rounds - r_s
+            n = sc.spec.n_nodes
+            sks = np.concatenate(
+                [sks, np.full((pad, n, max_sends), -1, np.int32)])
+            svs = np.concatenate(
+                [svs, np.zeros((pad, n, max_sends), np.int32)])
+        sks_all.append(sks)
+        svs_all.append(svs)
+    return (jnp.asarray(np.stack(sks_all)),
+            jnp.asarray(np.stack(svs_all)))
+
+
+# -- the per-scenario certification driver (traced) ----------------------
+
+
+def certify_loop(step1, conv, state, clear, max_rec: int,
+                 r_total: int, tel=None, tel_row=None, tel_mask=None):
+    """ONE scenario's whole campaign as a fixed-trip ``fori_loop``
+    (traced; vmapped over the scenario axis by the batch programs):
+
+    - before each round, if the scenario is past its own clear round
+      and not yet converged, test convergence and record the FIRST
+      converged round (`conv_round`; -1 = never within bound);
+    - record ``msgs`` when ``t == clear`` (the faulted-phase ledger
+      check_recovery's degraded-throughput ratio needs);
+    - step only while ACTIVE (not converged, not past
+      ``clear + max_rec``) — a frozen scenario carries its final state
+      unchanged, which is exactly where the sequential
+      ``run_*_nemesis`` loop stops stepping, so the batched final
+      state is bit-identical to the sequential one;
+    - with a telemetry ring (``tel``), record each ACTIVE round's row
+      (``tel_row(s0, s1)``) — frozen scenarios stop recording, like
+      the sequential observed drivers stop stepping.
+
+    Returns ``(state, conv_round, msgs_at_clear[, tel])``.
+    """
+    bound = clear + jnp.int32(max_rec)
+
+    def check(st, cr):
+        done_now = (st.t >= clear) & (cr < 0) & conv(st)
+        return jnp.where(done_now, st.t, cr)
+
+    def freeze(active, new, old):
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(active, a, b), new, old)
+
+    if tel is None:
+        def body(i, carry):
+            st, cr, mc = carry
+            cr = check(st, cr)
+            mc = jnp.where(st.t == clear, st.msgs, mc)
+            active = (cr < 0) & (st.t < bound)
+            st = freeze(active, step1(st, i), st)
+            return (st, cr, mc)
+
+        st, cr, mc = lax.fori_loop(
+            0, r_total, body, (state, jnp.int32(-1), jnp.uint32(0)))
+        return st, check(st, cr), mc
+
+    def body_tel(i, carry):
+        st, cr, mc, tl = carry
+        cr = check(st, cr)
+        mc = jnp.where(st.t == clear, st.msgs, mc)
+        active = (cr < 0) & (st.t < bound)
+        s2 = step1(st, i)
+        tl = freeze(active,
+                    telemetry.record(tl, st.t, tel_row(st, s2),
+                                     tel_mask), tl)
+        st = freeze(active, s2, st)
+        return (st, cr, mc, tl)
+
+    st, cr, mc, tl = lax.fori_loop(
+        0, r_total, body_tel,
+        (state, jnp.int32(-1), jnp.uint32(0), tel))
+    return st, check(st, cr), mc, tl
+
+
+# -- batch program construction ------------------------------------------
+
+# compiled batch programs, keyed by the full static shape (workload,
+# scenario count, state shapes, trip count, telemetry spec, mesh)
+_PROGS: dict = {}
+
+
+def _place(args, mesh):
+    """Device-put every batched operand with its scenario sharding
+    (leading axis over the mesh's device axis) when scenario placement
+    applies; no-op off mesh.  (Donation is the program's concern —
+    _build_batch_program's donate_argnums.)"""
+    s = jax.tree_util.tree_leaves(args[0])[0].shape[0]
+    if scenario_placement(s, mesh) == "single":
+        return args
+    sh = NamedSharding(mesh, P("nodes"))
+    return tuple(
+        jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), a)
+        for a in args)
+
+
+def _build_batch_program(workload: str, per_scenario, example_args,
+                         mesh, donate_argnums, key):
+    """Build (or fetch) the ONE compiled program of a batch shape:
+    ``jax.vmap`` of the per-scenario certify driver, scenario-sharded
+    via engine.scenario_program.  Cached so a fuzz sweep reuses one
+    compiled program across every batch of the same shape."""
+    full_key = (workload, key, id(mesh),
+                jax.tree_util.tree_structure(example_args),
+                tuple((tuple(leaf.shape), str(leaf.dtype))
+                      for leaf in
+                      jax.tree_util.tree_leaves(example_args)))
+    if full_key not in _PROGS:
+        _PROGS[full_key] = scenario_program(
+            per_scenario, example_args, mesh=mesh,
+            donate_argnums=donate_argnums)
+    return _PROGS[full_key]
+
+
+def _verdict_rows(batch: ScenarioBatch, conv_round, msgs_clear,
+                  msgs_final, lost_lists, extra=None) -> dict:
+    """Assemble the batch result: per-scenario verdict rows via the
+    batched recovery certifier (checkers.check_recovery_batch — a
+    single planted bad scenario fails loudly and names its index)."""
+    from ..harness.checkers import check_recovery_batch
+
+    clears = np.array([sc.spec.clear_round
+                       for sc in batch.scenarios], np.int64)
+    ok, det = check_recovery_batch(
+        clear_rounds=clears,
+        converged_rounds=np.asarray(conv_round, np.int64),
+        max_recovery_rounds=batch.max_recovery_rounds,
+        lost_writes=lost_lists,
+        msgs_at_clear=np.asarray(msgs_clear, np.int64),
+        msgs_at_converged=np.asarray(msgs_final, np.int64))
+    rows = []
+    for i, sc in enumerate(batch.scenarios):
+        row = dict(det["scenarios"][i])
+        row.update(workload=batch.workload, scenario=i,
+                   spec=sc.spec.to_meta(),
+                   msgs_total=int(np.asarray(msgs_final)[i]))
+        if sc.parts is not None:
+            row["parts"] = sc.parts
+        if sc.delays is not None:
+            row["delays"] = [list(r) for r in sc.delays]
+        if extra is not None:
+            row.update(extra[i])
+        rows.append(row)
+    return {"ok": ok, "workload": batch.workload,
+            "n_scenarios": len(rows),
+            "failing": det["failing"], "scenarios": rows}
+
+
+# -- per-workload batch drivers ------------------------------------------
+
+
+def run_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
+                        telemetry_spec=None) -> dict:
+    """S broadcast campaigns in ONE dispatch: values injected
+    round-robin at round 0, per-scenario convergence = every node
+    holds every value, lost acked writes = values absent from every
+    node at the scenario's own stop round.  The fault space per
+    scenario: crash/loss/dup (``spec``) x partition windows
+    (``parts``) x per-edge delays (``delays`` — static delay classes,
+    the history-ring gather path).  Returns the batch verdict dict
+    (see :func:`_verdict_rows`) plus per-scenario telemetry series
+    when ``telemetry_spec`` rides along."""
+    kw = batch.runner_kw
+    n = batch.n_nodes
+    nv = int(kw.get("n_values") or 2 * n)
+    topology = kw.get("topology", "grid")
+    sync_every = int(kw.get("sync_every", 4))
+    from ..parallel.topology import grid, to_padded_neighbors, tree
+    nbrs_np = to_padded_neighbors(
+        {"grid": grid, "tree": tree}[topology](n))
+    nbrs = jnp.asarray(nbrs_np, jnp.int32)
+    nbr_mask = jnp.asarray(nbrs_np >= 0)
+
+    scs = batch.scenarios
+    s_count = len(scs)
+    dup_on = any(sc.spec.dup_rate > 0 for sc in scs)
+    has_delays = any(sc.delays is not None for sc in scs)
+    if has_delays:
+        dmats = []
+        for sc in scs:
+            d = (np.asarray(sc.delays, np.int32)
+                 if sc.delays is not None
+                 else np.ones(nbrs_np.shape, np.int32))
+            if d.shape != nbrs_np.shape:
+                raise ValueError(
+                    f"scenario delays shape {d.shape} != adjacency "
+                    f"{nbrs_np.shape}")
+            dmats.append(np.where(nbrs_np >= 0, d, 1))
+        delay_set = tuple(int(v) for v in
+                          np.unique(np.stack(dmats)))
+        delays_b = jnp.asarray(np.stack(dmats))
+        ring = max(delay_set)
+    else:
+        delay_set, delays_b, ring = (), None, 0
+
+    plans = faults.batch_plans([sc.spec for sc in scs])
+    parts_b = batch_partitions([sc.parts for sc in scs], n)
+    clears = jnp.asarray(
+        np.array([sc.spec.clear_round for sc in scs], np.int32))
+    max_clear = int(np.max(np.asarray(clears)))
+    r_total = max_clear + batch.max_recovery_rounds
+
+    inject = B.make_inject(n, nv)
+    target = jnp.asarray(np.bitwise_or.reduce(
+        inject.astype(np.uint32), axis=0))
+    targets = jnp.broadcast_to(target, (s_count,) + target.shape)
+
+    def one_state():
+        rec = jnp.asarray(inject.astype(np.uint32))
+        hist = (jnp.zeros((ring, n, B.num_words(nv)), jnp.uint32)
+                if has_delays else None)
+        return B.BroadcastState(received=rec, frontier=jnp.copy(rec),
+                                t=jnp.int32(0), msgs=jnp.uint32(0),
+                                history=hist, srv_msgs=None)
+
+    states = stack_pytrees([one_state() for _ in range(s_count)])
+    rnd = B._build_batch_round(nbrs, nbr_mask, sync_every=sync_every,
+                               dup_on=dup_on, delay_set=delay_set)
+    tl = telemetry_spec is not None
+    tel_mask = telemetry_spec.static_mask if tl else None
+    sim = (B.BroadcastSim(nbrs_np, n_values=nv, sync_every=sync_every,
+                          srv_ledger=False) if tl else None)
+
+    if has_delays:
+        def one(state, plan, parts, delays, clear, target, *tel_a):
+            step1 = lambda st, i: rnd(st, plan, parts,  # noqa: E731
+                                      delays)
+            conv = lambda st: B._batch_converged(st,   # noqa: E731
+                                                 target)
+            row = ((lambda s0, s1: sim._tel_series(
+                s0, s1, plan, lambda x: x)) if tl else None)
+            return certify_loop(step1, conv, state, clear,
+                                batch.max_recovery_rounds, r_total,
+                                tel_a[0] if tl else None, row,
+                                tel_mask)
+
+        args = [states, plans, parts_b, delays_b, clears, targets]
+    else:
+        def one(state, plan, parts, clear, target, *tel_a):
+            step1 = lambda st, i: rnd(st, plan, parts)  # noqa: E731
+            conv = lambda st: B._batch_converged(st,   # noqa: E731
+                                                 target)
+            row = ((lambda s0, s1: sim._tel_series(
+                s0, s1, plan, lambda x: x)) if tl else None)
+            return certify_loop(step1, conv, state, clear,
+                                batch.max_recovery_rounds, r_total,
+                                tel_a[0] if tl else None, row,
+                                tel_mask)
+
+        args = [states, plans, parts_b, clears, targets]
+    dn = (0,) + ((len(args),) if tl else ())
+    if tl:
+        args.append(stack_pytrees(
+            [telemetry.init_state(telemetry_spec)
+             for _ in range(s_count)]))
+    args = _place(tuple(args), mesh)
+    prog = _build_batch_program(
+        "broadcast", one, args, mesh, dn,
+        key=(n, nv, topology, sync_every, s_count, r_total, dup_on,
+             delay_set, int(plans.starts.shape[1]),
+             int(parts_b.starts.shape[1]), telemetry_spec))
+    out = prog(*args)
+    final, conv_round, msgs_clear = out[0], out[1], out[2]
+    rec = np.asarray(final.received)                  # (S, N, W)
+    anywhere = np.bitwise_or.reduce(rec, axis=1)      # (S, W)
+    lost_lists = [
+        [v for v in range(nv)
+         if not (anywhere[i, v // 32] >> (v % 32)) & 1]
+        for i in range(s_count)]
+    res = _verdict_rows(batch, conv_round, msgs_clear,
+                        np.asarray(final.msgs), lost_lists)
+    res.update(n_nodes=n, n_values=nv, topology=topology,
+               final=final)
+    if tl:
+        res["telemetry"] = [
+            telemetry.series_arrays(
+                jax.tree_util.tree_map(lambda x, i=i: x[i], out[3]),
+                telemetry_spec)
+            for i in range(s_count)]
+    return res
+
+
+def run_counter_batch(batch: ScenarioBatch, *, mesh=None,
+                      telemetry_spec=None) -> dict:
+    """S g-counter campaigns in ONE dispatch: per-node deltas acked at
+    round 0 (the sequential runner's default ``arange(1, n+1)``),
+    convergence = pending drained AND every cached read equals the KV,
+    lost acked writes = the final ``acked_sum - kv - pending``
+    shortfall (amnesia-killed deltas)."""
+    kw = batch.runner_kw
+    n = batch.n_nodes
+    mode = kw.get("mode", "cas")
+    poll_every = int(kw.get("poll_every", 2))
+    scs = batch.scenarios
+    s_count = len(scs)
+    sim = CT.CounterSim(n, mode=mode, poll_every=poll_every)
+    deltas = np.arange(1, n + 1, dtype=np.int32)
+    acked_sum = int(deltas.sum())
+
+    plans = faults.batch_plans([sc.spec for sc in scs])
+    clears = jnp.asarray(
+        np.array([sc.spec.clear_round for sc in scs], np.int32))
+    r_total = (int(np.max(np.asarray(clears)))
+               + batch.max_recovery_rounds)
+
+    def one_state():
+        st = sim.init_state()
+        return st._replace(pending=st.pending
+                           + jnp.asarray(deltas))
+
+    states = stack_pytrees([one_state() for _ in range(s_count)])
+    rnd = CT._build_batch_round(sim)
+    tl = telemetry_spec is not None
+    tel_mask = telemetry_spec.static_mask if tl else None
+    from .engine import collectives
+    coll = collectives(n)
+
+    def one(state, plan, clear, *tel_a):
+        step1 = lambda st, i: rnd(st, plan)            # noqa: E731
+        row = ((lambda s0, s1: sim._tel_series(
+            s0, s1, coll, sim.kv_sched, plan)) if tl else None)
+        return certify_loop(step1, CT._batch_converged, state, clear,
+                            batch.max_recovery_rounds, r_total,
+                            tel_a[0] if tl else None, row, tel_mask)
+
+    args = [states, plans, clears]
+    dn = (0,) + ((len(args),) if tl else ())
+    if tl:
+        args.append(stack_pytrees(
+            [telemetry.init_state(telemetry_spec)
+             for _ in range(s_count)]))
+    args = _place(tuple(args), mesh)
+    prog = _build_batch_program(
+        "counter", one, args, mesh, dn,
+        key=(n, mode, poll_every, s_count, r_total,
+             int(plans.starts.shape[1]), telemetry_spec))
+    out = prog(*args)
+    final, conv_round, msgs_clear = out[0], out[1], out[2]
+    kv = np.asarray(final.kv)
+    pend = np.asarray(final.pending).sum(axis=1)
+    shortfall = acked_sum - kv - pend
+    lost_lists = [([{"lost_sum": int(shortfall[i])}]
+                   if shortfall[i] != 0 else [])
+                  for i in range(s_count)]
+    res = _verdict_rows(batch, conv_round, msgs_clear,
+                        np.asarray(final.msgs), lost_lists,
+                        extra=[{"acked_sum": acked_sum,
+                                "kv": int(kv[i])}
+                               for i in range(s_count)])
+    res.update(n_nodes=n, mode=mode, final=final)
+    if tl:
+        res["telemetry"] = [
+            telemetry.series_arrays(
+                jax.tree_util.tree_map(lambda x, i=i: x[i], out[3]),
+                telemetry_spec)
+            for i in range(s_count)]
+    return res
+
+
+def run_kafka_batch(batch: ScenarioBatch, *, mesh=None,
+                    telemetry_spec=None) -> dict:
+    """S replicated-log campaigns in ONE dispatch: per-scenario seeded
+    send traffic at live nodes (commit-free vectorized staging — the
+    sequential runner's ``commits=False`` regime), the FAULTED
+    origin-union replication path, convergence = every node's presence
+    bitset identical, lost acked writes = allocated slots present at
+    NO node (+ any committed-offset cache exceeding the shared
+    cell)."""
+    kw = batch.runner_kw
+    n = batch.n_nodes
+    n_keys = int(kw.get("n_keys", 4))
+    capacity = int(kw.get("capacity", 64))
+    max_sends = int(kw.get("max_sends", 2))
+    resync_every = int(kw.get("resync_every", 4))
+    send_prob = float(kw.get("send_prob", 0.7))
+    scs = batch.scenarios
+    s_count = len(scs)
+    sim = KF.KafkaSim(n, n_keys, capacity=capacity,
+                      max_sends=max_sends, resync_every=resync_every)
+
+    plans = faults.batch_plans([sc.spec for sc in scs])
+    clears_np = np.array(
+        [max(sc.spec.clear_round, int(kw.get("rounds") or 0))
+         for sc in scs], np.int32)
+    clears = jnp.asarray(clears_np)
+    max_clear = int(clears_np.max())
+    r_total = max_clear + batch.max_recovery_rounds
+    sks, svs = stage_kafka_batch(batch, r_total, n_keys=n_keys,
+                                 max_sends=max_sends,
+                                 send_prob=send_prob)
+
+    states = stack_pytrees([sim.init_state()
+                            for _ in range(s_count)])
+    rnd = KF._build_batch_round(sim)
+    tl = telemetry_spec is not None
+    tel_mask = telemetry_spec.static_mask if tl else None
+    full_scan = (tl and "present_bits_full" in telemetry_spec.series)
+    from .engine import collectives
+    coll = collectives(n)
+
+    def one(state, plan, sk_r, sv_r, clear, *tel_a):
+        def step1(st, i):
+            sk = lax.dynamic_index_in_dim(sk_r, i, axis=0,
+                                          keepdims=False)
+            sv = lax.dynamic_index_in_dim(sv_r, i, axis=0,
+                                          keepdims=False)
+            return rnd(st, plan, sk, sv)
+
+        row = ((lambda s0, s1: sim._tel_series(
+            s0, s1, coll, plan, full_scan)) if tl else None)
+        return certify_loop(step1, KF._batch_converged, state, clear,
+                            batch.max_recovery_rounds, r_total,
+                            tel_a[0] if tl else None, row, tel_mask)
+
+    args = [states, plans, sks, svs, clears]
+    dn = (0,) + ((len(args),) if tl else ())
+    if tl:
+        args.append(stack_pytrees(
+            [telemetry.init_state(telemetry_spec)
+             for _ in range(s_count)]))
+    args = _place(tuple(args), mesh)
+    prog = _build_batch_program(
+        "kafka", one, args, mesh, dn,
+        key=(n, n_keys, capacity, max_sends, resync_every, s_count,
+             r_total, int(plans.starts.shape[1]), telemetry_spec))
+    out = prog(*args)
+    final, conv_round, msgs_clear = out[0], out[1], out[2]
+    pres = np.asarray(final.present) > 0              # (S, N, K, Wc)
+    log_vals = np.asarray(final.log_vals)             # (S, K, C)
+    lost_lists = []
+    for i in range(s_count):
+        allocated = log_vals[i] >= 0
+        anywhere = np.zeros_like(allocated)
+        p = np.asarray(final.present)[i]              # (N, K, Wc)
+        bits = np.unpackbits(
+            p.view(np.uint8), axis=-1, bitorder="little")
+        anywhere = bits.any(axis=0)[:, :allocated.shape[1]]
+        lost = [(int(k), int(c) + 1)
+                for k, c in zip(*np.nonzero(allocated
+                                            & ~anywhere))]
+        kvv = np.asarray(final.kv_val)[i]
+        lc = np.asarray(final.local_committed)[i]
+        over = lc > np.where(kvv > 0, kvv, 0)[None, :]
+        lost += [{"committed_over_cell": (int(a), int(b))}
+                 for a, b in zip(*np.nonzero(over))]
+        lost_lists.append(lost)
+    res = _verdict_rows(
+        batch, conv_round, msgs_clear, np.asarray(final.msgs),
+        lost_lists,
+        extra=[{"n_allocated": int((log_vals[i] >= 0).sum())}
+               for i in range(s_count)])
+    res.update(n_nodes=n, n_keys=n_keys, final=final)
+    if tl:
+        res["telemetry"] = [
+            telemetry.series_arrays(
+                jax.tree_util.tree_map(lambda x, i=i: x[i], out[3]),
+                telemetry_spec)
+            for i in range(s_count)]
+    return res
+
+
+_RUNNERS = {"broadcast": run_broadcast_batch,
+            "counter": run_counter_batch,
+            "kafka": run_kafka_batch}
+
+
+def run_scenario_batch(batch: ScenarioBatch, *, mesh=None,
+                       telemetry_spec=None,
+                       pad_to_mesh: bool = True) -> dict:
+    """Dispatch one :class:`ScenarioBatch` (pad to the device count
+    first when a mesh is given, dropping the filler rows from the
+    result) — the fuzzer's unit of work."""
+    n_real = len(batch.scenarios)
+    if mesh is not None and pad_to_mesh:
+        batch, n_real = pad_batch(batch, int(mesh.shape["nodes"]))
+    res = _RUNNERS[batch.workload](batch, mesh=mesh,
+                                   telemetry_spec=telemetry_spec)
+    if n_real < res["n_scenarios"]:
+        res["scenarios"] = res["scenarios"][:n_real]
+        res["failing"] = [i for i in res["failing"] if i < n_real]
+        if "telemetry" in res:
+            res["telemetry"] = res["telemetry"][:n_real]
+        res["n_scenarios"] = n_real
+        res["ok"] = not res["failing"]
+    return res
+
+
+# -- program contracts (tpu_sim/audit.py registry) -----------------------
+
+
+def batch_state_bytes(workload: str, s_local: int, n: int, *,
+                      nv: int = 0, n_keys: int = 0,
+                      capacity: int = 0) -> int:
+    """Per-shard donated state bytes of a scenario-batch program
+    (``s_local`` scenarios per device) — the donation/memory claim of
+    the contract rows."""
+    if workload == "broadcast":
+        per = 2 * n * ((nv + 31) // 32) * 4
+    elif workload == "counter":
+        per = 2 * n * 4
+    else:
+        wc = (capacity + 31) // 32
+        per = (n * n_keys * wc * 4 + n_keys * capacity * 4
+               + n_keys * 4 + n * n_keys * 4)
+    return s_local * per
+
+
+def audit_contracts():
+    """The scenario-batch drivers' :class:`~.audit.ProgramContract`
+    rows: scenario placement runs every scenario's node axis LOCALLY,
+    so the compiled batch program must contain ZERO collective ops of
+    any kind (the cap-0 census over the whole COLLECTIVE_OPS family),
+    alias the whole stacked state carry in place (donation scaled by
+    S/devices), and sit in the analytic memory band of S_local x the
+    single-scenario state."""
+    from .audit import AuditProgram, ProgramContract
+    from .engine import analytic_peak_bytes
+    from .engine import operand_bytes as engine_operand_bytes
+
+    def _specs(n, s):
+        out = []
+        for i in range(s):
+            out.append(Scenario(spec=faults.random_spec(
+                n, seed=i + 1, horizon=8,
+                n_crash_windows=1 + i % 2, loss_rate=0.1,
+                dup_rate=0.05 if i % 2 else 0.0)))
+        return tuple(out)
+
+    def broadcast_batch(mesh):
+        n, nv, s = 32, 64, 16
+        batch = ScenarioBatch(
+            workload="broadcast", scenarios=_specs(n, s),
+            runner_kw={"n_values": nv, "topology": "tree",
+                       "sync_every": 4}, max_recovery_rounds=16)
+        prog, args = _audit_program("broadcast", batch, mesh)
+        s_local = s // (1 if mesh is None else 8)
+        state_bytes = batch_state_bytes("broadcast", s_local, n,
+                                        nv=nv)
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes,
+            operand_bytes=engine_operand_bytes(
+                faults.batch_plans([sc.spec
+                                    for sc in batch.scenarios])),
+            slab_bytes=s_local * n * ((nv + 31) // 32) * 4)
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    def counter_batch(mesh):
+        n, s = 32, 16
+        batch = ScenarioBatch(
+            workload="counter", scenarios=_specs(n, s),
+            runner_kw={"mode": "cas", "poll_every": 2},
+            max_recovery_rounds=16)
+        prog, args = _audit_program("counter", batch, mesh)
+        s_local = s // (1 if mesh is None else 8)
+        state_bytes = batch_state_bytes("counter", s_local, n)
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes,
+            operand_bytes=engine_operand_bytes(
+                faults.batch_plans([sc.spec
+                                    for sc in batch.scenarios])),
+            slab_bytes=s_local * n * 4)
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    def kafka_batch(mesh):
+        n, s = 16, 16
+        batch = ScenarioBatch(
+            workload="kafka", scenarios=_specs(n, s),
+            runner_kw={"n_keys": 4, "capacity": 32, "max_sends": 1,
+                       "resync_every": 2, "send_prob": 0.5},
+            max_recovery_rounds=12)
+        prog, args = _audit_program("kafka", batch, mesh)
+        s_local = s // (1 if mesh is None else 8)
+        state_bytes = batch_state_bytes("kafka", s_local, n,
+                                        n_keys=4, capacity=32)
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes,
+            operand_bytes=engine_operand_bytes(
+                faults.batch_plans([sc.spec
+                                    for sc in batch.scenarios])),
+            slab_bytes=s_local * n * n * 1 * 4)
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    return [
+        ProgramContract(
+            name="broadcast/scenario-batch-run",
+            build=broadcast_batch,
+            collectives={},
+            donation=True,
+            mem_lo=0.05, mem_hi=8.0,
+            notes="scenario-sharded batched broadcast campaigns: S "
+                  "whole scenarios vmapped, node axis local per "
+                  "scenario — ZERO collective ops of any kind in the "
+                  "compiled batch program; stacked state carry "
+                  "aliases in place"),
+        ProgramContract(
+            name="counter/scenario-batch-run",
+            build=counter_batch,
+            collectives={},
+            donation=True,
+            mem_lo=0.02, mem_hi=12.0,
+            notes="scenario-sharded batched counter campaigns: cap-0 "
+                  "census over the whole collective family (identity "
+                  "collectives per scenario)"),
+        ProgramContract(
+            name="kafka/scenario-batch-run",
+            build=kafka_batch,
+            collectives={},
+            donation=True,
+            mem_lo=0.02, mem_hi=12.0,
+            notes="scenario-sharded batched kafka campaigns on the "
+                  "faulted origin-union path: the batched program "
+                  "keeps the union elementwise per scenario — no "
+                  "all-gather, no ppermute, no matmul mask"),
+    ]
+
+
+def _audit_program(workload: str, batch: ScenarioBatch, mesh):
+    """(jitted, example_args) of a batch driver: run the runner once
+    with :func:`engine.scenario_program` intercepted so the EXACT
+    jitted object the batch executed (and its staged operand shapes)
+    is what the contract auditor lowers — the ``audit_step_program``
+    convention, applied to the batch drivers.  The runner DONATES its
+    state args, so the captured operands are handed back as
+    ``ShapeDtypeStruct`` leaves (lowering needs avals, not buffers)."""
+    import contextlib
+
+    captured = {}
+    orig = scenario_program
+
+    def capture(per_scenario, example_args, **kw):
+        prog = orig(per_scenario, example_args, **kw)
+        captured["prog"] = prog
+        captured["args"] = tuple(
+            jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), a)
+            for a in example_args)
+        return prog
+
+    import gossip_glomers_tpu.tpu_sim.scenario as _self
+    with contextlib.ExitStack() as stack:
+        stack.callback(setattr, _self, "scenario_program", orig)
+        setattr(_self, "scenario_program", capture)
+        _PROGS.clear()
+        _RUNNERS[workload](batch, mesh=mesh)
+    return captured["prog"], captured["args"]

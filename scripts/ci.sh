@@ -51,6 +51,17 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 # (CPU, seconds.)
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/provenance_smoke.py || rc=1
+# Fuzz smoke (PR 10): a seeded 64-scenario fault-space fuzz run on
+# the scenario-axis batched drivers (8-way virtual mesh, scenario-
+# sharded, one compiled program per batch) with one PLANTED failing
+# seed — asserts the batched certifier names the failure, the
+# auto-shrinker reduces it to a strictly smaller minimal repro whose
+# every retained component is load-bearing, and the shrunk flight
+# bundle replays to the same failure from its JSON alone.  Artifacts
+# uploaded.  (CPU, a few minutes: each shrink replays candidate specs
+# sequentially.)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/fuzz_smoke.py || rc=1
 # Program-contract audit (PR 6): every registered driver contract
 # (collective census, donation alias table, host boundary, memory
 # band) on the CPU 8-way virtual mesh, plus the AST determinism lint

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""CI fuzz smoke (PR 10): a small seeded fault-space fuzz run with a
+planted failing seed, end to end through the auto-shrinker.
+
+Asserts the whole scenario-axis pipeline:
+
+- >= 64 scenarios certified in compiled batch dispatches on the 8-way
+  virtual CPU mesh (scenario-sharded — tpu_sim/scenario.py), one
+  PLANTED provably-failing cell among them;
+- the planted failure is detected by the batched recovery certifier
+  (named by scenario index), reproduced sequentially, and auto-shrunk
+  (harness/fuzz.py): the shrunk repro's flight bundle is WRITTEN,
+  schema-valid (observe.load_bundle), strictly SMALLER than the
+  original cell (fuzz.scenario_weight), every retained fault
+  component is load-bearing, and ``replay_bundle`` reproduces the
+  SAME checker failure from the bundle's JSON alone with a faithful
+  (divergence-free) record;
+- artifacts land in ``artifacts/fuzz_smoke/`` (uploaded by CI).
+
+Exit nonzero on any failed assertion.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gossip_glomers_tpu.parallel.mesh import force_virtual_devices  # noqa: E402
+
+force_virtual_devices(8)
+
+import jax                                                  # noqa: E402
+import numpy as np                                          # noqa: E402
+from jax.sharding import Mesh                               # noqa: E402
+
+from gossip_glomers_tpu.harness import fuzz as FZ           # noqa: E402
+from gossip_glomers_tpu.harness import observe              # noqa: E402
+
+OUT = pathlib.Path(__file__).resolve().parent.parent \
+    / "artifacts" / "fuzz_smoke"
+
+
+def main() -> int:
+    OUT.mkdir(parents=True, exist_ok=True)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
+    res = FZ.fuzz_run(
+        "broadcast", 64, n_nodes=24, batch_size=32, horizon=8,
+        max_recovery_rounds=48, seed=7, mesh=mesh,
+        plant_failure=True, max_shrinks=3, observe_dir=str(OUT))
+    print(f"fuzz: {res['n_certified_ok']}/{res['n_scenarios']} "
+          f"certified ({res['n_distinct']} distinct), "
+          f"{res['n_failing']} failing, "
+          f"{res['scenarios_per_sec']}/s")
+    ok = True
+
+    def check(cond: bool, msg: str) -> None:
+        nonlocal ok
+        print(("ok  " if cond else "FAIL") + f" {msg}")
+        ok = ok and cond
+
+    check(res["n_scenarios"] >= 64, ">= 64 scenarios dispatched")
+    check(res["n_distinct"] >= 64, "all scenario cells distinct")
+    check(res["n_failing"] >= 1, "the planted failing seed failed")
+    planted = next(
+        (s for s in res["shrinks"]
+         if s["original"]["spec"]["seed"] == 424242), None)
+    check(planted is not None, "planted seed reached the shrinker")
+    if planted is None:
+        return 1
+    check(planted["weight_after"] < planted["weight_before"],
+          f"shrunk repro is smaller "
+          f"({planted['weight_before']} -> "
+          f"{planted['weight_after']})")
+    check(bool(planted["moves_accepted"]),
+          "shrinker accepted at least one reduction")
+    check(planted["all_components_load_bearing"],
+          "every retained fault component is load-bearing")
+    bundle_path = planted["bundle"]
+    check(bundle_path is not None and
+          pathlib.Path(bundle_path).exists(),
+          f"shrunk flight bundle written ({bundle_path})")
+    bundle = observe.load_bundle(bundle_path)   # schema-valid or raises
+    check(bundle["workload"] == "broadcast",
+          "bundle schema valid (load_bundle)")
+    check(planted["replay_same_failure"],
+          "shrunk bundle replays to the SAME failure from JSON alone")
+    # an independent replay from the file (not the shrinker's cached
+    # verdict): same failure signature, faithful record
+    replay = observe.replay_bundle(bundle_path)
+    sig = FZ.failure_signature(replay)
+    check(sig is not None, "independent replay still fails")
+    check(replay.get("first_divergence_round") is None,
+          "independent replay is divergence-free")
+    (OUT / "fuzz_smoke_report.json").write_text(json.dumps(
+        {k: v for k, v in res.items() if k != "rows"},
+        indent=1, default=str) + "\n")
+    print("fuzz smoke", "OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
