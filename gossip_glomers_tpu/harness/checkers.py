@@ -248,6 +248,104 @@ def check_op_latency(summary: dict, *, p99_max_rounds: float,
         "problems": problems}
 
 
+def check_slo(row: dict, *, p99_max_rounds: float | None = None,
+              max_rounds: int | None = None,
+              min_completed: int = 1,
+              min_sustained: float | None = None,
+              max_recovery_rounds: int | None = None,
+              require_converged: bool = True,
+              coords=None) -> tuple[bool, dict]:
+    """Falsifiable SLO verdict over ONE serving-frontier grid cell
+    (tpu_sim/scenario.py ``collect_serving_batch`` row, or a
+    sequential ``run_serving`` details dict — same keys, so the two
+    certifiers cannot drift).  A cell fails when
+
+    - its p99 / max per-op latency (rounds) exceeds the bound,
+    - fewer than ``min_completed`` ops completed,
+    - sustained throughput (``sustained_per_round``, completed ops
+      per round over the whole horizon) falls below
+      ``min_sustained``,
+    - it never drained its in-flight ops (``require_converged``) or
+      took more than ``max_recovery_rounds`` rounds past clear, or
+    - the tracker's conservation invariant broke.
+
+    Every problem string names the cell's grid coordinates
+    (``coords`` argument, else the row's own ``coords`` key) so one
+    bad cell in a 256-cell surface is identified without re-running
+    anything — tests/test_frontier.py plants one and proves it."""
+    at = coords if coords is not None else row.get("coords")
+    where = f"cell{tuple(at)!r}" if at else f"cell {row.get('cell')}"
+    completed = int(row.get("completed", 0))
+    problems: list[str] = []
+    if not row.get("conserved", True):
+        problems.append(f"{where}: conservation broke (arrived != "
+                        "issued + deferred)")
+    if completed < min_completed:
+        problems.append(f"{where}: only {completed} ops completed "
+                        f"(< {min_completed})")
+    elif completed > 0:
+        if (p99_max_rounds is not None
+                and row["lat_p99"] > p99_max_rounds):
+            problems.append(
+                f"{where}: p99 latency {row['lat_p99']} rounds > "
+                f"SLO {p99_max_rounds}")
+        if max_rounds is not None and row["lat_max"] > max_rounds:
+            problems.append(
+                f"{where}: max latency {row['lat_max']} rounds > "
+                f"SLO {max_rounds}")
+    if (min_sustained is not None
+            and row.get("sustained_per_round", 0.0) < min_sustained):
+        problems.append(
+            f"{where}: sustained {row.get('sustained_per_round')} "
+            f"ops/round < SLO {min_sustained}")
+    if require_converged and row.get("converged_round") is None:
+        problems.append(
+            f"{where}: never drained ({row.get('in_flight', '?')} "
+            "acked ops still in flight)")
+    rec = row.get("recovery_rounds")
+    if (max_recovery_rounds is not None and rec is not None
+            and rec > max_recovery_rounds):
+        problems.append(
+            f"{where}: recovery took {rec} rounds "
+            f"(> {max_recovery_rounds})")
+    return not problems, {
+        "coords": (list(at) if at is not None else None),
+        "cell": row.get("cell"),
+        "completed": completed,
+        "lat_p50": row.get("lat_p50"),
+        "lat_p99": row.get("lat_p99"),
+        "lat_max": row.get("lat_max"),
+        "sustained_per_round": row.get("sustained_per_round"),
+        "recovery_rounds": rec,
+        "problems": problems}
+
+
+def check_frontier_batch(rows: list, slo: dict) -> tuple[bool, dict]:
+    """Batched :func:`check_slo` over the per-cell rows of ONE
+    compiled serving-frontier dispatch (tpu_sim/scenario.py
+    ``run_serving_batch``): the scalar checker itself runs per row
+    (the batched and sequential certifiers cannot drift), failing
+    cells are named by index AND grid coordinates, and the details
+    dict carries every per-cell verdict for the frontier table."""
+    verdicts: list[dict] = []
+    failing: list[int] = []
+    problems: list[str] = []
+    for i, row in enumerate(rows):
+        ok_i, det = check_slo(row, **slo)
+        verdicts.append({"ok": ok_i, **det})
+        if not ok_i:
+            failing.append(i)
+            if len(problems) < 16:
+                problems.extend(det["problems"][:2])
+    return not failing, {
+        "n_cells": len(rows),
+        "n_ok": len(rows) - len(failing),
+        "failing": failing,
+        "problems": problems,
+        "slo": dict(slo),
+        "cells": verdicts}
+
+
 def series_divergence_round(expected: dict, got: dict) -> int | None:
     """First absolute round at which two recorded telemetry series
     dicts (tpu_sim/telemetry.py ``series_arrays``) disagree on any

@@ -37,6 +37,7 @@ sequence.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import time
 
@@ -58,13 +59,44 @@ HOST_SIDE = (
     "_sample_partition", "sample_scenarios", "planted_failure",
     "_canon_lost", "failure_signature", "scenario_weight",
     "run_sequential", "_shrink_moves", "_components",
-    "shrink_scenario", "fuzz_run")
+    "shrink_scenario", "_pow2", "_axis_key", "fuzz_run",
+    "_traffic_moves", "_serving_moves", "_serving_weight",
+    "run_serving_cell", "shrink_serving_cell")
 
 # the sampled axis grids (each cell draws one value per axis)
 LOSS_GRID = (0.0, 0.05, 0.1, 0.2)
 DUP_GRID = (0.0, 0.05, 0.1)
 CRASH_GRID = (0, 1, 2)
 DELAY_CLASSES = (1, 2)
+
+
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (the shape-bucket rounding)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _axis_key(sc: "SC.Scenario") -> tuple:
+    """The fault-space grid cell one sampled scenario came from —
+    the adaptive fuzzer's steering granularity (CoverageMap axis),
+    computable BEFORE the run: the sampled grid values (crash
+    windows, loss rate, dup rate, partition windows, max delay
+    class) refined by the crash shape (earliest-start bucket, total
+    crashed nodes) — timing and blast radius drive which behavior a
+    scenario lands in, so the axis must distinguish them or the
+    steering chases the wrong cells."""
+    spec = sc.spec
+    starts = [s for s, _e, _ns in spec.crash]
+    return (len(spec.crash),
+            float(spec.loss_rate or 0.0),
+            float(spec.dup_rate or 0.0),
+            0 if sc.parts is None else len(sc.parts["starts"]),
+            0 if sc.delays is None
+            else max(v for row in sc.delays for v in row),
+            min(starts) // 2 if starts else -1,
+            sum(len(ns) for _s, _e, ns in spec.crash))
 
 
 # -- sampling ------------------------------------------------------------
@@ -458,6 +490,12 @@ def fuzz_run(workload: str = "broadcast", n_scenarios: int = 256, *,
              plant_failure: bool = False,
              shrink: bool = True, max_shrinks: int | None = None,
              observe_dir: str | None = None,
+             shape_buckets: bool = False,
+             pipeline: bool = False,
+             signatures: bool = False,
+             adapt: bool = False,
+             adapt_oversample: int = 4,
+             coverage=None,
              ) -> dict:
     """The fault-space fuzzer (module docstring): sample
     ``n_scenarios`` cells, certify them in ``batch_size``-scenario
@@ -468,9 +506,35 @@ def fuzz_run(workload: str = "broadcast", n_scenarios: int = 256, *,
     samples per-edge delays (batches are homogeneous in the delay
     dimension); ``"on"`` / ``"off"`` force it.  ``plant_failure``
     prepends :func:`planted_failure` (a provably failing cell) —
-    the CI smoke's end-to-end shrink probe."""
+    the CI smoke's end-to-end shrink probe.
+
+    PR 13 knobs (all default OFF — the PR-10 behavior is pinned):
+
+    - ``shape_buckets``: pad every batch to power-of-two program
+      shapes (crash-window count, scenario count via ``pad_to``, a
+      campaign-wide trip-count floor via ``min_rounds``) so ragged
+      tails and heterogeneous window counts reuse ONE hot compiled
+      program instead of paying per-shape XLA compiles;
+    - ``pipeline``: depth-2 async dispatch — batch ``i+1`` is staged
+      and enqueued while the host certifies batch ``i``'s results
+      (verdicts pinned identical to the sync path);
+    - ``signatures``: record each scenario's on-device (4,)
+      behavioral signature and fold the campaign into a
+      :class:`~.frontier.CoverageMap` (``result["coverage"]``);
+    - ``adapt``: coverage-steered sampling (implies ``signatures``;
+      forces sequential batches, so incompatible with ``pipeline``):
+      each batch oversamples ``adapt_oversample``-fold candidate
+      cells and keeps the ones whose fault-axis cell has the highest
+      behaviors-per-sample novelty — budget flows toward the axis
+      cells still producing unseen behaviors.  ``coverage`` seeds
+      the map (cross-campaign steering)."""
     if workload not in ("broadcast", "counter", "kafka"):
         raise ValueError(f"unknown fuzz workload {workload!r}")
+    if adapt and pipeline:
+        raise ValueError(
+            "adapt needs the coverage of batch i before sampling "
+            "batch i+1 — incompatible with pipelined dispatch")
+    signatures = signatures or adapt
     kw = dict(runner_kw or {})
     if workload == "broadcast":
         kw.setdefault("n_values", 2 * n_nodes)
@@ -485,57 +549,181 @@ def fuzz_run(workload: str = "broadcast", n_scenarios: int = 256, *,
         nbrs_shape = None
 
     n_batches = (n_scenarios + batch_size - 1) // batch_size
-    t_sample = time.perf_counter()
-    batches = []
-    for b in range(n_batches):
-        count = min(batch_size, n_scenarios - b * batch_size)
-        delays_on = (workload == "broadcast"
-                     and {"alternate": b % 2 == 1,
-                          "on": True, "off": False}[delay_axis])
-        cells = sample_scenarios(
-            workload, count, n_nodes=n_nodes,
-            seed=seed * 1000 + b, horizon=horizon,
-            nbrs_shape=nbrs_shape, delay_axis=delays_on)
-        if plant_failure and b == 0:
-            cells[0] = planted_failure(workload, n_nodes, horizon)
-            if delays_on:
-                ones = tuple(tuple(1 for _ in range(nbrs_shape[1]))
-                             for _ in range(nbrs_shape[0]))
-                cells[0] = SC.Scenario(
-                    spec=cells[0].spec, parts=cells[0].parts,
-                    delays=ones,
-                    workload_seed=cells[0].workload_seed)
-        batches.append(SC.ScenarioBatch(
+    counts = [min(batch_size, n_scenarios - b * batch_size)
+              for b in range(n_batches)]
+    delays_flags = [
+        (workload == "broadcast"
+         and {"alternate": b % 2 == 1,
+              "on": True, "off": False}[delay_axis])
+        for b in range(n_batches)]
+
+    def _plant(cells, delays_on):
+        cells[0] = planted_failure(workload, n_nodes, horizon)
+        if delays_on:
+            ones = tuple(tuple(1 for _ in range(nbrs_shape[1]))
+                         for _ in range(nbrs_shape[0]))
+            cells[0] = SC.Scenario(
+                spec=cells[0].spec, parts=cells[0].parts,
+                delays=ones,
+                workload_seed=cells[0].workload_seed)
+        return cells
+
+    def _mk_batch(cells):
+        return SC.ScenarioBatch(
             workload=workload, scenarios=tuple(cells),
-            runner_kw=kw, max_recovery_rounds=max_recovery_rounds))
+            runner_kw=kw, max_recovery_rounds=max_recovery_rounds)
+
+    t_sample = time.perf_counter()
+    batches: list = [None] * n_batches
+    if not adapt:
+        for b in range(n_batches):
+            cells = sample_scenarios(
+                workload, counts[b], n_nodes=n_nodes,
+                seed=seed * 1000 + b, horizon=horizon,
+                nbrs_shape=nbrs_shape, delay_axis=delays_flags[b])
+            if plant_failure and b == 0:
+                cells = _plant(cells, delays_flags[b])
+            batches[b] = _mk_batch(cells)
     sample_s = time.perf_counter() - t_sample
+
+    # shape-bucket knobs (PR 13): pow-2 crash-window counts, pow-2
+    # scenario counts (ragged tails padded up), and ONE campaign-wide
+    # trip-count floor — every batch then shares one compiled program
+    # per delay-axis setting instead of paying per-shape XLA compiles
+    kw_rounds = int(kw.get("rounds") or 0)
+    n_windows = pad_to = None
+    min_rounds = 0
+    if shape_buckets:
+        n_windows = _pow2(max(1, max(CRASH_GRID)))
+        pad_to = _pow2(batch_size)
+        shift = n_nodes + 2 if workload == "counter" else 0
+        min_rounds = (max(horizon + shift, kw_rounds)
+                      + max_recovery_rounds)
+
+    if signatures:
+        from .frontier import CoverageMap
+        coverage = coverage if coverage is not None else CoverageMap()
+
+    def _tel_spec(batch):
+        # the signature ring must cover the batch's whole horizon
+        # (scenario.py _sig_setup rejects a wrapping ring); with
+        # shape_buckets the min_rounds floor dominates, so every
+        # batch shares one ring shape
+        if not signatures:
+            return None
+        mx = max(max(sc.spec.clear_round, kw_rounds)
+                 for sc in batch.scenarios)
+        r_tot = max(mx + max_recovery_rounds, min_rounds)
+        return TM.TelemetrySpec(workload, rounds=r_tot)
+
+    def _shape_key(batch):
+        # program-shape key: a batch with a new shape (scenario
+        # count, delays on/off, padded window counts) compiles fresh
+        # — the steady-state rate must exclude its compile
+        s = len(batch.scenarios)
+        if pad_to:
+            s = -(-s // pad_to) * pad_to
+        w = max(len(sc.spec.crash) for sc in batch.scenarios)
+        if n_windows:
+            w = max(w, n_windows)
+        return (s,
+                any(sc.delays is not None for sc in batch.scenarios),
+                w,
+                max((0 if sc.parts is None
+                     else len(sc.parts["starts"]))
+                    for sc in batch.scenarios))
+
+    def _dispatch(batch):
+        return SC.dispatch_scenario_batch(
+            batch, mesh=mesh, telemetry_spec=_tel_spec(batch),
+            signatures=signatures, n_windows=n_windows,
+            min_rounds=min_rounds, pad_to=pad_to)
+
+    def _absorb(b, res):
+        batch = batches[b]
+        sigs = res.get("signatures")
+        for i, row in enumerate(res["scenarios"]):
+            row = dict(row)
+            row.pop("final", None)
+            row["batch"] = b
+            if sigs is not None:
+                sig = [int(v) for v in sigs[i]]
+                row["signature"] = sig
+                coverage.add(sig,
+                             axis=_axis_key(batch.scenarios[i]),
+                             meta={"batch": b, "index": i})
+            rows.append(row)
+            if not row["ok"]:
+                failing.append((b, i, batch.scenarios[i]))
 
     rows = []
     failing = []
     batch_walls = []
     batch_shapes = []
     t0 = time.perf_counter()
-    for b, batch in enumerate(batches):
+    if adapt:
+        # coverage-steered sampling: oversample candidate cells,
+        # keep the ones whose fault-axis cell still has the highest
+        # behaviors-per-sample novelty — NECESSARILY sequential
+        # (batch i's signatures steer batch i+1's sampling)
+        for b in range(n_batches):
+            tb = time.perf_counter()
+            cands = sample_scenarios(
+                workload, counts[b] * max(1, adapt_oversample),
+                n_nodes=n_nodes, seed=seed * 1000 + b,
+                horizon=horizon, nbrs_shape=nbrs_shape,
+                delay_axis=delays_flags[b])
+            axes = [_axis_key(sc) for sc in cands]
+            # greedy: highest coverage novelty first, discounting
+            # axis cells already taken THIS batch (ties break on
+            # candidate order — fully deterministic)
+            picked: list = []
+            local: dict = {}
+            remaining = list(range(len(cands)))
+            while len(picked) < counts[b] and remaining:
+                best = max(
+                    remaining,
+                    key=lambda j: (coverage.novelty(axes[j])
+                                   / (1 + 2 * local.get(axes[j], 0)),
+                                   -j))
+                picked.append(best)
+                remaining.remove(best)
+                local[axes[best]] = local.get(axes[best], 0) + 1
+            cells = [cands[j] for j in sorted(picked)]
+            if plant_failure and b == 0:
+                cells = _plant(cells, delays_flags[b])
+            batches[b] = _mk_batch(cells)
+            res = SC.collect_scenario_batch(_dispatch(batches[b]))
+            batch_walls.append(round(time.perf_counter() - tb, 3))
+            batch_shapes.append(_shape_key(batches[b]))
+            _absorb(b, res)
+    elif pipeline:
+        # depth-2 async dispatch: batch b is staged + enqueued while
+        # the host certifies batch b-1's results; verdicts are
+        # pinned identical to the sync path (tests/test_frontier.py)
+        pending = None
+        for b in range(n_batches):
+            tb = time.perf_counter()
+            h = _dispatch(batches[b])
+            if pending is not None:
+                _absorb(b - 1, SC.collect_scenario_batch(pending))
+            pending = h
+            batch_walls.append(round(time.perf_counter() - tb, 3))
+            batch_shapes.append(_shape_key(batches[b]))
         tb = time.perf_counter()
-        res = SC.run_scenario_batch(batch, mesh=mesh)
-        wall = time.perf_counter() - tb
-        batch_walls.append(round(wall, 3))
-        # program-shape key: a batch with a new shape (scenario
-        # count, delays on/off, padded window counts) compiles fresh
-        # — the steady-state rate must exclude its compile
-        batch_shapes.append((
-            len(batch.scenarios),
-            any(sc.delays is not None for sc in batch.scenarios),
-            max(len(sc.spec.crash) for sc in batch.scenarios),
-            max((0 if sc.parts is None else len(sc.parts["starts"]))
-                for sc in batch.scenarios)))
-        for i, row in enumerate(res["scenarios"]):
-            row = dict(row)
-            row.pop("final", None)
-            row["batch"] = b
-            rows.append(row)
-            if not row["ok"]:
-                failing.append((b, i, batch.scenarios[i]))
+        _absorb(n_batches - 1, SC.collect_scenario_batch(pending))
+        batch_walls[-1] = round(
+            batch_walls[-1] + time.perf_counter() - tb, 3)
+    else:
+        for b, batch in enumerate(batches):
+            tb = time.perf_counter()
+            res = SC.run_scenario_batch(
+                batch, mesh=mesh, telemetry_spec=_tel_spec(batch),
+                signatures=signatures, n_windows=n_windows,
+                min_rounds=min_rounds, pad_to=pad_to)
+            batch_walls.append(round(time.perf_counter() - tb, 3))
+            batch_shapes.append(_shape_key(batch))
+            _absorb(b, res)
     dispatch_s = time.perf_counter() - t0
 
     distinct = len({json.dumps(r["spec"], sort_keys=True)
@@ -580,6 +768,157 @@ def fuzz_run(workload: str = "broadcast", n_scenarios: int = 256, *,
         "scenarios_per_sec": round(len(rows) / max(1e-9,
                                                    dispatch_s), 2),
         "scenarios_per_sec_steady": steady,
+        "shape_buckets": bool(shape_buckets),
+        "shape_knobs": ({"n_windows": n_windows, "pad_to": pad_to,
+                         "min_rounds": min_rounds}
+                        if shape_buckets else None),
+        "n_program_shapes": len(set(batch_shapes)),
+        "pipelined": bool(pipeline),
+        "adapt": bool(adapt),
+        "n_distinct_signatures": (coverage.n_distinct
+                                  if signatures else None),
+        "coverage": coverage.to_meta() if signatures else None,
         "shrinks": shrinks,
         "rows": rows,
+    }
+
+
+# -- serving-cell shrinking (PR 13): the fault shrinker + traffic axis ---
+
+
+def _traffic_moves(t):
+    """Candidate reductions of one TrafficSpec, most-aggressive
+    first: halve the offered rate, drop / narrow / soften burst
+    windows — the load-side mirror of :func:`_shrink_moves`."""
+    if t.rate > 0.02:
+        yield ("halve rate",
+               dataclasses.replace(t, rate=round(t.rate / 2, 6)))
+    for i, (s, e, m) in enumerate(t.burst):
+        yield (f"drop burst window {i}",
+               dataclasses.replace(
+                   t, burst=tuple(w for j, w in enumerate(t.burst)
+                                  if j != i)))
+        if e - s > 1:
+            nb = list(t.burst)
+            nb[i] = (s, s + max(1, (e - s) // 2), m)
+            yield (f"halve burst window {i} width",
+                   dataclasses.replace(t, burst=tuple(nb)))
+        if m > 2.0:
+            nb = list(t.burst)
+            nb[i] = (s, e, m / 2)
+            yield (f"halve burst window {i} mult",
+                   dataclasses.replace(t, burst=tuple(nb)))
+
+
+def _serving_moves(cell):
+    """Candidate reductions of one failing frontier grid cell: the
+    PR-13 traffic moves plus the PR-10 fault moves (the scenario
+    shrinker's, applied to the cell's NemesisSpec)."""
+    for desc, t in _traffic_moves(cell.traffic):
+        yield desc, dataclasses.replace(cell, traffic=t)
+    if cell.spec is not None:
+        for desc, cand in _shrink_moves(SC.Scenario(spec=cell.spec)):
+            yield desc, dataclasses.replace(cell, spec=cand.spec)
+
+
+def _serving_weight(cell) -> int:
+    """Shrink-progress metric for one grid cell: offered load +
+    burst windows + the fault spec's scenario weight."""
+    w = int(round(100 * cell.traffic.rate)) \
+        + 3 * len(cell.traffic.burst)
+    if cell.spec is not None:
+        w += scenario_weight(SC.Scenario(spec=cell.spec))
+    return w
+
+
+def run_serving_cell(workload: str, cell, runner_kw: dict, *,
+                     max_recovery_rounds: int = 96,
+                     drain_every: int = 8, telemetry=None,
+                     observe_dir: str | None = None) -> dict:
+    """One frontier grid cell through the SEQUENTIAL serving runner
+    (harness.serving.run_serving — the batched dispatch is pinned
+    bit-exact against it), with the cell's grid coordinates attached
+    so check_slo verdicts name them — the serving shrinker's
+    oracle."""
+    from . import serving as SV
+
+    sim_kw = dict(runner_kw)
+    if workload == "broadcast":
+        sim_kw["topology"] = cell.topology
+    res = SV.run_serving(
+        workload, cell.traffic, nemesis=cell.spec, sim_kw=sim_kw,
+        max_recovery_rounds=max_recovery_rounds,
+        drain_every=drain_every, telemetry=telemetry,
+        observe_dir=observe_dir)
+    res["coords"] = list(cell.coords)
+    return res
+
+
+def shrink_serving_cell(workload: str, cell, runner_kw: dict,
+                        slo: dict, *,
+                        max_recovery_rounds: int = 96,
+                        drain_every: int = 8, observe_dir,
+                        max_iters: int = 200) -> dict:
+    """Greedy auto-shrink of one SLO-failing frontier grid cell —
+    the PR-10 scenario shrinker extended with the traffic axis: a
+    reduction (halved rate, dropped/narrowed burst window, any fault
+    move) is accepted iff the reduced cell still fails ``check_slo``
+    with the IDENTICAL violation-class signature
+    (frontier.slo_signature).  Writes the shrunk cell's replayable
+    flight bundle and certifies the replay reproduces the same
+    failure classes from its JSON alone."""
+    from . import observe
+    from .frontier import _cell_bundle, slo_signature
+
+    def _probe(c):
+        row = run_serving_cell(
+            workload, c, runner_kw,
+            max_recovery_rounds=max_recovery_rounds,
+            drain_every=drain_every)
+        from .checkers import check_slo
+        _ok, det = check_slo(row, **slo)
+        return slo_signature(row, slo), row, det
+
+    sig0, row0, det0 = _probe(cell)
+    if sig0 is None:
+        raise ValueError(
+            "shrink_serving_cell needs an SLO-FAILING cell (the "
+            "frontier verdict said this one failed but the "
+            "sequential rerun passed — a batch/sequential "
+            "divergence, which the parity tests pin against)")
+    cur, cur_row, cur_det = cell, row0, det0
+    trail = []
+    iters = 0
+    progress = True
+    while progress and iters < max_iters:
+        progress = False
+        for desc, cand in _serving_moves(cur):
+            iters += 1
+            if iters > max_iters:
+                break
+            sig, row, det = _probe(cand)
+            if sig == sig0:
+                cur, cur_row, cur_det = cand, row, det
+                trail.append(desc)
+                progress = True
+                break
+    bundle_path = _cell_bundle(
+        observe_dir, workload, cur, cur_row,
+        {"problems": cur_det["problems"], "slo": dict(slo)},
+        dict(runner_kw), max_recovery_rounds, drain_every)
+    replay = observe.replay_bundle(bundle_path)
+    replay["coords"] = list(cur.coords)
+    replay_ok = slo_signature(replay, slo) == sig0
+    return {
+        "workload": workload,
+        "original": cell.to_meta(),
+        "shrunk": cur.to_meta(),
+        "weight_before": _serving_weight(cell),
+        "weight_after": _serving_weight(cur),
+        "signature": {k: (list(v) if isinstance(v, tuple) else v)
+                      for k, v in sig0.items()},
+        "moves_accepted": trail,
+        "n_candidate_runs": iters,
+        "bundle": bundle_path,
+        "replay_same_failure": bool(replay_ok),
     }

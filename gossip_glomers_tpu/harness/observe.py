@@ -70,6 +70,7 @@ MANIFEST_SCHEMA = "gg-run-manifest/1"
 TIMELINE_SCHEMA = "gg-timeline/1"
 BUNDLE_SCHEMA = "gg-flight-bundle/1"
 TREE_SCHEMA = "gg-dissemination-tree/1"
+FRONTIER_SCHEMA = "gg-frontier/1"
 
 
 # -- runner-side telemetry resolution ------------------------------------
@@ -497,6 +498,49 @@ def validate_manifest(d: dict) -> None:
         if "fingerprint" not in rec:
             raise ValueError(
                 f"program record {name!r} missing fingerprint")
+
+
+def validate_frontier(d: dict) -> None:
+    """Loud schema check for a frontier report
+    (harness/frontier.py ``run_frontier``) — the CI frontier-smoke
+    gate: every cell row must carry its grid coordinates, both
+    verdicts, and the SLO surface metrics; the failing list must
+    agree with the per-cell verdicts; the coverage section (when
+    present) must account for every recorded signature."""
+    if d.get("schema") != FRONTIER_SCHEMA:
+        raise ValueError(
+            f"frontier schema {d.get('schema')!r} != "
+            f"{FRONTIER_SCHEMA!r}")
+    for key in ("workload", "ok", "n_cells", "slo", "slo_ok",
+                "serving_ok", "failing", "cells"):
+        if key not in d:
+            raise ValueError(f"frontier report missing {key!r}")
+    if d["n_cells"] != len(d["cells"]):
+        raise ValueError(
+            f"n_cells {d['n_cells']} != len(cells) "
+            f"{len(d['cells'])}")
+    failing = set()
+    for i, cell in enumerate(d["cells"]):
+        for key in ("coords", "ok", "slo_ok", "lat_p99",
+                    "sustained_per_round", "completed"):
+            if key not in cell:
+                raise ValueError(f"frontier cell {i} missing "
+                                 f"{key!r}")
+        if not (cell["ok"] and cell["slo_ok"]):
+            failing.add(i)
+    if failing != set(d["failing"]):
+        raise ValueError(
+            f"failing list {sorted(d['failing'])} disagrees with "
+            f"per-cell verdicts {sorted(failing)}")
+    if bool(d["ok"]) != (not failing):
+        raise ValueError("top-level ok disagrees with cells")
+    cov = d.get("coverage")
+    if cov is not None:
+        if cov["n_distinct"] != len(cov["signatures"]):
+            raise ValueError("coverage n_distinct != signatures")
+        if cov["n_seen"] != sum(r["count"]
+                                for r in cov["signatures"]):
+            raise ValueError("coverage n_seen != sum of counts")
 
 
 # -- atomic JSON writes --------------------------------------------------

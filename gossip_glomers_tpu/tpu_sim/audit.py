@@ -475,6 +475,17 @@ def _fuzz_roots() -> str:
                             for n in fuzz.TRACED_EVALUATORS) + ")$")
 
 
+def _frontier_roots() -> str:
+    # harness/frontier.py is PURE HOST cartography (PR 13) — same
+    # empty-traced-tuple contract as harness/fuzz.py (the traced
+    # serving_loop / signature_eval live in tpu_sim/scenario.py);
+    # totality pinned by tests/test_frontier.py.
+    from ..harness import frontier
+    return ("^(" + "|".join(re.escape(n)
+                            for n in frontier.TRACED_EVALUATORS)
+            + ")$")
+
+
 _TRACED_ROOTS: dict[str, str] = {
     "tpu_sim/broadcast.py":
         r"^(_round|flood_step$|_wm_round_single$|_sharded_round"
@@ -494,6 +505,7 @@ _TRACED_ROOTS: dict[str, str] = {
     "tpu_sim/provenance.py": _provenance_roots(),
     "tpu_sim/scenario.py": _scenario_roots(),
     "harness/fuzz.py": _fuzz_roots(),
+    "harness/frontier.py": _frontier_roots(),
     "tpu_sim/engine.py":
         r"^(sharded_roll$|sharded_shift$|collectives$|fori_rounds$"
         r"|windows_fold$|scan_blocks$|scan_rounds$|while_converge$)",
@@ -503,10 +515,13 @@ _TRACED_ROOTS: dict[str, str] = {
 
 # builder methods whose nested `def`s are traced program bodies
 # (run_\w+_batch: the scenario-axis batch runners, PR 10 — their
-# nested per-scenario closures become the vmapped program bodies)
+# nested per-scenario closures become the vmapped program bodies;
+# _?dispatch_\w+_batch: the async dispatch halves those runners split
+# into, PR 13 — same nested closures, now enqueued without blocking)
 _BUILDERS = re.compile(
     r"^(_build_\w+|_step_prog|_run_prog|run_rounds|build_fixed"
-    r"|poll_batch_program|alloc_offsets|run_\w+_batch)$")
+    r"|poll_batch_program|alloc_offsets|run_\w+_batch"
+    r"|_?dispatch_\w+_batch)$")
 # structured.py's exchange/diff/nemesis factories — its make_* arm is
 # scoped to THAT file only: host-side make_* factories elsewhere
 # (harness staging, wire helpers) may nest closures that legitimately

@@ -296,12 +296,15 @@ def pad_plan(plan: FaultPlan, n_windows: int) -> FaultPlan:
             [plan.down, jnp.zeros((pad, n), bool)], axis=0))
 
 
-def batch_plans(specs) -> FaultPlan:
+def batch_plans(specs, n_windows: int | None = None) -> FaultPlan:
     """Compile + pad + stack a sequence of :class:`NemesisSpec`s into
     ONE batched :class:`FaultPlan` with a leading scenario axis:
     ``starts/ends (S, C)``, ``down (S, C, N)``, scalars ``(S,)``.
     The scenario drivers vmap over the leading axis, so each scenario
-    evaluates exactly its own (padded) plan."""
+    evaluates exactly its own (padded) plan.  ``n_windows`` overrides
+    the padded crash-window count (the fuzzer's shape-bucket knob,
+    PR 13: a power-of-two bucket keeps the batched plan shape — and so
+    the compiled program — stable across campaigns)."""
     specs = list(specs)
     if not specs:
         raise ValueError("batch_plans needs at least one spec")
@@ -312,6 +315,12 @@ def batch_plans(specs) -> FaultPlan:
                 f"scenario batch mixes n_nodes {n} and {sp.n_nodes} "
                 "(one compiled shape per batch)")
     c_max = max(len(sp.crash) for sp in specs)
+    if n_windows is not None:
+        if n_windows < c_max:
+            raise ValueError(
+                f"n_windows={n_windows} < the batch's widest crash-"
+                f"window count {c_max}")
+        c_max = n_windows
     plans = [pad_plan(sp.compile(), c_max) for sp in specs]
     return FaultPlan(*(jnp.stack([p[i] for p in plans])
                        for i in range(len(FaultPlan._fields))))

@@ -615,7 +615,19 @@ class KafkaSim:
         #    (logmap.go:309-311).
         n_resync = jnp.uint32(0)
         if plan is not None:
-            is_rs = ((state.t % jnp.int32(self.resync_every) == 0)
+            # gate the cadence on TRACED plan activity, not plan
+            # presence: a batched frontier program stacks one plan per
+            # grid cell and must pass the operand statically, so a
+            # fault-free cell rides an all-zero plan — without this
+            # gate its resync sweep (and 2-msgs-per-live-node ledger)
+            # would fire where the sequential plan=None run skips it.
+            # An inert plan (no crashed nodes, no loss stream) is now
+            # bit-exactly plan=None, ledger included.  A declared
+            # crash window with an EMPTY node set counts as absent.
+            fp_on = (jnp.any(plan.down)
+                     | (plan.loss_num > jnp.uint32(0)))
+            is_rs = (fp_on
+                     & (state.t % jnp.int32(self.resync_every) == 0)
                      & (state.t > 0))
             if self._push:
                 pushers = up_rows & jnp.any(origin_bits > 0,

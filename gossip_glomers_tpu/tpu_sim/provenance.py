@@ -81,12 +81,12 @@ from .engine import _env_int
 # pattern): the determinism lint (tpu_sim/audit.py) treats exactly
 # TRACED_EVALUATORS as traced scope; tests/test_provenance.py pins the
 # split TOTAL.
-TRACED_EVALUATORS = ("stamp",)
+TRACED_EVALUATORS = ("stamp", "critical_depth")
 HOST_SIDE = (
     "init_broadcast", "init_counter", "init_kafka",
     "broadcast_specs", "counter_specs", "kafka_specs",
     "enabled", "default_spec", "prov_key", "arrays_of", "from_arrays",
-    "audit_contracts")
+    "depth_of", "audit_contracts")
 
 WORKLOADS = ("broadcast", "counter", "kafka")
 
@@ -198,6 +198,20 @@ def stamp(cur: jnp.ndarray, mask: jnp.ndarray, val) -> jnp.ndarray:
                      jnp.asarray(val, cur.dtype), cur)
 
 
+def critical_depth(stamps: jnp.ndarray) -> jnp.ndarray:
+    """() int32 — the critical-path depth of a stamp array (traced):
+    the last ROUND at which a first-occurrence stamp landed.  Stamps
+    follow the t+1 convention (-1 unseen, 0 = round-0 origin, t+1 =
+    first present after round t), so the depth is ``max(stamps) - 1``,
+    clamped to -1 when nothing past the origin was ever stamped.  This
+    is the provenance-side twin of the ring-derived
+    ``telemetry.ring_progress_depth`` for dissemination spanning >= 2
+    rounds (pinned by tests; round-0-only deliveries are invisible to
+    the ring's delta view, which baselines at row 0)."""
+    return jnp.maximum(jnp.max(stamps).astype(jnp.int32) - 1,
+                       jnp.int32(-1))
+
+
 # -- env knob -------------------------------------------------------------
 
 
@@ -258,6 +272,19 @@ def from_arrays(workload: str, arrays: dict):
            "kafka": KafkaProv}[workload]
     return cls(*(jnp.array(np.asarray(arrays[f], np.int32))
                  for f in _FIELDS[workload]))
+
+
+def depth_of(workload: str, arrays: dict) -> int:
+    """Host twin of :func:`critical_depth` over a bundle's JSON
+    arrays: the last round a first-occurrence stamp landed, from the
+    workload's dissemination field (broadcast ``arrival``, counter
+    ``visible_round``, kafka ``first_present``).  The frontier replay
+    cross-checks this against the ring-derived signature depth."""
+    field = {"broadcast": "arrival", "counter": "visible_round",
+             "kafka": "first_present"}[workload]
+    a = np.asarray(arrays[field], np.int64)
+    m = int(a.max()) if a.size else -1
+    return max(m - 1, -1)
 
 
 # -- program contracts (tpu_sim/audit.py registry) -----------------------

@@ -50,21 +50,31 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import broadcast as B
 from . import counter as CT
-from . import faults, kafka as KF, telemetry
+from . import faults, kafka as KF, telemetry, traffic
 from .engine import scenario_placement, scenario_program
 
 # The module's host/device split, DECLARED (the PR-6 faults.py
 # pattern): the determinism lint (tpu_sim/audit.py) treats exactly
 # TRACED_EVALUATORS as traced scope; tests/test_scenario.py pins the
 # split TOTAL.  `_build_batch_program`'s nested defs are traced via
-# the builder mechanism (audit._BUILDERS).
-TRACED_EVALUATORS = ("certify_loop",)
+# the builder mechanism (audit._BUILDERS); the `_dispatch_*_batch` /
+# `dispatch_serving_batch` builders carry the traced `one`/`step1`
+# closures and are matched by the same mechanism.
+TRACED_EVALUATORS = ("certify_loop", "serving_loop", "signature_eval")
 HOST_SIDE = (
     "batch_partitions", "pad_batch", "stack_pytrees", "stage_kafka_batch",
     "run_broadcast_batch", "run_counter_batch", "run_kafka_batch",
     "run_scenario_batch", "batch_state_bytes", "audit_contracts",
     "_build_batch_program", "_place", "_verdict_rows",
-    "_audit_program")
+    "_audit_program",
+    "_dispatch_broadcast_batch", "_collect_broadcast_batch",
+    "_dispatch_counter_batch", "_collect_counter_batch",
+    "_dispatch_kafka_batch", "_collect_kafka_batch",
+    "dispatch_scenario_batch", "collect_scenario_batch",
+    "dispatch_serving_batch", "collect_serving_batch",
+    "run_serving_batch", "serving_state_bytes",
+    "pad_serving_batch", "_serving_common", "_serving_sig",
+    "_sig_setup")
 
 
 # -- scenario cases ------------------------------------------------------
@@ -397,17 +407,84 @@ def _verdict_rows(batch: ScenarioBatch, conv_round, msgs_clear,
 # -- per-workload batch drivers ------------------------------------------
 
 
-def run_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
-                        telemetry_spec=None) -> dict:
-    """S broadcast campaigns in ONE dispatch: values injected
-    round-robin at round 0, per-scenario convergence = every node
-    holds every value, lost acked writes = values absent from every
-    node at the scenario's own stop round.  The fault space per
-    scenario: crash/loss/dup (``spec``) x partition windows
-    (``parts``) x per-edge delays (``delays`` — static delay classes,
-    the history-ring gather path).  Returns the batch verdict dict
-    (see :func:`_verdict_rows`) plus per-scenario telemetry series
-    when ``telemetry_spec`` rides along."""
+def _sig_setup(telemetry_spec, r_total: int, extra_series=()):
+    """Host-side validation + column lookup shared by the batch
+    dispatchers when ``signatures=True``: the ring is the signature's
+    only source, so it must exist, cover the whole horizon (no wrap —
+    row t IS round t), and record every column the evaluator reads."""
+    if telemetry_spec is None:
+        raise ValueError(
+            "signatures=True needs a telemetry_spec — the behavioral "
+            "signature is derived from the telemetry ring (no new "
+            "host callbacks)")
+    if telemetry_spec.rounds < r_total:
+        raise ValueError(
+            f"signature ring must cover the whole horizon without "
+            f"wrapping: rounds={telemetry_spec.rounds} < "
+            f"r_total={r_total}")
+    cols = telemetry.signature_columns(telemetry_spec)
+    missing = [s for s in extra_series
+               if s not in telemetry_spec.series]
+    if missing:
+        raise ValueError(
+            f"behavioral signatures for workload "
+            f"{telemetry_spec.workload!r} also need series {missing} "
+            f"recorded; got series={list(telemetry_spec.series)}")
+    return cols
+
+
+def signature_eval(tel, conv_round, clear, bp_class,
+                   msgs_col: int, progress_col: int) -> jnp.ndarray:
+    """One scenario's (4,) int32 behavioral signature (traced; vmapped
+    by the batch programs next to the certify/serving drivers):
+
+    ``[stall_bucket, depth_bucket, bp_class, recovery_bucket]``
+
+    - stall: log2 bucket of the FIRST pre-convergence round whose msgs
+      ledger went quiet (``telemetry.ring_stall_round`` — the
+      first-divergence round);
+    - depth: log2 bucket of the LAST round the workload's progress
+      gauge still moved (``telemetry.ring_progress_depth`` — the
+      provenance critical-path depth, ring-derived);
+    - bp_class: the caller's dominant backpressure class (a small
+      workload-specific int — see the dispatchers);
+    - recovery: log2 bucket of ``conv_round - clear`` (127 = never
+      converged within bound — its own coverage cell).
+
+    Everything reads the ring + scalars the run already carries: ZERO
+    extra collectives, ZERO host callbacks."""
+    stall = telemetry.ring_stall_round(tel.ring, tel.wrote, msgs_col,
+                                       conv_round)
+    depth = telemetry.ring_progress_depth(tel.ring, tel.wrote,
+                                          progress_col)
+    cr = jnp.asarray(conv_round, jnp.int32)
+    rec_b = jnp.where(
+        cr >= 0,
+        telemetry.log2_bucket(jnp.maximum(cr - clear, 0)),
+        jnp.int32(127))
+    return jnp.stack([telemetry.log2_bucket(stall),
+                      telemetry.log2_bucket(depth),
+                      jnp.asarray(bp_class, jnp.int32), rec_b])
+
+
+def _dispatch_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
+                              telemetry_spec=None,
+                              signatures: bool = False,
+                              n_windows: int | None = None,
+                              min_rounds: int = 0) -> dict:
+    """Stage + enqueue S broadcast campaigns (the device half of
+    :func:`run_broadcast_batch`).  Returns the async handle
+    :func:`_collect_broadcast_batch` finishes — JAX async dispatch
+    means the device executes while the host moves on (the pipelined
+    fuzzer overlaps collect(i) with dispatch(i+1)).
+
+    ``signatures`` (PR 13) appends the per-scenario (4,) behavioral
+    signature (:func:`signature_eval`; requires ``telemetry_spec``
+    with an unwrapped ring).  ``n_windows`` pads every FaultPlan to a
+    fixed crash-window count and ``min_rounds`` floors the trip count
+    — the shape-bucket knobs that let one compiled program serve many
+    campaigns (extra frozen trips are no-ops: certify_loop is
+    clear-driven)."""
     kw = batch.runner_kw
     n = batch.n_nodes
     nv = int(kw.get("n_values") or 2 * n)
@@ -441,12 +518,13 @@ def run_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
     else:
         delay_set, delays_b, ring = (), None, 0
 
-    plans = faults.batch_plans([sc.spec for sc in scs])
+    plans = faults.batch_plans([sc.spec for sc in scs], n_windows)
     parts_b = batch_partitions([sc.parts for sc in scs], n)
     clears = jnp.asarray(
         np.array([sc.spec.clear_round for sc in scs], np.int32))
     max_clear = int(np.max(np.asarray(clears)))
-    r_total = max_clear + batch.max_recovery_rounds
+    r_total = max(max_clear + batch.max_recovery_rounds,
+                  int(min_rounds))
 
     inject = B.make_inject(n, nv)
     target = jnp.asarray(np.bitwise_or.reduce(
@@ -468,6 +546,22 @@ def run_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
     tel_mask = telemetry_spec.static_mask if tl else None
     sim = (B.BroadcastSim(nbrs_np, n_values=nv, sync_every=sync_every,
                           srv_ledger=False) if tl else None)
+    if signatures:
+        ms_col, pg_col = _sig_setup(telemetry_spec, r_total)
+        kn_col = telemetry_spec.names.index("known_bits")
+
+    def sig_of(res, clear):
+        if not signatures:
+            return res
+        st, cr, mc, tlf = res
+        last = jnp.maximum(jnp.minimum(
+            tlf.wrote.astype(jnp.int32),
+            jnp.int32(telemetry_spec.rounds)) - 1, 0)
+        known = tlf.ring[last, kn_col].astype(jnp.int32)
+        bp = telemetry.log2_bucket(
+            jnp.maximum(jnp.int32(n * nv) - known, 0))
+        return st, cr, mc, tlf, signature_eval(tlf, cr, clear, bp,
+                                               ms_col, pg_col)
 
     if has_delays:
         def one(state, plan, parts, delays, clear, target, *tel_a):
@@ -477,10 +571,10 @@ def run_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
                                                  target)
             row = ((lambda s0, s1: sim._tel_series(
                 s0, s1, plan, lambda x: x)) if tl else None)
-            return certify_loop(step1, conv, state, clear,
-                                batch.max_recovery_rounds, r_total,
-                                tel_a[0] if tl else None, row,
-                                tel_mask)
+            return sig_of(certify_loop(
+                step1, conv, state, clear,
+                batch.max_recovery_rounds, r_total,
+                tel_a[0] if tl else None, row, tel_mask), clear)
 
         args = [states, plans, parts_b, delays_b, clears, targets]
     else:
@@ -490,10 +584,10 @@ def run_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
                                                  target)
             row = ((lambda s0, s1: sim._tel_series(
                 s0, s1, plan, lambda x: x)) if tl else None)
-            return certify_loop(step1, conv, state, clear,
-                                batch.max_recovery_rounds, r_total,
-                                tel_a[0] if tl else None, row,
-                                tel_mask)
+            return sig_of(certify_loop(
+                step1, conv, state, clear,
+                batch.max_recovery_rounds, r_total,
+                tel_a[0] if tl else None, row, tel_mask), clear)
 
         args = [states, plans, parts_b, clears, targets]
     dn = (0,) + ((len(args),) if tl else ())
@@ -506,8 +600,22 @@ def run_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
         "broadcast", one, args, mesh, dn,
         key=(n, nv, topology, sync_every, s_count, r_total, dup_on,
              delay_set, int(plans.starts.shape[1]),
-             int(parts_b.starts.shape[1]), telemetry_spec))
+             int(parts_b.starts.shape[1]), telemetry_spec,
+             signatures))
     out = prog(*args)
+    return {"out": out, "batch": batch,
+            "telemetry_spec": telemetry_spec, "signatures": signatures,
+            "n": n, "nv": nv, "topology": topology}
+
+
+def _collect_broadcast_batch(handle: dict) -> dict:
+    """Block on + certify a dispatched broadcast batch (the host half
+    of :func:`run_broadcast_batch`)."""
+    out, batch = handle["out"], handle["batch"]
+    telemetry_spec = handle["telemetry_spec"]
+    n, nv = handle["n"], handle["nv"]
+    s_count = len(batch.scenarios)
+    tl = telemetry_spec is not None
     final, conv_round, msgs_clear = out[0], out[1], out[2]
     rec = np.asarray(final.received)                  # (S, N, W)
     anywhere = np.bitwise_or.reduce(rec, axis=1)      # (S, W)
@@ -517,7 +625,7 @@ def run_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
         for i in range(s_count)]
     res = _verdict_rows(batch, conv_round, msgs_clear,
                         np.asarray(final.msgs), lost_lists)
-    res.update(n_nodes=n, n_values=nv, topology=topology,
+    res.update(n_nodes=n, n_values=nv, topology=handle["topology"],
                final=final)
     if tl:
         res["telemetry"] = [
@@ -525,16 +633,39 @@ def run_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
                 jax.tree_util.tree_map(lambda x, i=i: x[i], out[3]),
                 telemetry_spec)
             for i in range(s_count)]
+    if handle["signatures"]:
+        res["signatures"] = np.asarray(out[4])
     return res
 
 
-def run_counter_batch(batch: ScenarioBatch, *, mesh=None,
-                      telemetry_spec=None) -> dict:
-    """S g-counter campaigns in ONE dispatch: per-node deltas acked at
-    round 0 (the sequential runner's default ``arange(1, n+1)``),
-    convergence = pending drained AND every cached read equals the KV,
-    lost acked writes = the final ``acked_sum - kv - pending``
-    shortfall (amnesia-killed deltas)."""
+def run_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
+                        telemetry_spec=None, signatures: bool = False,
+                        n_windows: int | None = None,
+                        min_rounds: int = 0) -> dict:
+    """S broadcast campaigns in ONE dispatch: values injected
+    round-robin at round 0, per-scenario convergence = every node
+    holds every value, lost acked writes = values absent from every
+    node at the scenario's own stop round.  The fault space per
+    scenario: crash/loss/dup (``spec``) x partition windows
+    (``parts``) x per-edge delays (``delays`` — static delay classes,
+    the history-ring gather path).  Returns the batch verdict dict
+    (see :func:`_verdict_rows`) plus per-scenario telemetry series
+    when ``telemetry_spec`` rides along and the (S, 4) behavioral
+    signature matrix with ``signatures``."""
+    return _collect_broadcast_batch(_dispatch_broadcast_batch(
+        batch, mesh=mesh, telemetry_spec=telemetry_spec,
+        signatures=signatures, n_windows=n_windows,
+        min_rounds=min_rounds))
+
+
+def _dispatch_counter_batch(batch: ScenarioBatch, *, mesh=None,
+                            telemetry_spec=None,
+                            signatures: bool = False,
+                            n_windows: int | None = None,
+                            min_rounds: int = 0) -> dict:
+    """Stage + enqueue S g-counter campaigns; see
+    :func:`_dispatch_broadcast_batch` for the dispatch/collect and
+    signature/shape-bucket contracts."""
     kw = batch.runner_kw
     n = batch.n_nodes
     mode = kw.get("mode", "cas")
@@ -545,11 +676,11 @@ def run_counter_batch(batch: ScenarioBatch, *, mesh=None,
     deltas = np.arange(1, n + 1, dtype=np.int32)
     acked_sum = int(deltas.sum())
 
-    plans = faults.batch_plans([sc.spec for sc in scs])
+    plans = faults.batch_plans([sc.spec for sc in scs], n_windows)
     clears = jnp.asarray(
         np.array([sc.spec.clear_round for sc in scs], np.int32))
-    r_total = (int(np.max(np.asarray(clears)))
-               + batch.max_recovery_rounds)
+    r_total = max(int(np.max(np.asarray(clears)))
+                  + batch.max_recovery_rounds, int(min_rounds))
 
     def one_state():
         st = sim.init_state()
@@ -562,14 +693,33 @@ def run_counter_batch(batch: ScenarioBatch, *, mesh=None,
     tel_mask = telemetry_spec.static_mask if tl else None
     from .engine import collectives
     coll = collectives(n)
+    if signatures:
+        ms_col, pg_col = _sig_setup(telemetry_spec, r_total,
+                                    extra_series=("pending_total",))
+        pd_col = telemetry_spec.names.index("pending_total")
+
+    def sig_of(res, clear):
+        if not signatures:
+            return res
+        st, cr, mc, tlf = res
+        last = jnp.maximum(jnp.minimum(
+            tlf.wrote.astype(jnp.int32),
+            jnp.int32(telemetry_spec.rounds)) - 1, 0)
+        kv_t = tlf.ring[last, pg_col].astype(jnp.int32)
+        pend = tlf.ring[last, pd_col].astype(jnp.int32)
+        bp = telemetry.log2_bucket(
+            jnp.maximum(jnp.int32(acked_sum) - kv_t - pend, 0))
+        return st, cr, mc, tlf, signature_eval(tlf, cr, clear, bp,
+                                               ms_col, pg_col)
 
     def one(state, plan, clear, *tel_a):
         step1 = lambda st, i: rnd(st, plan)            # noqa: E731
         row = ((lambda s0, s1: sim._tel_series(
             s0, s1, coll, sim.kv_sched, plan)) if tl else None)
-        return certify_loop(step1, CT._batch_converged, state, clear,
-                            batch.max_recovery_rounds, r_total,
-                            tel_a[0] if tl else None, row, tel_mask)
+        return sig_of(certify_loop(
+            step1, CT._batch_converged, state, clear,
+            batch.max_recovery_rounds, r_total,
+            tel_a[0] if tl else None, row, tel_mask), clear)
 
     args = [states, plans, clears]
     dn = (0,) + ((len(args),) if tl else ())
@@ -581,8 +731,21 @@ def run_counter_batch(batch: ScenarioBatch, *, mesh=None,
     prog = _build_batch_program(
         "counter", one, args, mesh, dn,
         key=(n, mode, poll_every, s_count, r_total,
-             int(plans.starts.shape[1]), telemetry_spec))
+             int(plans.starts.shape[1]), telemetry_spec, signatures))
     out = prog(*args)
+    return {"out": out, "batch": batch,
+            "telemetry_spec": telemetry_spec, "signatures": signatures,
+            "n": n, "mode": mode, "acked_sum": acked_sum}
+
+
+def _collect_counter_batch(handle: dict) -> dict:
+    """Block on + certify a dispatched counter batch."""
+    out, batch = handle["out"], handle["batch"]
+    telemetry_spec = handle["telemetry_spec"]
+    n, mode = handle["n"], handle["mode"]
+    acked_sum = handle["acked_sum"]
+    s_count = len(batch.scenarios)
+    tl = telemetry_spec is not None
     final, conv_round, msgs_clear = out[0], out[1], out[2]
     kv = np.asarray(final.kv)
     pend = np.asarray(final.pending).sum(axis=1)
@@ -602,18 +765,34 @@ def run_counter_batch(batch: ScenarioBatch, *, mesh=None,
                 jax.tree_util.tree_map(lambda x, i=i: x[i], out[3]),
                 telemetry_spec)
             for i in range(s_count)]
+    if handle["signatures"]:
+        res["signatures"] = np.asarray(out[4])
     return res
 
 
-def run_kafka_batch(batch: ScenarioBatch, *, mesh=None,
-                    telemetry_spec=None) -> dict:
-    """S replicated-log campaigns in ONE dispatch: per-scenario seeded
-    send traffic at live nodes (commit-free vectorized staging — the
-    sequential runner's ``commits=False`` regime), the FAULTED
-    origin-union replication path, convergence = every node's presence
-    bitset identical, lost acked writes = allocated slots present at
-    NO node (+ any committed-offset cache exceeding the shared
-    cell)."""
+def run_counter_batch(batch: ScenarioBatch, *, mesh=None,
+                      telemetry_spec=None, signatures: bool = False,
+                      n_windows: int | None = None,
+                      min_rounds: int = 0) -> dict:
+    """S g-counter campaigns in ONE dispatch: per-node deltas acked at
+    round 0 (the sequential runner's default ``arange(1, n+1)``),
+    convergence = pending drained AND every cached read equals the KV,
+    lost acked writes = the final ``acked_sum - kv - pending``
+    shortfall (amnesia-killed deltas)."""
+    return _collect_counter_batch(_dispatch_counter_batch(
+        batch, mesh=mesh, telemetry_spec=telemetry_spec,
+        signatures=signatures, n_windows=n_windows,
+        min_rounds=min_rounds))
+
+
+def _dispatch_kafka_batch(batch: ScenarioBatch, *, mesh=None,
+                          telemetry_spec=None,
+                          signatures: bool = False,
+                          n_windows: int | None = None,
+                          min_rounds: int = 0) -> dict:
+    """Stage + enqueue S replicated-log campaigns; see
+    :func:`_dispatch_broadcast_batch` for the dispatch/collect and
+    signature/shape-bucket contracts."""
     kw = batch.runner_kw
     n = batch.n_nodes
     n_keys = int(kw.get("n_keys", 4))
@@ -626,13 +805,14 @@ def run_kafka_batch(batch: ScenarioBatch, *, mesh=None,
     sim = KF.KafkaSim(n, n_keys, capacity=capacity,
                       max_sends=max_sends, resync_every=resync_every)
 
-    plans = faults.batch_plans([sc.spec for sc in scs])
+    plans = faults.batch_plans([sc.spec for sc in scs], n_windows)
     clears_np = np.array(
         [max(sc.spec.clear_round, int(kw.get("rounds") or 0))
          for sc in scs], np.int32)
     clears = jnp.asarray(clears_np)
     max_clear = int(clears_np.max())
-    r_total = max_clear + batch.max_recovery_rounds
+    r_total = max(max_clear + batch.max_recovery_rounds,
+                  int(min_rounds))
     sks, svs = stage_kafka_batch(batch, r_total, n_keys=n_keys,
                                  max_sends=max_sends,
                                  send_prob=send_prob)
@@ -645,6 +825,23 @@ def run_kafka_batch(batch: ScenarioBatch, *, mesh=None,
     full_scan = (tl and "present_bits_full" in telemetry_spec.series)
     from .engine import collectives
     coll = collectives(n)
+    if signatures:
+        ms_col, pg_col = _sig_setup(telemetry_spec, r_total,
+                                    extra_series=("alloc_total",))
+        al_col = telemetry_spec.names.index("alloc_total")
+
+    def sig_of(res, clear):
+        if not signatures:
+            return res
+        st, cr, mc, tlf = res
+        last = jnp.maximum(jnp.minimum(
+            tlf.wrote.astype(jnp.int32),
+            jnp.int32(telemetry_spec.rounds)) - 1, 0)
+        alloc = tlf.ring[last, al_col].astype(jnp.int32)
+        pres = tlf.ring[last, pg_col].astype(jnp.int32)
+        bp = telemetry.log2_bucket(jnp.maximum(alloc - pres, 0))
+        return st, cr, mc, tlf, signature_eval(tlf, cr, clear, bp,
+                                               ms_col, pg_col)
 
     def one(state, plan, sk_r, sv_r, clear, *tel_a):
         def step1(st, i):
@@ -656,9 +853,10 @@ def run_kafka_batch(batch: ScenarioBatch, *, mesh=None,
 
         row = ((lambda s0, s1: sim._tel_series(
             s0, s1, coll, plan, full_scan)) if tl else None)
-        return certify_loop(step1, KF._batch_converged, state, clear,
-                            batch.max_recovery_rounds, r_total,
-                            tel_a[0] if tl else None, row, tel_mask)
+        return sig_of(certify_loop(
+            step1, KF._batch_converged, state, clear,
+            batch.max_recovery_rounds, r_total,
+            tel_a[0] if tl else None, row, tel_mask), clear)
 
     args = [states, plans, sks, svs, clears]
     dn = (0,) + ((len(args),) if tl else ())
@@ -670,8 +868,21 @@ def run_kafka_batch(batch: ScenarioBatch, *, mesh=None,
     prog = _build_batch_program(
         "kafka", one, args, mesh, dn,
         key=(n, n_keys, capacity, max_sends, resync_every, s_count,
-             r_total, int(plans.starts.shape[1]), telemetry_spec))
+             r_total, int(plans.starts.shape[1]), telemetry_spec,
+             signatures))
     out = prog(*args)
+    return {"out": out, "batch": batch,
+            "telemetry_spec": telemetry_spec, "signatures": signatures,
+            "n": n, "n_keys": n_keys}
+
+
+def _collect_kafka_batch(handle: dict) -> dict:
+    """Block on + certify a dispatched kafka batch."""
+    out, batch = handle["out"], handle["batch"]
+    telemetry_spec = handle["telemetry_spec"]
+    n, n_keys = handle["n"], handle["n_keys"]
+    s_count = len(batch.scenarios)
+    tl = telemetry_spec is not None
     final, conv_round, msgs_clear = out[0], out[1], out[2]
     pres = np.asarray(final.present) > 0              # (S, N, K, Wc)
     log_vals = np.asarray(final.log_vals)             # (S, K, C)
@@ -704,33 +915,627 @@ def run_kafka_batch(batch: ScenarioBatch, *, mesh=None,
                 jax.tree_util.tree_map(lambda x, i=i: x[i], out[3]),
                 telemetry_spec)
             for i in range(s_count)]
+    if handle["signatures"]:
+        res["signatures"] = np.asarray(out[4])
     return res
+
+
+def run_kafka_batch(batch: ScenarioBatch, *, mesh=None,
+                    telemetry_spec=None, signatures: bool = False,
+                    n_windows: int | None = None,
+                    min_rounds: int = 0) -> dict:
+    """S replicated-log campaigns in ONE dispatch: per-scenario seeded
+    send traffic at live nodes (commit-free vectorized staging — the
+    sequential runner's ``commits=False`` regime), the FAULTED
+    origin-union replication path, convergence = every node's presence
+    bitset identical, lost acked writes = allocated slots present at
+    NO node (+ any committed-offset cache exceeding the shared
+    cell)."""
+    return _collect_kafka_batch(_dispatch_kafka_batch(
+        batch, mesh=mesh, telemetry_spec=telemetry_spec,
+        signatures=signatures, n_windows=n_windows,
+        min_rounds=min_rounds))
 
 
 _RUNNERS = {"broadcast": run_broadcast_batch,
             "counter": run_counter_batch,
             "kafka": run_kafka_batch}
+_DISPATCHERS = {"broadcast": _dispatch_broadcast_batch,
+                "counter": _dispatch_counter_batch,
+                "kafka": _dispatch_kafka_batch}
+_COLLECTORS = {"broadcast": _collect_broadcast_batch,
+               "counter": _collect_counter_batch,
+               "kafka": _collect_kafka_batch}
 
 
-def run_scenario_batch(batch: ScenarioBatch, *, mesh=None,
-                       telemetry_spec=None,
-                       pad_to_mesh: bool = True) -> dict:
-    """Dispatch one :class:`ScenarioBatch` (pad to the device count
-    first when a mesh is given, dropping the filler rows from the
-    result) — the fuzzer's unit of work."""
+def dispatch_scenario_batch(batch: ScenarioBatch, *, mesh=None,
+                            telemetry_spec=None,
+                            signatures: bool = False,
+                            n_windows: int | None = None,
+                            min_rounds: int = 0,
+                            pad_to: int | None = None,
+                            pad_to_mesh: bool = True) -> dict:
+    """Pad + enqueue one :class:`ScenarioBatch` and return its async
+    handle WITHOUT blocking on device results — JAX async dispatch
+    keeps the device busy while the host stages or certifies another
+    batch (the depth-2 pipeline in harness.fuzz).  Finish with
+    :func:`collect_scenario_batch`.  ``pad_to`` rounds the scenario
+    count up to a multiple of the given bucket (the shape-bucket
+    knob: a ragged tail batch padded to the same power-of-two count
+    reuses the full batch's compiled program instead of paying a
+    fresh XLA compile)."""
     n_real = len(batch.scenarios)
+    mult = 1
     if mesh is not None and pad_to_mesh:
-        batch, n_real = pad_batch(batch, int(mesh.shape["nodes"]))
-    res = _RUNNERS[batch.workload](batch, mesh=mesh,
-                                   telemetry_spec=telemetry_spec)
+        mult = int(mesh.shape["nodes"])
+    if pad_to:
+        mult = max(mult, int(pad_to))
+    if mult > 1:
+        batch, n_real = pad_batch(batch, mult)
+    handle = _DISPATCHERS[batch.workload](
+        batch, mesh=mesh, telemetry_spec=telemetry_spec,
+        signatures=signatures, n_windows=n_windows,
+        min_rounds=min_rounds)
+    handle["n_real"] = n_real
+    return handle
+
+
+def collect_scenario_batch(handle: dict) -> dict:
+    """Block on + certify a dispatched scenario batch, dropping any
+    mesh-padding filler rows (scenarios, telemetry, signatures) from
+    the result."""
+    res = _COLLECTORS[handle["batch"].workload](handle)
+    n_real = handle["n_real"]
     if n_real < res["n_scenarios"]:
         res["scenarios"] = res["scenarios"][:n_real]
         res["failing"] = [i for i in res["failing"] if i < n_real]
         if "telemetry" in res:
             res["telemetry"] = res["telemetry"][:n_real]
+        if "signatures" in res:
+            res["signatures"] = res["signatures"][:n_real]
         res["n_scenarios"] = n_real
         res["ok"] = not res["failing"]
     return res
+
+
+def run_scenario_batch(batch: ScenarioBatch, *, mesh=None,
+                       telemetry_spec=None, signatures: bool = False,
+                       n_windows: int | None = None,
+                       min_rounds: int = 0,
+                       pad_to: int | None = None,
+                       pad_to_mesh: bool = True) -> dict:
+    """Dispatch one :class:`ScenarioBatch` (pad to the device count
+    first when a mesh is given, dropping the filler rows from the
+    result) — the fuzzer's unit of work.  ``signatures`` appends the
+    per-scenario behavioral signature matrix; ``n_windows`` /
+    ``min_rounds`` / ``pad_to`` are the shape-bucket knobs (pad crash
+    windows / floor the trip count / round the scenario count up)
+    that keep one compiled program hot across heterogeneous
+    campaigns."""
+    return collect_scenario_batch(dispatch_scenario_batch(
+        batch, mesh=mesh, telemetry_spec=telemetry_spec,
+        signatures=signatures, n_windows=n_windows,
+        min_rounds=min_rounds, pad_to=pad_to,
+        pad_to_mesh=pad_to_mesh))
+
+
+# -- serving-frontier batching (PR 13) -----------------------------------
+
+
+@dataclass(frozen=True)
+class ServingCell:
+    """One (offered load x fault x topology) grid cell — JSON-able.
+    ``traffic`` carries the cell's open-loop load (rate/burst/seed/
+    until ride the traced TrafficPlan; client shape must match the
+    batch), ``spec`` the optional nemesis, ``topology`` the broadcast
+    adjacency ("grid"/"tree"; counter/kafka exchange over the KV, so
+    they ignore it), ``coords`` free-form grid coordinates echoed into
+    the verdict rows (the frontier table's axes)."""
+
+    traffic: traffic.TrafficSpec
+    spec: faults.NemesisSpec | None = None
+    topology: str = "grid"
+    coords: tuple = ()
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "coords", tuple(self.coords))
+
+    @property
+    def clear_round(self) -> int:
+        """The cell's fault-clear horizon — ``run_serving``'s
+        ``clear``: traffic horizon, extended to the nemesis clear."""
+        return max(self.traffic.until,
+                   self.spec.clear_round if self.spec else 0)
+
+    def to_meta(self) -> dict:
+        return {"traffic": self.traffic.to_meta(),
+                "spec": (None if self.spec is None
+                         else self.spec.to_meta()),
+                "topology": self.topology,
+                "coords": list(self.coords)}
+
+    @staticmethod
+    def from_meta(meta: dict) -> "ServingCell":
+        return ServingCell(
+            traffic=traffic.TrafficSpec.from_meta(meta["traffic"]),
+            spec=(None if meta.get("spec") is None
+                  else faults.NemesisSpec.from_meta(meta["spec"])),
+            topology=str(meta.get("topology", "grid")),
+            coords=tuple(meta.get("coords", ())))
+
+
+@dataclass(frozen=True)
+class ServingBatch:
+    """S serving cells + the static shape they share — the frontier
+    sweep's unit of work (:func:`run_serving_batch`).  ``runner_kw``
+    holds the per-workload sim statics (broadcast: ``n_values``/
+    ``sync_every``; counter: ``mode``/``poll_every``; kafka:
+    ``n_keys``/``capacity`` (REQUIRED — the sequential default is
+    rate-dependent and a batch mixes rates)/``max_sends``/
+    ``resync_every``)."""
+
+    workload: str
+    cells: tuple = field(default_factory=tuple)
+    runner_kw: dict = field(default_factory=dict)
+    max_recovery_rounds: int = 96
+    drain_every: int = 8
+
+    def __post_init__(self) -> None:
+        if self.workload not in ("broadcast", "counter", "kafka"):
+            raise ValueError(
+                f"unknown serving workload {self.workload!r}")
+        if not self.cells:
+            raise ValueError("a ServingBatch needs >= 1 cell")
+        if self.max_recovery_rounds < 1 or self.drain_every < 1:
+            raise ValueError(
+                "max_recovery_rounds and drain_every must be >= 1")
+        object.__setattr__(self, "cells", tuple(self.cells))
+        c0 = self.cells[0]
+        key = c0.traffic.program_key[:4]
+        for c in self.cells:
+            if c.traffic.program_key[:4] != key:
+                raise ValueError(
+                    "serving batch mixes traffic statics "
+                    f"{key} and {c.traffic.program_key[:4]} — the "
+                    "client shape (n_nodes, n_clients, "
+                    "ops_per_client, intake) is compiled; only "
+                    "rate/kind/burst/seed/until ride the plan")
+            if (c.spec is not None
+                    and c.spec.n_nodes != c0.traffic.n_nodes):
+                raise ValueError(
+                    f"cell nemesis is for {c.spec.n_nodes} nodes, "
+                    f"traffic for {c0.traffic.n_nodes}")
+
+    @property
+    def n_nodes(self) -> int:
+        return self.cells[0].traffic.n_nodes
+
+    def to_meta(self) -> dict:
+        return {"workload": self.workload,
+                "cells": [c.to_meta() for c in self.cells],
+                "runner_kw": dict(self.runner_kw),
+                "max_recovery_rounds": self.max_recovery_rounds,
+                "drain_every": self.drain_every}
+
+    @staticmethod
+    def from_meta(meta: dict) -> "ServingBatch":
+        return ServingBatch(
+            workload=str(meta["workload"]),
+            cells=tuple(ServingCell.from_meta(m)
+                        for m in meta["cells"]),
+            runner_kw=dict(meta.get("runner_kw", {})),
+            max_recovery_rounds=int(meta.get("max_recovery_rounds",
+                                             96)),
+            drain_every=int(meta.get("drain_every", 8)))
+
+
+def pad_serving_batch(batch: ServingBatch, multiple: int) -> tuple:
+    """(padded batch, n_real): duplicate the last cell up to a
+    multiple of ``multiple`` (filler rows are dropped from the
+    results) so a mesh can take scenario placement."""
+    s = len(batch.cells)
+    if multiple <= 1 or s % multiple == 0:
+        return batch, s
+    pad = multiple - s % multiple
+    return ServingBatch(
+        workload=batch.workload,
+        cells=batch.cells + (batch.cells[-1],) * pad,
+        runner_kw=batch.runner_kw,
+        max_recovery_rounds=batch.max_recovery_rounds,
+        drain_every=batch.drain_every), s
+
+
+def serving_loop(step1, all_done, state, ts, clear, drain_every: int,
+                 max_rec: int, r_total: int, tel=None):
+    """ONE serving cell's whole run as a fixed-trip ``fori_loop``
+    (traced; vmapped over the cell axis by the frontier batch
+    programs) — the device twin of harness.serving.run_serving's host
+    loop, BIT-EXACTLY:
+
+    - drive unconditionally to the cell's own ``clear`` round (the
+      sequential driven + fault-outlasting phases), recording ``msgs``
+      when ``t == clear``;
+    - past clear, test "all issued ops completed" ONLY at the drain
+      checkpoints the sequential loop observes — every ``drain_every``
+      rounds, plus the final partial chunk at ``clear + max_rec`` —
+      and record the FIRST satisfied checkpoint round (``fr``; -1 =
+      still-open ops at the bound, the sequential loop's exhausted
+      drain);
+    - freeze the cell (state, tracker, ring) once satisfied or past
+      the bound — exactly where the sequential loop stops driving, so
+      mid-chunk completions keep stepping (and counting msgs) just
+      like the sequential drain chunk runs to its checkpoint.
+
+    ``step1(st, tr, tl, i) -> (st', tr', tl')`` owns the whole traffic
+    round INCLUDING the telemetry row (``tl`` may be None).  Returns
+    ``(state, tracker, fr, msgs_at_clear, tel)``."""
+    bound = clear + jnp.int32(max_rec)
+
+    def check(st, tr, fr):
+        d = st.t - clear
+        at_cp = (d >= jnp.int32(0)) & (
+            (lax.rem(d, jnp.int32(drain_every)) == 0)
+            | (d >= jnp.int32(max_rec)))
+        return jnp.where(at_cp & (fr < 0) & all_done(tr), st.t, fr)
+
+    def freeze(active, new, old):
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(active, a, b), new, old)
+
+    def body(i, carry):
+        st, tr, tl, fr, mc = carry
+        fr = check(st, tr, fr)
+        mc = jnp.where(st.t == clear, st.msgs, mc)
+        active = (fr < 0) & (st.t < bound)
+        s2, t2, tl2 = step1(st, tr, tl, i)
+        st = freeze(active, s2, st)
+        tr = freeze(active, t2, tr)
+        tl = freeze(active, tl2, tl)
+        return (st, tr, tl, fr, mc)
+
+    st, tr, tl, fr, mc = lax.fori_loop(
+        0, r_total, body,
+        (state, ts, tel, jnp.int32(-1), jnp.uint32(0)))
+    fr = check(st, tr, fr)
+    return st, tr, fr, mc, tl
+
+
+def _serving_common(batch: ServingBatch, n_windows, n_burst,
+                    min_rounds):
+    """The workload-independent staging every serving dispatcher
+    shares: stacked traffic plans + trackers, padded fault plans
+    (fault-free cells ride an all-zero plan — value-identical to the
+    sequential plan=None path: zero-threshold coins never fire),
+    per-cell clear rounds, and the common trip count."""
+    cells = batch.cells
+    tplans = traffic.batch_tplans([c.traffic for c in cells], n_burst)
+    trackers = stack_pytrees(
+        [traffic.init_state(c.traffic, None) for c in cells])
+    n = batch.n_nodes
+    specs = [c.spec if c.spec is not None
+             else faults.NemesisSpec(n_nodes=n) for c in cells]
+    plans = faults.batch_plans(specs, n_windows)
+    clears_np = np.array([c.clear_round for c in cells], np.int32)
+    r_total = max(int(clears_np.max()) + batch.max_recovery_rounds,
+                  int(min_rounds))
+    return tplans, trackers, plans, jnp.asarray(clears_np), r_total
+
+
+def _serving_sig(batch: ServingBatch, telemetry_spec, r_total: int):
+    """(ms_col, pg_col, sig_fn) for the serving dispatchers: the
+    serving backpressure class comes from the TRACKER (0 = clean, 1 =
+    deferral-dominated — intake/slot backpressure, 2 = in-flight-
+    dominated — completion stall), the stall/depth buckets from the
+    ring (:func:`signature_eval`)."""
+    ms_col, pg_col = _sig_setup(telemetry_spec, r_total)
+
+    def sig_fn(tr, tlf, fr, clear):
+        inf = (jnp.sum(tr.issued_k)
+               - tr.completed.astype(jnp.int32))
+        de = tr.deferred.astype(jnp.int32)
+        bp = jnp.where((de == jnp.int32(0)) & (inf == jnp.int32(0)),
+                       jnp.int32(0),
+                       jnp.where(de >= inf, jnp.int32(1),
+                                 jnp.int32(2)))
+        return signature_eval(tlf, fr, clear, bp, ms_col, pg_col)
+
+    return sig_fn
+
+
+def dispatch_serving_batch(batch: ServingBatch, *, mesh=None,
+                           telemetry_spec=None,
+                           signatures: bool = False,
+                           n_windows: int | None = None,
+                           n_burst: int | None = None,
+                           min_rounds: int = 0,
+                           pad_to_mesh: bool = True) -> dict:
+    """Stage + enqueue a whole (load x fault x topology) serving grid
+    as ONE compiled, scenario-sharded batch program: per-cell
+    TrafficPlans and FaultPlans stacked leaf-by-leaf, per-cell
+    adjacency stacked as an operand (broadcast), the per-cell
+    :func:`serving_loop` vmapped over the cell axis — zero collective
+    ops, donation over BOTH the stacked sim state and the stacked
+    tracker carry.  Finish with :func:`collect_serving_batch`;
+    ``run_serving_batch`` = collect(dispatch(...)) and documents the
+    knobs.  ``telemetry_spec=True`` builds the default traffic ring
+    sized to the horizon (what ``signatures`` needs)."""
+    from .engine import collectives
+
+    n_real = len(batch.cells)
+    if mesh is not None and pad_to_mesh:
+        batch, n_real = pad_serving_batch(
+            batch, int(mesh.shape["nodes"]))
+    cells = batch.cells
+    s_count = len(cells)
+    n = batch.n_nodes
+    kw = batch.runner_kw
+    tspec0 = cells[0].traffic
+    tplans, trackers, plans, clears, r_total = _serving_common(
+        batch, n_windows, n_burst, min_rounds)
+    if telemetry_spec is True:
+        telemetry_spec = telemetry.TelemetrySpec(
+            workload=batch.workload, rounds=r_total, traffic=True)
+    tl = telemetry_spec is not None
+    tel_mask = telemetry_spec.static_mask if tl else None
+    if tl and telemetry_spec.rounds < r_total:
+        raise ValueError(
+            f"serving telemetry ring must cover the horizon without "
+            f"wrapping: rounds={telemetry_spec.rounds} < "
+            f"r_total={r_total} (the per-cell freeze round indexes "
+            "the unwrapped ring)")
+    sig_fn = (_serving_sig(batch, telemetry_spec, r_total)
+              if signatures else None)
+    coll = collectives(n)
+    ub = traffic.traffic_block(tspec0.n_clients)
+    max_rec, drain_every = batch.max_recovery_rounds, batch.drain_every
+
+    def all_done(tr):
+        return tr.completed >= jnp.sum(
+            tr.issued_k).astype(jnp.uint32)
+
+    if batch.workload == "broadcast":
+        nv = int(kw.get("n_values")
+                 or tspec0.n_clients * tspec0.ops_per_client)
+        sync_every = int(kw.get("sync_every", 4))
+        from ..parallel.topology import (grid, to_padded_neighbors,
+                                         tree)
+        mats = [to_padded_neighbors(
+            {"grid": grid, "tree": tree}[c.topology](n))
+            for c in cells]
+        deg = max(m.shape[1] for m in mats)
+        mats = [np.pad(m, ((0, 0), (0, deg - m.shape[1])),
+                       constant_values=-1) for m in mats]
+        stacked = np.stack(mats)
+        nbrs_b = jnp.asarray(stacked, jnp.int32)      # (S, N, D)
+        mask_b = jnp.asarray(stacked >= 0)
+        sim = B.BroadcastSim(mats[0], n_values=nv,
+                             sync_every=sync_every, srv_ledger=False)
+        sim._traffic_validate(tspec0)
+        dup_on = any(c.spec is not None and c.spec.dup_rate > 0
+                     for c in cells)
+        parts0 = B.Partitions.none(n)
+        states = stack_pytrees([sim.init_state(
+            np.zeros((n, sim.n_words), np.uint32))
+            for _ in range(s_count)])
+
+        def one(state, tr, tplan, plan, nbrs, nbr_mask, clear,
+                *tel_a):
+            def step1(st, t_, tl_c, i):
+                s, t2 = sim._traffic_inject(st, t_, tspec0, tplan,
+                                            plan, coll)
+                s2 = B.flood_step(
+                    s, nbrs=nbrs, nbr_mask=nbr_mask, parts=parts0,
+                    sync_every=sync_every, plan=plan, dup_on=dup_on,
+                    union_block=sim._ub)
+                t2 = sim._traffic_done(s2, t2, tspec0, coll, ub)
+                if tl_c is None:
+                    return s2, t2, None
+                return s2, t2, sim._traffic_tel(s, s2, t2, plan,
+                                                coll, tl_c, tel_mask)
+
+            out = serving_loop(step1, all_done, state, tr, clear,
+                               drain_every, max_rec, r_total,
+                               tel_a[0] if tl else None)
+            st, t2, fr, mc, tlf = out
+            res = (st, t2, fr, mc) + ((tlf,) if tl else ())
+            if signatures:
+                res = res + (sig_fn(t2, tlf, fr, clear),)
+            return res
+
+        args = [states, trackers, tplans, plans, nbrs_b, mask_b,
+                clears]
+        key = ("serving", n, nv, sync_every, dup_on, deg)
+    elif batch.workload == "counter":
+        mode = kw.get("mode", "cas")
+        poll_every = int(kw.get("poll_every", 2))
+        sim = CT.CounterSim(n, mode=mode, poll_every=poll_every)
+        states = stack_pytrees([sim.init_state()
+                                for _ in range(s_count)])
+
+        def one(state, tr, tplan, plan, clear, *tel_a):
+            def step1(st, t_, tl_c, i):
+                out = sim._traffic_round(
+                    st, t_, tspec0, tplan, sim.kv_sched, coll, plan,
+                    ub, tl_c, tel_mask)
+                return out if tl_c is not None else out + (None,)
+
+            out = serving_loop(step1, all_done, state, tr, clear,
+                               drain_every, max_rec, r_total,
+                               tel_a[0] if tl else None)
+            st, t2, fr, mc, tlf = out
+            res = (st, t2, fr, mc) + ((tlf,) if tl else ())
+            if signatures:
+                res = res + (sig_fn(t2, tlf, fr, clear),)
+            return res
+
+        args = [states, trackers, tplans, plans, clears]
+        key = ("serving", n, mode, poll_every)
+    else:
+        if "capacity" not in kw:
+            raise ValueError(
+                "kafka serving batches need an explicit "
+                "runner_kw['capacity']: the sequential default is "
+                "sized from the cell's rate, and a frontier batch "
+                "mixes rates (one compiled shape per batch)")
+        n_keys = int(kw.get("n_keys", 16))
+        capacity = int(kw["capacity"])
+        max_sends = int(kw.get("max_sends", 4))
+        resync_every = int(kw.get("resync_every", 4))
+        sim = KF.KafkaSim(n, n_keys, capacity=capacity,
+                          max_sends=max_sends,
+                          resync_every=resync_every)
+        # the helper sim carries no FaultPlan, so its own
+        # _repl_mode() would pick the nemesis-blind "union" path;
+        # an ACTIVE batch must ride "union_nem" (inert/zero plans
+        # are value-identical there: zero-threshold coins never
+        # fire, the resync cadence gates on TRACED plan activity —
+        # kafka._step — and the msgs ledger is repl_mode-blind)
+        active = any(c.spec is not None
+                     and (len(c.spec.crash) > 0
+                          or (c.spec.loss_rate > 0
+                              and c.spec.loss_until > 0))
+                     for c in cells)
+        repl_mode = "union_nem" if active else "union"
+        tel_full = (tl and "present_bits_full"
+                    in telemetry_spec.series)
+        states = stack_pytrees([sim.init_state()
+                                for _ in range(s_count)])
+
+        def one(state, tr, tplan, plan, clear, *tel_a):
+            def step1(st, t_, tl_c, i):
+                out = sim._traffic_round(
+                    st, t_, tspec0, tplan, sim.kv_sched, coll, plan,
+                    repl_mode, ub, tl_c, tel_mask, tel_full)
+                return out if tl_c is not None else out + (None,)
+
+            out = serving_loop(step1, all_done, state, tr, clear,
+                               drain_every, max_rec, r_total,
+                               tel_a[0] if tl else None)
+            st, t2, fr, mc, tlf = out
+            res = (st, t2, fr, mc) + ((tlf,) if tl else ())
+            if signatures:
+                res = res + (sig_fn(t2, tlf, fr, clear),)
+            return res
+
+        args = [states, trackers, tplans, plans, clears]
+        key = ("serving", n, n_keys, capacity, max_sends,
+               resync_every, repl_mode)
+
+    dn = (0, 1) + ((len(args),) if tl else ())
+    if tl:
+        args.append(stack_pytrees(
+            [telemetry.init_state(telemetry_spec)
+             for _ in range(s_count)]))
+    args = _place(tuple(args), mesh)
+    prog = _build_batch_program(
+        f"serving-{batch.workload}", one, args, mesh, dn,
+        key=key + (s_count, r_total, drain_every, max_rec,
+                   tspec0.program_key, telemetry_spec, signatures,
+                   int(plans.starts.shape[1])))
+    out = prog(*args)
+    return {"out": out, "batch": batch, "n_real": n_real,
+            "telemetry_spec": telemetry_spec,
+            "signatures": signatures, "r_total": r_total}
+
+
+def collect_serving_batch(handle: dict) -> dict:
+    """Block on + certify a dispatched serving batch: per-cell
+    latency summary, the EXACT sequential converged-round rule, the
+    sequential per-cell ``check_recovery`` verdict (open in-flight
+    ops = lost acked writes), conservation ANDed in — then drop any
+    mesh-padding filler cells.  Wall-clock fields are deliberately
+    absent (one dispatch serves the whole grid; throughput belongs to
+    the benchmark that timed it)."""
+    from ..harness.checkers import check_recovery
+
+    out, batch = handle["out"], handle["batch"]
+    telemetry_spec = handle["telemetry_spec"]
+    tl = telemetry_spec is not None
+    n_real = handle["n_real"]
+    cells = batch.cells[:n_real]
+    final, trackers, fr, mc = out[0], out[1], out[2], out[3]
+    fr_np = np.asarray(fr)
+    mc_np = np.asarray(mc)
+    msgs_np = np.asarray(final.msgs)
+    max_rec = batch.max_recovery_rounds
+    rows, failing, all_ok = [], [], True
+    for i, cell in enumerate(cells):
+        ts_i = jax.tree_util.tree_map(lambda x, i=i: x[i], trackers)
+        summ = traffic.latency_summary(ts_i)
+        clear = cell.clear_round
+        done_r = np.asarray(ts_i.done_round)
+        if summ["issued"] == 0:
+            converged_round = clear
+        elif summ["in_flight"] == 0:
+            converged_round = max(clear, int(done_r.max()))
+        else:
+            converged_round = None
+        lost = ([{"open_ops": summ["in_flight"]}]
+                if summ["in_flight"] else [])
+        ok, det = check_recovery(
+            clear_round=clear, converged_round=converged_round,
+            max_recovery_rounds=max_rec, lost_writes=lost,
+            msgs_at_clear=int(mc_np[i]),
+            msgs_at_converged=int(msgs_np[i]), latency=summ)
+        ok = ok and summ["conserved"]
+        drained = (int(fr_np[i]) - clear if fr_np[i] >= 0
+                   else max_rec)
+        total_rounds = clear + drained
+        det.update(
+            workload=batch.workload, cell=i,
+            coords=list(cell.coords), topology=cell.topology,
+            n_nodes=batch.n_nodes, traffic=cell.traffic.to_meta(),
+            **summ,
+            offered_per_round=traffic.offered_per_round(cell.traffic),
+            sustained_per_round=summ["completed"] / max(1,
+                                                        total_rounds),
+            driven_rounds=cell.traffic.until,
+            total_rounds=total_rounds,
+            msgs_total=int(msgs_np[i]), ok=ok)
+        if cell.spec is not None:
+            det["spec"] = cell.spec.to_meta()
+        rows.append(det)
+        if not ok:
+            failing.append(i)
+        all_ok = all_ok and ok
+    res = {"ok": all_ok, "workload": batch.workload,
+           "n_cells": len(cells), "failing": failing, "cells": rows,
+           "final": final, "trackers": trackers}
+    if tl:
+        res["telemetry"] = [
+            telemetry.series_arrays(
+                jax.tree_util.tree_map(lambda x, i=i: x[i], out[4]),
+                telemetry_spec)
+            for i in range(len(cells))]
+    if handle["signatures"]:
+        sig = np.asarray(out[5 if tl else 4])
+        res["signatures"] = sig[:len(cells)]
+        for i, row in enumerate(rows):
+            row["signature"] = [int(v) for v in sig[i]]
+    return res
+
+
+def run_serving_batch(batch: ServingBatch, *, mesh=None,
+                      telemetry_spec=None, signatures: bool = False,
+                      n_windows: int | None = None,
+                      n_burst: int | None = None,
+                      min_rounds: int = 0,
+                      pad_to_mesh: bool = True) -> dict:
+    """A whole (offered load x fault x topology) serving grid in ONE
+    compiled, zero-collective batch dispatch — per-cell p50/p99/max
+    latency, sustained throughput, backpressure counts, and
+    ``check_recovery`` verdicts, BIT-EXACT against sequential
+    ``run_serving`` rows (tests/test_frontier.py pins single-device
+    and 8-way mesh).  ``signatures`` appends the per-cell (4,)
+    behavioral signature (requires a telemetry ring covering the
+    horizon; pass ``telemetry_spec=True`` for the default);
+    ``n_windows``/``n_burst``/``min_rounds`` are the shape-bucket
+    knobs (pad crash windows / burst windows / floor the trip count)
+    that keep ONE compiled program hot across heterogeneous grids."""
+    return collect_serving_batch(dispatch_serving_batch(
+        batch, mesh=mesh, telemetry_spec=telemetry_spec,
+        signatures=signatures, n_windows=n_windows, n_burst=n_burst,
+        min_rounds=min_rounds, pad_to_mesh=pad_to_mesh))
 
 
 # -- program contracts (tpu_sim/audit.py registry) -----------------------
@@ -751,6 +1556,21 @@ def batch_state_bytes(workload: str, s_local: int, n: int, *,
         per = (n * n_keys * wc * 4 + n_keys * capacity * 4
                + n_keys * 4 + n * n_keys * 4)
     return s_local * per
+
+
+def serving_state_bytes(workload: str, s_local: int, n: int,
+                        n_clients: int, ops_per_client: int, *,
+                        nv: int = 0, n_keys: int = 0,
+                        capacity: int = 0) -> int:
+    """Per-shard donated bytes of a serving-frontier batch program:
+    the sim state (:func:`batch_state_bytes`) PLUS the stacked per-op
+    tracker carry — ``issued_k`` (C,) + the three (C, K) op tables +
+    the three scalar counters, all 4-byte — which the frontier
+    programs donate alongside the state (donate_argnums (0, 1))."""
+    tracker = 4 * (n_clients + 3 * n_clients * ops_per_client + 3)
+    return (batch_state_bytes(workload, s_local, n, nv=nv,
+                              n_keys=n_keys, capacity=capacity)
+            + s_local * tracker)
 
 
 def audit_contracts():
@@ -834,6 +1654,99 @@ def audit_contracts():
                             analytic_peak_bytes=analytic[
                                 "peak_live_bytes"])
 
+    def _cells(n, s, until=10, n_clients=None):
+        n_clients = n_clients or n
+        out = []
+        for i in range(s):
+            spec = (None if i % 2 == 0 else faults.random_spec(
+                n, seed=i + 1, horizon=until, n_crash_windows=1,
+                loss_rate=0.1))
+            out.append(ServingCell(
+                traffic=traffic.TrafficSpec(
+                    n_nodes=n, n_clients=n_clients, ops_per_client=2,
+                    until=until, rate=0.2 + 0.1 * (i % 3), seed=i),
+                spec=spec,
+                topology="tree" if i % 4 == 3 else "grid",
+                coords=(i % 3, i % 2, i % 4 == 3)))
+        return tuple(out)
+
+    def _serving_runner(b, mesh):
+        return run_serving_batch(b, mesh=mesh)
+
+    def broadcast_frontier(mesh):
+        n, s = 16, 16
+        batch = ServingBatch(
+            workload="broadcast", cells=_cells(n, s),
+            runner_kw={"sync_every": 4}, max_recovery_rounds=16,
+            drain_every=4)
+        prog, args = _audit_program("broadcast", batch, mesh,
+                                    runner=_serving_runner)
+        s_local = s // (1 if mesh is None else 8)
+        nv = n * 2
+        state_bytes = serving_state_bytes("broadcast", s_local, n,
+                                          n, 2, nv=nv)
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes,
+            operand_bytes=engine_operand_bytes(
+                (faults.batch_plans(
+                    [c.spec or faults.NemesisSpec(n_nodes=n)
+                     for c in batch.cells]),
+                 traffic.batch_tplans(
+                     [c.traffic for c in batch.cells]))),
+            slab_bytes=s_local * n * ((nv + 31) // 32) * 4)
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    def counter_frontier(mesh):
+        n, s = 16, 16
+        batch = ServingBatch(
+            workload="counter", cells=_cells(n, s),
+            runner_kw={"mode": "cas", "poll_every": 2},
+            max_recovery_rounds=16, drain_every=4)
+        prog, args = _audit_program("counter", batch, mesh,
+                                    runner=_serving_runner)
+        s_local = s // (1 if mesh is None else 8)
+        state_bytes = serving_state_bytes("counter", s_local, n,
+                                          n, 2)
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes,
+            operand_bytes=engine_operand_bytes(
+                (faults.batch_plans(
+                    [c.spec or faults.NemesisSpec(n_nodes=n)
+                     for c in batch.cells]),
+                 traffic.batch_tplans(
+                     [c.traffic for c in batch.cells]))),
+            slab_bytes=s_local * n * 4)
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    def kafka_frontier(mesh):
+        n, s = 8, 16
+        batch = ServingBatch(
+            workload="kafka", cells=_cells(n, s),
+            runner_kw={"n_keys": 4, "capacity": 32, "max_sends": 2,
+                       "resync_every": 2},
+            max_recovery_rounds=12, drain_every=4)
+        prog, args = _audit_program("kafka", batch, mesh,
+                                    runner=_serving_runner)
+        s_local = s // (1 if mesh is None else 8)
+        state_bytes = serving_state_bytes("kafka", s_local, n, n, 2,
+                                          n_keys=4, capacity=32)
+        analytic = analytic_peak_bytes(
+            state_bytes=state_bytes,
+            operand_bytes=engine_operand_bytes(
+                (faults.batch_plans(
+                    [c.spec or faults.NemesisSpec(n_nodes=n)
+                     for c in batch.cells]),
+                 traffic.batch_tplans(
+                     [c.traffic for c in batch.cells]))),
+            slab_bytes=s_local * n * n * 4)
+        return AuditProgram(prog, args, donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
     return [
         ProgramContract(
             name="broadcast/scenario-batch-run",
@@ -865,17 +1778,48 @@ def audit_contracts():
                   "faulted origin-union path: the batched program "
                   "keeps the union elementwise per scenario — no "
                   "all-gather, no ppermute, no matmul mask"),
+        ProgramContract(
+            name="broadcast/frontier-batch-run",
+            build=broadcast_frontier,
+            collectives={},
+            donation=True,
+            mem_lo=0.01, mem_hi=16.0,
+            notes="serving-frontier batch (PR 13): a whole load x "
+                  "fault x topology grid as ONE scenario-sharded "
+                  "dispatch — zero collective ops; donation covers "
+                  "the stacked sim state AND the stacked per-op "
+                  "tracker carry"),
+        ProgramContract(
+            name="counter/frontier-batch-run",
+            build=counter_frontier,
+            collectives={},
+            donation=True,
+            mem_lo=0.01, mem_hi=20.0,
+            notes="counter serving-frontier batch: cap-0 census, "
+                  "stacked state + tracker donation (PR 13)"),
+        ProgramContract(
+            name="kafka/frontier-batch-run",
+            build=kafka_frontier,
+            collectives={},
+            donation=True,
+            mem_lo=0.01, mem_hi=20.0,
+            notes="kafka serving-frontier batch on the explicit "
+                  "union_nem/union replication path: cap-0 census, "
+                  "stacked state + tracker donation (PR 13)"),
     ]
 
 
-def _audit_program(workload: str, batch: ScenarioBatch, mesh):
+def _audit_program(workload: str, batch, mesh, runner=None):
     """(jitted, example_args) of a batch driver: run the runner once
     with :func:`engine.scenario_program` intercepted so the EXACT
     jitted object the batch executed (and its staged operand shapes)
     is what the contract auditor lowers — the ``audit_step_program``
     convention, applied to the batch drivers.  The runner DONATES its
     state args, so the captured operands are handed back as
-    ``ShapeDtypeStruct`` leaves (lowering needs avals, not buffers)."""
+    ``ShapeDtypeStruct`` leaves (lowering needs avals, not buffers).
+    ``runner`` overrides the default ``_RUNNERS[workload]`` entry —
+    the serving-frontier contracts pass :func:`run_serving_batch`
+    (same interception, different batch driver)."""
     import contextlib
 
     captured = {}
@@ -890,10 +1834,14 @@ def _audit_program(workload: str, batch: ScenarioBatch, mesh):
             for a in example_args)
         return prog
 
+    if runner is None:
+        def runner(b, m):
+            return _RUNNERS[workload](b, mesh=m)
+
     import gossip_glomers_tpu.tpu_sim.scenario as _self
     with contextlib.ExitStack() as stack:
         stack.callback(setattr, _self, "scenario_program", orig)
         setattr(_self, "scenario_program", capture)
         _PROGS.clear()
-        _RUNNERS[workload](batch, mesh=mesh)
+        runner(batch, mesh)
     return captured["prog"], captured["args"]

@@ -64,11 +64,12 @@ from .engine import _env_int
 # pattern): the determinism lint (tpu_sim/audit.py) treats exactly
 # TRACED_EVALUATORS as traced scope; tests/test_telemetry.py pins the
 # split TOTAL so new traced telemetry code can never dodge the lint.
-TRACED_EVALUATORS = ("record", "live_count")
+TRACED_EVALUATORS = ("record", "live_count", "ring_stall_round",
+                     "ring_progress_depth", "log2_bucket")
 HOST_SIDE = (
     "series_names", "enabled", "env_series", "init_state",
     "state_specs", "ring_rows", "series_arrays", "default_spec",
-    "tel_key", "audit_contracts")
+    "tel_key", "signature_columns", "audit_contracts")
 
 # canonical per-workload series, in ring-column order.  Totals vs
 # gauges per the module docstring.  broadcast: frontier_bits = bits
@@ -234,6 +235,86 @@ def live_count(plan, t, n_nodes: int) -> jnp.ndarray:
     ids = jnp.arange(n_nodes, dtype=jnp.int32)
     return jnp.sum(faults.node_up(plan, t, ids).astype(jnp.uint32),
                    dtype=jnp.uint32)
+
+
+# -- ring-derived behavioral signature components (PR 13) -----------------
+#
+# The coverage observatory reduces a recorded run to a tiny integer
+# signature WITHOUT new host callbacks: every component below reads the
+# telemetry ring the run already carries.  The helpers assume the
+# caller sized the ring to cover the whole run (rounds >= total driven
+# rounds, the frontier runner contract) so row ``t`` IS round ``t`` —
+# no wrap arithmetic in traced scope.
+
+
+def ring_stall_round(ring, wrote, col: int, conv_round) -> jnp.ndarray:
+    """() int32 — the FIRST recorded round ``t >= 1`` whose ``col``
+    running total did not move (``ring[t, col] == ring[t-1, col]``)
+    while the run was still unconverged (``conv_round < 0`` or
+    ``t < conv_round``); -1 when the column climbs every pre-convergence
+    round.  With ``col`` = the msgs ledger this is the first-divergence
+    round of the signature: the round the protocol first went quiet
+    before finishing (traced; replicated inputs -> replicated scalar,
+    zero collectives)."""
+    r = ring.shape[0]
+    t = jnp.arange(r, dtype=jnp.int32)
+    vals = ring[:, col]
+    prev = jnp.concatenate([vals[:1], vals[:-1]])
+    valid = (t >= 1) & (t < jnp.minimum(
+        wrote.astype(jnp.int32), jnp.int32(r)))
+    cr = jnp.asarray(conv_round, jnp.int32)
+    unconv = (cr < 0) | (t < cr)
+    stalled = valid & unconv & (vals == prev)
+    first = jnp.min(jnp.where(stalled, t, jnp.int32(r)))
+    return jnp.where(first >= r, jnp.int32(-1), first)
+
+
+def ring_progress_depth(ring, wrote, col: int) -> jnp.ndarray:
+    """() int32 — the LAST recorded round ``t >= 1`` whose ``col``
+    value changed vs the previous row; -1 when the column is flat after
+    round 0.  With ``col`` = the workload's progress gauge (broadcast
+    ``known_bits``, counter ``kv_total``, kafka ``present_bits``) this
+    is the critical-path depth of the dissemination: the final round
+    at which NEW information still landed — for broadcast it equals the
+    maximum provenance arrival round (pinned against
+    ``provenance.depth_of`` by tests)."""
+    r = ring.shape[0]
+    t = jnp.arange(r, dtype=jnp.int32)
+    vals = ring[:, col]
+    prev = jnp.concatenate([vals[:1], vals[:-1]])
+    valid = (t >= 1) & (t < jnp.minimum(
+        wrote.astype(jnp.int32), jnp.int32(r)))
+    changed = valid & (vals != prev)
+    return jnp.max(jnp.where(changed, t, jnp.int32(-1)))
+
+
+def log2_bucket(x, n_buckets: int = 14) -> jnp.ndarray:
+    """() int32 — coarse log2 bucket for a signature component: -1 for
+    negative sentinels, else the count of powers of two <= x (0 -> 0,
+    1 -> 1, 2..3 -> 2, 4..7 -> 3, ... capped at ``n_buckets``).  A
+    threshold sum, not a float log — traced, exact, branch-free."""
+    xi = jnp.asarray(x, jnp.int32)
+    b = jnp.int32(0)
+    for k in range(n_buckets):
+        b = b + jnp.where(xi >= jnp.int32(1 << k), 1, 0).astype(
+            jnp.int32)
+    return jnp.where(xi < 0, jnp.int32(-1), b)
+
+
+def signature_columns(spec: TelemetrySpec) -> tuple[int, int]:
+    """(msgs_col, progress_col) ring-column indices the signature
+    evaluator reads for this spec's workload.  Loud contract: both
+    columns must actually be RECORDED by the spec (a subset that
+    dropped them would hand the evaluator statically-zeroed rows)."""
+    progress = {"broadcast": "known_bits", "counter": "kv_total",
+                "kafka": "present_bits"}[spec.workload]
+    missing = [s for s in ("msgs", progress) if s not in spec.series]
+    if missing:
+        raise ValueError(
+            f"behavioral signatures need telemetry series {missing} "
+            f"recorded for workload {spec.workload!r}; got "
+            f"series={list(spec.series)}")
+    return spec.names.index("msgs"), spec.names.index(progress)
 
 
 # -- env knobs ------------------------------------------------------------

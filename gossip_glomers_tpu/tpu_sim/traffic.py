@@ -80,7 +80,8 @@ TRACED_EVALUATORS = (
 HOST_SIDE = (
     "plan_specs", "state_specs", "init_state", "client_nodes",
     "host_arrivals", "traffic_block", "latency_summary",
-    "per_round_series", "offered_per_round")
+    "per_round_series", "offered_per_round", "pad_tplan",
+    "batch_tplans")
 
 # distinct stream salts off the shared (seed, t, id) counter family
 _SALT_ARRIVE = 0x1B873593
@@ -256,6 +257,73 @@ class TrafficSpec:
         its rates."""
         return (self.n_nodes, self.n_clients, self.ops_per_client,
                 self.intake, len(self.burst))
+
+
+# -- scenario-axis batching (PR 13, the faults.pad_plan/batch_plans
+#    mirror) --------------------------------------------------------------
+#
+# Padding semantics: a pad burst window is ``[0, 0)`` with a zero
+# in-window threshold — ``b_starts[w] <= t < b_ends[w]`` is
+# unsatisfiable at every t, so the windows_fold in :func:`_arrival_num`
+# treats it as never-active and a padded plan draws BIT-IDENTICAL
+# arrival coins (pinned by tests/test_frontier.py).  All specs in a
+# batch must share the STATIC program_key fields (n_nodes, n_clients,
+# ops_per_client, intake — they shape the compiled program); rate,
+# seed, kind, horizon and the burst values stack into (S,) / (S, B)
+# traced operands, exactly like a batched FaultPlan.
+
+
+def pad_tplan(plan: TrafficPlan, n_burst: int) -> TrafficPlan:
+    """Pad a compiled traffic plan's burst-window axis to ``n_burst``
+    with never-active ``[0, 0)`` windows (see above).  Evaluation is
+    bit-identical — the pad windows fold as inactive at every round."""
+    b = int(plan.b_starts.shape[0])
+    if b > n_burst:
+        raise ValueError(
+            f"plan has {b} burst windows, cannot pad to {n_burst}")
+    if b == n_burst:
+        return plan
+    pad = n_burst - b
+    return plan._replace(
+        b_starts=jnp.concatenate(
+            [plan.b_starts, jnp.zeros((pad,), jnp.int32)]),
+        b_ends=jnp.concatenate(
+            [plan.b_ends, jnp.zeros((pad,), jnp.int32)]),
+        b_num=jnp.concatenate(
+            [plan.b_num, jnp.zeros((pad,), jnp.uint32)]))
+
+
+def batch_tplans(specs, n_burst: int | None = None) -> TrafficPlan:
+    """Compile + pad + stack a sequence of :class:`TrafficSpec`s into
+    ONE batched :class:`TrafficPlan` with a leading scenario axis:
+    scalars ``(S,)``, burst windows ``(S, B)``.  The serving batch
+    drivers (tpu_sim/scenario.py) vmap over the leading axis, so each
+    grid cell evaluates exactly its own (padded) arrival schedule.
+    ``n_burst`` overrides the padded window count (the fuzzer's
+    shape-bucket knob — a power-of-two bucket keeps one compiled
+    program across campaigns)."""
+    specs = list(specs)
+    if not specs:
+        raise ValueError("batch_tplans needs at least one spec")
+    key = specs[0].program_key[:4]
+    for sp in specs:
+        if sp.program_key[:4] != key:
+            raise ValueError(
+                "traffic batch mixes static shapes "
+                f"{key} and {sp.program_key[:4]} — n_nodes, "
+                "n_clients, ops_per_client and intake must be "
+                "uniform across a batch (rate/seed/kind/until/burst "
+                "values ride the traced plan)")
+    b_max = max(len(sp.burst) for sp in specs)
+    if n_burst is not None:
+        if n_burst < b_max:
+            raise ValueError(
+                f"n_burst={n_burst} < the batch's widest burst "
+                f"count {b_max}")
+        b_max = n_burst
+    plans = [pad_tplan(sp.compile(), b_max) for sp in specs]
+    return TrafficPlan(*(jnp.stack([p[i] for p in plans])
+                         for i in range(len(TrafficPlan._fields))))
 
 
 # -- device-side arrival evaluation --------------------------------------

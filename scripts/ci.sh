@@ -62,6 +62,16 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 # sequentially.)
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/fuzz_smoke.py || rc=1
+# Frontier smoke (PR 13): a 16-cell (load x fault x topology)
+# serving-frontier grid certified in scenario-sharded batch
+# dispatches on the 8-way virtual mesh — per-cell SLO surfaces with
+# on-device behavioral signatures, schema-valid frontier report +
+# coverage map + Perfetto timeline, and a PLANTED p99 SLO violation
+# that fails naming its grid coordinates, writes a flight bundle
+# (TrafficSpec + NemesisSpec + coords), and replays to the same
+# check_slo failure from the bundle's JSON alone.  (CPU, seconds.)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/frontier_smoke.py || rc=1
 # Program-contract audit (PR 6): every registered driver contract
 # (collective census, donation alias table, host boundary, memory
 # band) on the CPU 8-way virtual mesh, plus the AST determinism lint

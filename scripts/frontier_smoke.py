@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""CI frontier smoke (PR 13): a small serving-frontier cartography
+run end to end through the coverage observatory and the SLO flight
+recorder.
+
+Asserts the whole (load x fault x topology) pipeline:
+
+- a 16-cell frontier grid certified in scenario-sharded batch
+  dispatches on the 8-way virtual CPU mesh, bit-exact per-cell
+  latency/throughput surfaces with behavioral signatures recorded
+  on-device (tpu_sim/scenario.py, harness/frontier.py);
+- the frontier report is schema-valid (observe.validate_frontier),
+  its coverage map is consistent, and the Perfetto timeline renders
+  + validates;
+- a PLANTED SLO violation (p99 bound below the achievable floor on
+  the loss+crash row) fails loudly naming its grid coordinates, its
+  flight bundle is WRITTEN with the TrafficSpec + NemesisSpec + grid
+  coords, and ``replay_bundle`` reproduces the SAME check_slo
+  failure from the bundle's JSON alone with a divergence-free
+  record;
+- artifacts land in ``artifacts/frontier_smoke/`` (uploaded by CI).
+
+Exit nonzero on any failed assertion.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gossip_glomers_tpu.parallel.mesh import force_virtual_devices  # noqa: E402
+
+force_virtual_devices(8)
+
+import jax                                                  # noqa: E402
+import numpy as np                                          # noqa: E402
+from jax.sharding import Mesh                               # noqa: E402
+
+from gossip_glomers_tpu.harness import frontier as FR       # noqa: E402
+from gossip_glomers_tpu.harness import observe              # noqa: E402
+from gossip_glomers_tpu.harness.checkers import check_slo   # noqa: E402
+
+OUT = pathlib.Path(__file__).resolve().parent.parent \
+    / "artifacts" / "frontier_smoke"
+
+
+def main() -> int:
+    OUT.mkdir(parents=True, exist_ok=True)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("nodes",))
+    cells = FR.frontier_grid(
+        "broadcast", n_nodes=16,
+        rates=(0.2, 0.4, 0.6, 0.8),
+        fault_levels=(None, {"n_crash_windows": 1,
+                             "loss_rate": 0.15}),
+        topologies=("grid", "tree"), until=10, seed=3)
+    rep = FR.run_frontier(
+        "broadcast", cells, mesh=mesh, batch_size=8,
+        slo={"p99_max_rounds": 1, "min_completed": 1},
+        max_recovery_rounds=24, drain_every=4,
+        observe_dir=str(OUT))
+    print(f"frontier: {rep['n_cells']} cells in "
+          f"{rep['n_batches']} batches "
+          f"({'pipelined' if rep['pipelined'] else 'sync'}), "
+          f"{rep['cells_per_sec']}/s, "
+          f"{rep['coverage']['n_distinct']} distinct behaviors, "
+          f"{len(rep['failing'])} SLO-failing")
+    ok = True
+
+    def check(cond: bool, msg: str) -> None:
+        nonlocal ok
+        print(("ok  " if cond else "FAIL") + f" {msg}")
+        ok = ok and cond
+
+    check(rep["n_cells"] == 16, "16-cell grid dispatched")
+    observe.validate_frontier(rep)   # schema-valid or raises
+    check(True, "frontier report schema valid (validate_frontier)")
+    check(all(c["ok"] for c in rep["cells"]),
+          "every cell passed the serving certifier "
+          "(drain/conservation)")
+    check(rep["coverage"]["n_seen"] == 16,
+          "one behavioral signature recorded per cell")
+    check(rep["coverage"]["n_distinct"] >= 2,
+          "the surface exercises >= 2 distinct behaviors")
+    tl = FR.frontier_timeline(rep)
+    observe.validate_timeline(tl)
+    check(any(ev.get("name") == "coverage/distinct_behaviors"
+              for ev in tl["traceEvents"]),
+          "Perfetto timeline renders coverage counters")
+    (OUT / "frontier_timeline.json").write_text(
+        json.dumps(tl) + "\n")
+
+    # the planted p99 SLO (1 round) is below the achievable floor,
+    # so cells fail loudly naming their grid coordinates
+    check(len(rep["failing"]) >= 1, "planted SLO violation detected")
+    check(any("p99 latency" in p for p in rep["problems"]),
+          "violation names the broken bound")
+    check(any(p.startswith("cell(") for p in rep["problems"]),
+          "violation names the cell's grid coordinates")
+    check(len(rep["bundles"]) == len(rep["failing"]),
+          "one flight bundle per SLO-failing cell")
+    b = rep["bundles"][0]
+    bundle = observe.load_bundle(b["path"])
+    check(bundle["kind"] == "serving"
+          and bundle["failure"]["checker"] == "check_slo"
+          and bundle["failure"]["grid_coords"] == b["coords"],
+          f"bundle carries traffic+fault+coords ({b['path']})")
+    replay = observe.replay_bundle(b["path"])
+    ok_r, det_r = check_slo(replay, **bundle["failure"]["slo"],
+                            coords=bundle["failure"]["grid_coords"])
+    check(not ok_r, "independent replay fails the SAME check_slo")
+    check(replay.get("first_divergence_round") is None,
+          "independent replay is divergence-free")
+
+    (OUT / "frontier_smoke_report.json").write_text(json.dumps(
+        {k: v for k, v in rep.items() if k != "cells"},
+        indent=1, default=str) + "\n")
+    print("frontier smoke", "OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

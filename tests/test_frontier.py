@@ -1,0 +1,388 @@
+"""Serving-frontier cartography + coverage observatory (PR 13:
+harness/frontier.py + the serving batch programs in
+tpu_sim/scenario.py): batched-vs-sequential serving parity
+(single-device AND 8-way scenario-sharded mesh, message ledgers
+included), the falsifiable check_slo certifier (a planted p99
+violation in one of 64 cells fails loudly naming its grid
+coordinates), coverage-map determinism across batch shapes /
+pipelining / GG_TRAFFIC_BLOCK sizes, flight-bundle replay for
+SLO-failing grid cells, the serving shrinker's traffic moves, the
+fuzzer's shape-bucket + pipelined dispatch parity, and the
+traced/host split totality that keeps the PR-6 determinism lint
+covering the new module.
+"""
+
+import ast as ast_mod
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from gossip_glomers_tpu.harness import frontier as FR
+from gossip_glomers_tpu.harness import fuzz as FZ
+from gossip_glomers_tpu.harness import observe, serving
+from gossip_glomers_tpu.harness.checkers import (check_frontier_batch,
+                                                 check_slo)
+from gossip_glomers_tpu.tpu_sim import audit
+from gossip_glomers_tpu.tpu_sim import faults as F
+from gossip_glomers_tpu.tpu_sim import scenario as SC
+from gossip_glomers_tpu.tpu_sim import traffic as T
+
+PARITY_KEYS = ("arrived", "issued", "deferred", "completed",
+               "in_flight", "conserved", "lat_p50", "lat_p99",
+               "lat_max", "msgs_total", "total_rounds",
+               "converged_round", "recovery_rounds", "ok")
+
+
+def mesh_1d():
+    return Mesh(np.array(jax.devices()).reshape(8), ("nodes",))
+
+
+def _tspec(n=8, rate=0.3, seed=1, **kw):
+    return T.TrafficSpec(n_nodes=n, n_clients=n, ops_per_client=2,
+                         until=8, rate=rate, seed=seed, **kw)
+
+
+def _assert_cell_parity(workload, cells, runner_kw, *, mrr=16, de=4,
+                        mesh=None, **batch_kw):
+    batch = SC.ServingBatch(workload=workload, cells=tuple(cells),
+                            runner_kw=runner_kw,
+                            max_recovery_rounds=mrr, drain_every=de)
+    res = SC.run_serving_batch(batch, mesh=mesh, **batch_kw)
+    for i, c in enumerate(cells):
+        sim_kw = dict(runner_kw)
+        if workload == "broadcast":
+            sim_kw["topology"] = c.topology
+        seq = serving.run_serving(workload, c.traffic,
+                                  nemesis=c.spec, sim_kw=sim_kw,
+                                  max_recovery_rounds=mrr,
+                                  drain_every=de)
+        row = res["cells"][i]
+        for k in PARITY_KEYS:
+            assert seq.get(k) == row.get(k), (workload, i, k,
+                                              seq.get(k),
+                                              row.get(k))
+    return res
+
+
+# -- the falsifiable SLO certifier ---------------------------------------
+
+
+def _passing_row(i):
+    return {"cell": i, "coords": [i // 16, (i // 4) % 4, i % 4],
+            "completed": 5, "conserved": True, "lat_p50": 2.0,
+            "lat_p99": 3.0, "lat_max": 4, "in_flight": 0,
+            "sustained_per_round": 0.5, "converged_round": 10,
+            "recovery_rounds": 2}
+
+
+def test_check_slo_planted_p99_violation_names_grid_coords():
+    """One planted p99 violation in a 64-cell surface fails LOUDLY
+    and the problem string names the offending cell's grid
+    coordinates — nothing needs re-running to locate it."""
+    rows = [_passing_row(i) for i in range(64)]
+    ok, det = check_frontier_batch(rows, {"p99_max_rounds": 8.0})
+    assert ok and det["n_ok"] == 64
+    rows[37]["lat_p99"] = 40.0
+    ok, det = check_frontier_batch(rows, {"p99_max_rounds": 8.0})
+    assert not ok
+    assert det["failing"] == [37]
+    assert "cell(2, 1, 1)" in det["problems"][0]
+    assert "p99 latency 40.0" in det["problems"][0]
+
+
+def test_check_slo_every_bound_is_falsifiable():
+    r = _passing_row(0)
+    assert check_slo(r, p99_max_rounds=8)[0]
+    assert not check_slo(r, p99_max_rounds=2.5)[0]
+    assert not check_slo(r, max_rounds=3)[0]
+    assert not check_slo(dict(r, completed=0), min_completed=1)[0]
+    assert not check_slo(r, min_sustained=0.9)[0]
+    assert not check_slo(dict(r, conserved=False))[0]
+    assert not check_slo(dict(r, converged_round=None,
+                              in_flight=3))[0]
+    assert check_slo(dict(r, converged_round=None),
+                     require_converged=False)[0]
+    assert not check_slo(dict(r, recovery_rounds=30),
+                         max_recovery_rounds=8)[0]
+    ok, det = check_slo(r, p99_max_rounds=1, coords=(9, 9, 9))
+    assert not ok and "cell(9, 9, 9)" in det["problems"][0]
+
+
+# -- grid staging --------------------------------------------------------
+
+
+def test_frontier_grid_coords_and_fault_levels():
+    cells = FR.frontier_grid(
+        "broadcast", n_nodes=8, rates=(0.2, 0.4, 0.6),
+        fault_levels=(None, {"n_crash_windows": 1,
+                             "loss_rate": 0.1}),
+        topologies=("grid", "tree"), until=8, seed=3)
+    assert len(cells) == 12
+    assert cells[0].coords == (0, 0, 0)
+    assert cells[-1].coords == (2, 1, 1)
+    # the fault axis resolves: None stays None, a dict draws a
+    # seeded spec, a zero dict collapses to None
+    assert cells[0].spec is None
+    assert cells[2].spec is not None and cells[2].spec.crash
+    z = FR.frontier_grid("counter", n_nodes=8, rates=(0.3,),
+                         fault_levels=({"n_crash_windows": 0},),
+                         until=8)
+    assert z[0].spec is None
+    # distinct traffic seeds per cell — distinct open-loop runs
+    assert len({c.traffic.seed for c in cells}) == len(cells)
+    # equal fault levels at different grid rows draw DISTINCT windows
+    specs = [c.spec for c in cells if c.spec is not None]
+    assert len({s.crash for s in specs}) > 1
+
+
+# -- batched-vs-sequential serving parity --------------------------------
+
+
+def test_serving_parity_counter_kafka_single_device():
+    spec = F.NemesisSpec(n_nodes=8, crash=((2, 5, (1, 2)),),
+                         loss_rate=0.1, loss_until=6)
+    cells = [SC.ServingCell(traffic=_tspec(rate=0.4, seed=2)),
+             SC.ServingCell(traffic=_tspec(rate=0.6, seed=3),
+                            spec=spec)]
+    _assert_cell_parity("counter", cells,
+                        {"mode": "cas", "poll_every": 2})
+    kkw = {"n_keys": 4, "capacity": 48, "max_sends": 4,
+           "resync_every": 4}
+    _assert_cell_parity("kafka", cells, kkw)
+
+
+def test_serving_parity_broadcast_mesh8():
+    """ONE scenario-sharded batch dispatch on the 8-way mesh is
+    bit-exact (ledger included) against eight sequential
+    single-device run_serving rows with mixed topologies, loads, and
+    fault plans."""
+    n = 16
+    spec = F.NemesisSpec(n_nodes=n, crash=((2, 5, (3, 4)),),
+                         loss_rate=0.1, loss_until=6)
+    cells = [SC.ServingCell(
+        traffic=_tspec(n=n, rate=0.2 + 0.05 * i, seed=i),
+        spec=(spec if i % 2 else None),
+        topology="tree" if i % 3 == 0 else "grid")
+        for i in range(8)]
+    _assert_cell_parity("broadcast", cells, {}, mesh=mesh_1d())
+
+
+def test_serving_burst_pad_bit_identity_and_mixed_statics_raise():
+    """A burst-window axis padded to a bigger bucket (n_burst) is
+    bit-identical — pad windows are never-active [0, 0) — and a
+    traffic batch mixing static shapes refuses loudly."""
+    c = SC.ServingCell(traffic=_tspec(rate=0.4, seed=5,
+                                      burst=((2, 5, 1.5),)))
+    base = _assert_cell_parity("broadcast", [c], {})
+    padded = SC.run_serving_batch(
+        SC.ServingBatch(workload="broadcast", cells=(c,),
+                        max_recovery_rounds=16, drain_every=4),
+        n_burst=4)
+    for k in PARITY_KEYS:
+        assert base["cells"][0].get(k) == padded["cells"][0].get(k)
+    with pytest.raises(ValueError, match="static shapes"):
+        T.batch_tplans([_tspec(n=8),
+                        dataclasses.replace(_tspec(n=8),
+                                            ops_per_client=3)])
+    with pytest.raises(ValueError, match="cannot pad"):
+        T.pad_tplan(_tspec(burst=((1, 3, 1.5), (4, 6, 1.5))
+                           ).compile(), 1)
+
+
+# -- the frontier runner: coverage determinism ---------------------------
+
+
+def _small_grid():
+    return FR.frontier_grid(
+        "broadcast", n_nodes=8, rates=(0.3, 0.6),
+        fault_levels=(None, {"n_crash_windows": 1,
+                             "loss_rate": 0.1}),
+        until=8, seed=3)
+
+
+def _cell_key(cell):
+    return {k: cell.get(k) for k in
+            ("coords", "ok", "slo_ok", "completed", "lat_p50",
+             "lat_p99", "msgs_total", "signature")}
+
+
+def test_frontier_coverage_deterministic_across_batch_shapes(
+        monkeypatch):
+    """The same grid mapped in one 4-cell batch, in two 2-cell
+    pipelined batches, and under a different GG_TRAFFIC_BLOCK slab
+    size produces the IDENTICAL coverage map and per-cell surface —
+    batching, pipelining, and tracker blocking are pure execution
+    layout."""
+    cells = _small_grid()
+    kw = dict(slo={"min_completed": 1}, max_recovery_rounds=16,
+              drain_every=4)
+    rep1 = FR.run_frontier("broadcast", cells, batch_size=4,
+                           pipeline=False, **kw)
+    rep2 = FR.run_frontier("broadcast", cells, batch_size=2,
+                           pipeline=True, **kw)
+    monkeypatch.setenv("GG_TRAFFIC_BLOCK", "2")
+    rep3 = FR.run_frontier("broadcast", cells, batch_size=4,
+                           pipeline=False, **kw)
+    monkeypatch.delenv("GG_TRAFFIC_BLOCK")
+    for rep in (rep1, rep2, rep3):
+        observe.validate_frontier(rep)
+    assert rep1["batch_sizes"] == [4] and rep2["batch_sizes"] == [2, 2]
+    for other in (rep2, rep3):
+        assert [_cell_key(c) for c in rep1["cells"]] == \
+               [_cell_key(c) for c in other["cells"]]
+        assert rep1["coverage"]["signatures"] == \
+            other["coverage"]["signatures"]
+    # the observatory artifacts render + validate
+    tl = FR.frontier_timeline(rep1)
+    observe.validate_timeline(tl)
+    assert any(ev.get("name") == "coverage/distinct_behaviors"
+               for ev in tl["traceEvents"])
+    tbl = FR.frontier_table(rep1)
+    assert len(tbl) == 4 and all("lat_p99" in r for r in tbl)
+
+
+def test_frontier_planted_slo_failure_bundle_replays(tmp_path):
+    """An SLO-failing grid cell writes a flight bundle carrying its
+    TrafficSpec + NemesisSpec + grid coordinates, and the bundle
+    replays from JSON alone to the same check_slo failure."""
+    cells = _small_grid()[:2]
+    rep = FR.run_frontier(
+        "broadcast", cells, slo={"p99_max_rounds": 1},
+        max_recovery_rounds=16, drain_every=4,
+        observe_dir=str(tmp_path), pipeline=False)
+    observe.validate_frontier(rep)
+    assert not rep["ok"] and rep["bundles"]
+    b = rep["bundles"][0]
+    bundle = observe.load_bundle(b["path"])
+    assert bundle["kind"] == "serving"
+    assert bundle["failure"]["checker"] == "check_slo"
+    assert bundle["failure"]["grid_coords"] == b["coords"]
+    assert bundle["traffic"]["rate"] == cells[b["cell"]].traffic.rate
+    assert any(f"cell{tuple(b['coords'])!r}" in p
+               for p in bundle["failure"]["problems"])
+    replay = observe.replay_bundle(b["path"])
+    ok_r, det_r = check_slo(replay, **bundle["failure"]["slo"],
+                            coords=bundle["failure"]["grid_coords"])
+    assert not ok_r
+    assert replay.get("first_divergence_round") is None
+
+
+def test_shrink_serving_cell_traffic_moves(tmp_path):
+    """The PR-10 shrinker extended along the traffic axis: halving
+    rates and dropping burst windows under the same violation-class
+    signature, terminal bundle replaying to the same failure."""
+    cell = SC.ServingCell(
+        traffic=_tspec(rate=0.8, seed=5, burst=((2, 6, 1.2),)),
+        spec=F.NemesisSpec(n_nodes=8, crash=((2, 5, (1, 2)),),
+                           loss_rate=0.1, loss_until=6),
+        coords=(1, 2, 0))
+    rec = FZ.shrink_serving_cell(
+        "broadcast", cell, {}, {"p99_max_rounds": 1},
+        max_recovery_rounds=16, drain_every=4,
+        observe_dir=str(tmp_path))
+    assert "halve rate" in rec["moves_accepted"]
+    assert rec["weight_after"] < rec["weight_before"]
+    assert rec["signature"]["kinds"] == ["p99"]
+    assert rec["replay_same_failure"]
+    shrunk = observe.load_bundle(rec["bundle"])
+    assert shrunk["failure"]["grid_coords"] == [1, 2, 0]
+    assert shrunk["traffic"]["rate"] < cell.traffic.rate
+
+
+# -- fuzzer shape buckets / pipelining / adaptive steering ---------------
+
+
+FUZZ_KW = dict(workload="broadcast", n_scenarios=8, n_nodes=12,
+               batch_size=4, horizon=6, max_recovery_rounds=16,
+               seed=7, shrink=False)
+
+
+def test_fuzz_shape_buckets_and_pipeline_pin_verdicts():
+    """Shape-bucketed, pipelined, signature-recording dispatch is
+    verdict-identical to the PR-10 path, never uses MORE program
+    shapes, and records one behavioral signature per scenario."""
+    base = FZ.fuzz_run(**FUZZ_KW)
+    buck = FZ.fuzz_run(**FUZZ_KW, shape_buckets=True, pipeline=True,
+                       signatures=True)
+    assert len(base["rows"]) == len(buck["rows"])
+    for a, b in zip(base["rows"], buck["rows"]):
+        for k in ("ok", "spec", "parts", "delays",
+                  "converged_round", "n_lost"):
+            assert a.get(k) == b.get(k), k
+        assert len(b["signature"]) == 4
+    assert buck["n_program_shapes"] <= base["n_program_shapes"]
+    assert buck["shape_knobs"]["pad_to"] == 4
+    assert buck["coverage"]["n_seen"] == len(buck["rows"])
+    sync = FZ.fuzz_run(**FUZZ_KW, shape_buckets=True,
+                       signatures=True)
+    assert [r["signature"] for r in sync["rows"]] == \
+           [r["signature"] for r in buck["rows"]]
+
+
+def test_fuzz_adapt_is_deterministic_and_guarded():
+    kw = dict(FUZZ_KW, workload="counter", n_scenarios=8)
+    a1 = FZ.fuzz_run(**kw, adapt=True)
+    a2 = FZ.fuzz_run(**kw, adapt=True)
+    assert a1["coverage"]["signatures"] == a2["coverage"][
+        "signatures"]
+    assert a1["adapt"] and a1["n_distinct_signatures"] >= 1
+    # axis bookkeeping: every scenario accounted to an axis cell
+    assert sum(r["n_samples"] for r in a1["coverage"]["axes"]) == 8
+    with pytest.raises(ValueError, match="incompatible"):
+        FZ.fuzz_run(**kw, adapt=True, pipeline=True)
+
+
+def test_coverage_map_roundtrip_and_novelty():
+    cm = FR.CoverageMap()
+    assert cm.novelty((1, 0.1)) == 2.0
+    assert cm.add([1, 2, 0, 3], axis=(1, 0.1), meta={"cell": 0})
+    assert not cm.add([1, 2, 0, 3], axis=(1, 0.1))
+    assert cm.add([2, 2, 1, 3], axis=(2, 0.0))
+    assert cm.n_distinct == 2 and cm.n_seen == 3
+    assert cm.axis_behaviors((1, 0.1)) == 1
+    assert cm.axis_samples((1, 0.1)) == 2
+    assert cm.novelty((1, 0.1)) == 0.5
+    meta = cm.to_meta()
+    cm2 = FR.CoverageMap.from_meta(meta)
+    assert cm2.n_distinct == 2 and cm2.n_seen == 3
+    assert cm2.to_meta()["signatures"] == meta["signatures"]
+    with pytest.raises(ValueError, match="fields"):
+        FR.signature_key([1, 2, 3])
+
+
+# -- program contracts + lint split --------------------------------------
+
+
+def test_frontier_batch_contracts_zero_collectives():
+    """The frontier batch programs (the serving dispatch family)
+    carry the same cap-0 census as the scenario batch family: ZERO
+    collectives, donation over the stacked tracker carry."""
+    mesh = mesh_1d()
+    rows = {c.name: c for c in SC.audit_contracts()}
+    row = audit.audit_contract(
+        rows["broadcast/frontier-batch-run"], mesh)
+    assert row["ok"], row
+    assert row["checks"]["collectives"]["counts"] == {}
+    assert row["checks"]["donation"]["entries"] > 0
+
+
+def test_frontier_traced_host_split_is_total():
+    import gossip_glomers_tpu
+
+    pkg = os.path.dirname(os.path.abspath(
+        gossip_glomers_tpu.__file__))
+    src = open(os.path.join(pkg, "harness", "frontier.py")).read()
+    top_fns = {node.name for node in ast_mod.parse(src).body
+               if isinstance(node, ast_mod.FunctionDef)}
+    declared = set(FR.TRACED_EVALUATORS) | set(FR.HOST_SIDE)
+    assert top_fns == declared, (
+        f"undeclared {sorted(top_fns - declared)}, "
+        f"stale {sorted(declared - top_fns)}")
+    pat = audit._root_pattern_for("harness/frontier.py")
+    assert pat is not None
+    for name in FR.HOST_SIDE:
+        assert not pat.match(name), name

@@ -521,7 +521,10 @@ def test_scenario_batch_contracts_zero_collectives():
     rows = {c.name: c for c in SC.audit_contracts()}
     assert set(rows) == {"broadcast/scenario-batch-run",
                          "counter/scenario-batch-run",
-                         "kafka/scenario-batch-run"}
+                         "kafka/scenario-batch-run",
+                         "broadcast/frontier-batch-run",
+                         "counter/frontier-batch-run",
+                         "kafka/frontier-batch-run"}
     row = audit.audit_contract(rows["broadcast/scenario-batch-run"],
                                mesh)
     assert row["ok"], row
@@ -533,7 +536,10 @@ def test_scenario_contracts_registered():
     names = {c.name for c in audit.default_registry()}
     for expected in ("broadcast/scenario-batch-run",
                      "counter/scenario-batch-run",
-                     "kafka/scenario-batch-run"):
+                     "kafka/scenario-batch-run",
+                     "broadcast/frontier-batch-run",
+                     "counter/frontier-batch-run",
+                     "kafka/frontier-batch-run"):
         assert expected in names
 
 
