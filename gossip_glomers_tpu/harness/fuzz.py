@@ -183,11 +183,13 @@ def planted_failure(workload: str, n_nodes: int,
     origin values wiped before the first flood — lost acked writes),
     dressed with non-load-bearing loss/dup/partition components the
     shrinker must strip."""
-    if workload == "kafka":
+    if workload in ("kafka", "txn"):
         raise ValueError(
             "the planted-failure cell targets broadcast/counter "
-            "(kafka allocations require a live origin, so a round-0 "
-            "crash stages no acked writes to lose)")
+            "(kafka allocations require a live origin, and txn "
+            "commits survive crashes by wound-or-die retry — plant "
+            "txn anomalies via kv_amnesia or the checker's planted "
+            "histories instead)")
     spec = NemesisSpec(
         n_nodes=n_nodes, seed=424242,
         crash=((0, horizon, (0, 1)),),
@@ -280,6 +282,18 @@ def run_sequential(workload: str, sc: SC.Scenario, runner_kw: dict,
         return NM.run_counter_nemesis(
             sc.spec, mode=kw.get("mode", "cas"),
             poll_every=int(kw.get("poll_every", 2)),
+            max_recovery_rounds=max_recovery_rounds,
+            telemetry=telemetry, observe_dir=observe_dir)
+    if workload == "txn":
+        from . import txn as TXH
+        return TXH.run_txn_nemesis(
+            sc.spec, n_keys=int(kw.get("n_keys", 8)),
+            txns_per_node=int(kw.get("txns_per_node", 4)),
+            ops_per_txn=int(kw.get("ops_per_txn", 2)),
+            rate=float(kw.get("rate", 0.5)),
+            until=kw.get("until"),
+            kv_amnesia=bool(kw.get("kv_amnesia", False)),
+            workload_seed=sc.workload_seed,
             max_recovery_rounds=max_recovery_rounds,
             telemetry=telemetry, observe_dir=observe_dir)
     return NM.run_kafka_nemesis(
@@ -394,7 +408,11 @@ def shrink_scenario(workload: str, sc: SC.Scenario, runner_kw: dict,
     from . import observe
     from .checkers import series_divergence_round
 
-    tel_spec = TM.TelemetrySpec(workload, rounds=tel_rounds)
+    # txn has no telemetry ring — its bundles carry the per-txn
+    # stamp record instead, and the replay diffs those for the
+    # first-divergence round
+    tel_spec = (None if workload == "txn"
+                else TM.TelemetrySpec(workload, rounds=tel_rounds))
     base = run_sequential(workload, sc, runner_kw,
                           max_recovery_rounds)
     sig0 = failure_signature(base)
@@ -528,8 +546,12 @@ def fuzz_run(workload: str = "broadcast", n_scenarios: int = 256, *,
       behaviors-per-sample novelty — budget flows toward the axis
       cells still producing unseen behaviors.  ``coverage`` seeds
       the map (cross-campaign steering)."""
-    if workload not in ("broadcast", "counter", "kafka"):
+    if workload not in ("broadcast", "counter", "kafka", "txn"):
         raise ValueError(f"unknown fuzz workload {workload!r}")
+    if workload == "txn" and (signatures or adapt):
+        raise ValueError(
+            "the txn workload records per-transaction stamps, not "
+            "telemetry rings — signatures/adapt are not wired for it")
     if adapt and pipeline:
         raise ValueError(
             "adapt needs the coverage of batch i before sampling "

@@ -702,9 +702,11 @@ def replay_bundle(path_or_dict, *, telemetry=False) -> dict:
             nemesis=spec, sim_kw=bundle.get("sim_kw") or {},
             telemetry=telemetry, **kw)
     else:
+        from . import txn as TXH
         runners = {"broadcast": NM.run_broadcast_nemesis,
                    "counter": NM.run_counter_nemesis,
-                   "kafka": NM.run_kafka_nemesis}
+                   "kafka": NM.run_kafka_nemesis,
+                   "txn": TXH.run_txn_nemesis}
         if spec is None:
             raise ValueError("nemesis bundle has no NemesisSpec")
         kw = dict(bundle.get("runner_kw") or {})

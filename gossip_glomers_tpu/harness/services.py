@@ -37,13 +37,25 @@ from ..protocol import (KEY_DOES_NOT_EXIST, PRECONDITION_FAILED, Message,
 class KVService:
     def __init__(self, network, service_id: str = "seq-kv",
                  stale_read_prob: float = 0.0,
-                 stale_window: float = 1.0) -> None:
+                 stale_window: float = 1.0,
+                 stale_coin_fn=None) -> None:
+        """``stale_coin_fn``: optional ``(now, client, key) -> bool``
+        that OWNS the stale decision for reads — it replaces the
+        behind-check + window + RNG policy wholesale (the servable
+        value stays the one-version-back record).  The tpu_sim
+        calibration tests inject the device backend's stateless coin
+        stream (``tpu_sim.kvstore.host_stale_coin``) here so both
+        backends retry in lockstep, message for message; the injected
+        policy must itself respect per-process monotonicity."""
         self.network = network
         self.id = service_id
         self.store: dict[str, Any] = {}
         self.history: list[tuple[float, str, str, Any]] = []  # (t, op, key, arg)
         self.stale_read_prob = stale_read_prob
         self.stale_window = stale_window
+        self.stale_coin_fn = stale_coin_fn
+        self._stale_on = bool(stale_read_prob) or stale_coin_fn is not None
+        self.stale_served = 0
         self._stale: dict[str, tuple[Any, float]] = {}  # key -> (old, t_overwrite)
         self._ver: dict[str, int] = {}                  # key -> version counter
         self._seen: dict[tuple[str, str], int] = {}     # (client, key) -> version
@@ -71,15 +83,23 @@ class KVService:
                     KEY_DOES_NOT_EXIST, f"key {key} not found").to_body())
                 return
             value = self.store[key]
-            if self.stale_read_prob and key in self._stale:
+            if self._stale_on and key in self._stale:
                 old, t_over = self._stale[key]
-                # only clients that have NOT yet observed the current
-                # version may be served the previous one (per-process
-                # monotonicity + read-your-writes)
-                behind = (self._seen.get((msg.src, key), 0)
-                          < self._ver.get(key, 0))
-                if (behind and self.network.now - t_over < self.stale_window
-                        and self._rng.random() < self.stale_read_prob):
+                if self.stale_coin_fn is not None:
+                    stale = bool(self.stale_coin_fn(self.network.now,
+                                                    msg.src, key))
+                else:
+                    # only clients that have NOT yet observed the
+                    # current version may be served the previous one
+                    # (per-process monotonicity + read-your-writes)
+                    behind = (self._seen.get((msg.src, key), 0)
+                              < self._ver.get(key, 0))
+                    stale = (behind
+                             and (self.network.now - t_over
+                                  < self.stale_window)
+                             and self._rng.random() < self.stale_read_prob)
+                if stale:
+                    self.stale_served += 1
                     self._reply(msg, {"type": "read_ok", "value": old})
                     return
             self._observe(msg.src, key)
@@ -120,14 +140,14 @@ class KVService:
             pass  # unknown service op: drop
 
     def _observe(self, client: str, key: str) -> None:
-        if self.stale_read_prob:
+        if self._stale_on:
             self._seen[(client, key)] = self._ver.get(key, 0)
 
     def _record_stale(self, key: str, writer: str) -> None:
         """Before overwriting ``key``: remember the outgoing value as the
         servable stale version, bump the key's version, and mark the
         writer as having observed its own write (read-your-writes)."""
-        if self.stale_read_prob and key in self.store:
+        if self._stale_on and key in self.store:
             self._stale[key] = (self.store[key], self.network.now)
             self._ver[key] = self._ver.get(key, 0) + 1
             self._seen[(writer, key)] = self._ver[key]

@@ -1,0 +1,210 @@
+"""txn-rw-register nemesis campaigns (PR 14): drive tpu_sim/txn.py's
+wound-or-die transaction rounds under a seeded crash/loss
+:class:`~..tpu_sim.faults.NemesisSpec`, then certify BOTH recovery
+(bounded convergence, zero lost acked commits — ``check_recovery``)
+AND serializability (``checkers.check_txn_serializable``: the host
+cycle check over the device-recorded read/write version graph).
+
+The runner mirrors ``run_counter_nemesis``'s shape: faulted phase as
+one donated fused dispatch to the clear round, host-observed
+step-by-step recovery, flight-recorder bundle on failure
+(harness/observe.py — ``replay_bundle`` re-runs the campaign from the
+bundle's JSON alone and diffs the re-recorded per-transaction stamps
+for the first-divergence round).  Provenance is free for this
+workload: the per-transaction ``issue_round``/``commit_round`` stamps
+ride inside :class:`~..tpu_sim.txn.TxnState` itself, so every run
+records them — no observed-driver variant needed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..tpu_sim import txn as TX
+from ..tpu_sim.faults import NemesisSpec
+from .checkers import check_recovery, check_txn_serializable
+
+# Host/device split, DECLARED (PR 6): all host — the traced bodies
+# live in tpu_sim/txn.py; tests/test_txn.py pins the split total.
+TRACED_EVALUATORS: tuple = ()
+HOST_SIDE = ("run_txn_nemesis", "txn_provenance_arrays",
+             "run_txn_frontier")
+
+
+def txn_provenance_arrays(state: "TX.TxnState") -> dict:
+    """The per-transaction causal record as plain int lists — the
+    flight-bundle stamp payload (checkers.provenance_divergence_round
+    diffs these on replay; both fields are round-valued so they are
+    their own round companions)."""
+    return {
+        "issue_round": np.asarray(state.issue_round).tolist(),
+        "commit_round": np.asarray(state.commit_round).tolist(),
+    }
+
+
+def run_txn_nemesis(spec: NemesisSpec, *, n_keys: int = 8,
+                    txns_per_node: int = 4, ops_per_txn: int = 2,
+                    rate: float = 0.5, until: int | None = None,
+                    workload_seed: int = 0,
+                    max_recovery_rounds: int = 48,
+                    kv_amnesia: bool = False,
+                    mesh=None, telemetry=None,
+                    observe_dir=None) -> dict:
+    """Transactions under the nemesis: every node's client offers
+    ``txns_per_node`` multi-key read/write transactions on the seeded
+    arrival schedule; wound-or-die retries carry stalled transactions
+    across crash windows.  Convergence = every offered transaction
+    committed (checked only after arrivals close at ``tspec.until``).
+
+    Certification ANDs two verdicts: ``check_recovery`` (bounded
+    recovery after the LAST of clear-round/arrival-horizon, zero lost
+    acked commits) and ``check_txn_serializable`` over the recorded
+    history with the final store registers as the anchor —
+    ``kv_amnesia=True`` composes owner wipes in, which MUST fail the
+    serializability check with named lost updates (the falsifiability
+    direction; tests pin it).
+
+    ``telemetry`` is accepted for replay-signature compatibility and
+    must be falsy: this workload's observability record is the
+    per-transaction stamp pair riding the state, not a telemetry
+    series."""
+    from . import observe
+
+    if telemetry:
+        raise ValueError("txn workload records per-transaction "
+                         "stamps, not telemetry series")
+    n = spec.n_nodes
+    sim = TX.TxnSim(
+        n, n_keys, txns_per_node=txns_per_node,
+        ops_per_txn=ops_per_txn, rate=rate, until=until, mesh=mesh,
+        workload_seed=workload_seed, fault_plan=spec.compile(),
+        kv_amnesia=kv_amnesia)
+    # convergence is meaningful only once BOTH the fault horizon and
+    # the arrival horizon have passed
+    clear = max(spec.clear_round, int(sim.tspec.until))
+    state = sim.init_state()
+    if clear > 0:
+        state = sim.run_fused(state, clear)
+    msgs_at_clear = int(state.msgs)
+
+    def converged(s) -> bool:
+        return bool(np.all(np.asarray(s.cur) >= np.asarray(s.arrived)))
+
+    converged_round = clear if converged(state) else None
+    while converged_round is None \
+            and int(state.t) < clear + max_recovery_rounds:
+        state = sim.step(state)
+        if converged(state):
+            converged_round = int(state.t)
+
+    history = TX.history_of(state, sim.ops)
+    final = TX.final_registers(state, sim.layout)
+    ok_ser, ser_det = check_txn_serializable(history, final=final)
+    lost = [p for p in ser_det["problems"]
+            if p["kind"] in ("lost-update", "lost-acked-commit")]
+    open_txns = [h["id"] for h in history if h["status"] == "open"]
+    ok, details = check_recovery(
+        clear_round=clear, converged_round=converged_round,
+        max_recovery_rounds=max_recovery_rounds, lost_writes=lost,
+        msgs_at_clear=msgs_at_clear, msgs_at_converged=int(state.msgs))
+    ok = ok and ok_ser
+    prov = txn_provenance_arrays(state)
+    details.update(
+        workload="txn", n_nodes=n, n_keys=n_keys,
+        n_txns=len(history),
+        n_committed=ser_det["n_committed"],
+        open_txns=open_txns[:10],
+        serializable=ok_ser, serializability=ser_det,
+        final_registers={str(k): list(v) for k, v in final.items()},
+        msgs_total=int(state.msgs), spec=spec.to_meta(),
+        provenance={"arrays": prov,
+                    "check": {"ok": ok_ser,
+                              "by_kind": ser_det["by_kind"]}})
+    runner_kw = dict(n_keys=n_keys, txns_per_node=txns_per_node,
+                     ops_per_txn=ops_per_txn, rate=rate, until=until,
+                     workload_seed=workload_seed,
+                     max_recovery_rounds=max_recovery_rounds,
+                     kv_amnesia=kv_amnesia)
+    if not ok and observe_dir is not None:
+        bundle_path = observe.write_flight_bundle(
+            observe_dir, kind="nemesis", workload="txn",
+            nemesis=spec.to_meta(), runner_kw=runner_kw,
+            provenance=prov,
+            failure={"converged_round": converged_round,
+                     "n_lost_writes": len(lost),
+                     "by_kind": ser_det["by_kind"]})
+        details["flight_bundle"] = bundle_path
+    return {"ok": ok, **details}
+
+
+def run_txn_frontier(rates, specs, *, n_keys: int = 8,
+                     txns_per_node: int = 4, ops_per_txn: int = 2,
+                     until: int = 16, max_recovery_rounds: int = 48,
+                     mesh=None, slo: dict | None = None) -> dict:
+    """The txn serving-frontier grid: (offered rate x nemesis) cells,
+    each rate's whole nemesis column certified in ONE batched scenario
+    dispatch (tpu_sim/scenario.py ``run_txn_batch`` — rate is a
+    static of the column, the fault axis is the batched dimension).
+
+    Per cell the row carries the recovery verdict plus the
+    transaction-level SLO surface derived from the device-recorded
+    stamps: commit latency percentiles (``commit_round -
+    issue_round`` over committed transactions, in rounds) and
+    committed throughput (txns per round to convergence).  ``slo``:
+    optional ``{"p99_max_rounds": float, "max_recovery_rounds": int}``
+    bounds ANDed into each cell's ``slo_ok``.
+    """
+    from ..tpu_sim import scenario as SC
+
+    import jax
+
+    rows = []
+    ok_all = True
+    for rate in rates:
+        batch = SC.ScenarioBatch(
+            workload="txn",
+            scenarios=tuple(SC.Scenario(spec=sp, workload_seed=sp.seed)
+                            for sp in specs),
+            runner_kw=dict(n_keys=n_keys, txns_per_node=txns_per_node,
+                           ops_per_txn=ops_per_txn, rate=float(rate),
+                           until=until),
+            max_recovery_rounds=max_recovery_rounds)
+        res = SC.run_txn_batch(batch, mesh=mesh)
+        final = res["final"]
+        for i, row in enumerate(res["scenarios"]):
+            st_i = jax.tree_util.tree_map(lambda x, i=i: x[i], final)
+            ir = np.asarray(st_i.issue_round)
+            cr = np.asarray(st_i.commit_round)
+            done = cr >= 0
+            lat = (cr - ir)[done] + 1
+            cell = dict(rate=float(rate), spec=i,
+                        ok=bool(row["ok"]),
+                        converged_round=row["converged_round"],
+                        recovery_rounds=row["recovery_rounds"],
+                        n_committed=int(done.sum()),
+                        msgs_total=row["msgs_total"])
+            if lat.size:
+                cell["lat_p50"] = float(np.percentile(lat, 50))
+                cell["lat_p99"] = float(np.percentile(lat, 99))
+                cell["lat_max"] = int(lat.max())
+                conv = row["converged_round"]
+                if conv:
+                    cell["committed_per_round"] = round(
+                        float(done.sum()) / conv, 4)
+            if slo is not None:
+                s_ok = cell["ok"]
+                if "p99_max_rounds" in slo and lat.size:
+                    s_ok = s_ok and (cell["lat_p99"]
+                                     <= slo["p99_max_rounds"])
+                if "max_recovery_rounds" in slo \
+                        and row["recovery_rounds"] is not None:
+                    s_ok = s_ok and (row["recovery_rounds"]
+                                     <= slo["max_recovery_rounds"])
+                cell["slo_ok"] = bool(s_ok)
+                ok_all = ok_all and s_ok
+            else:
+                ok_all = ok_all and cell["ok"]
+            rows.append(cell)
+    return {"ok": bool(ok_all), "workload": "txn",
+            "n_cells": len(rows), "rates": [float(r) for r in rates],
+            "n_specs": len(specs), "slo": slo, "cells": rows}

@@ -377,12 +377,13 @@ def default_registry() -> list[ProgramContract]:
     """Every registered driver contract, collected from the sims (each
     stateful sim module owns its own ``audit_contracts()``; telemetry
     registers the observed-driver rows, PR 8; provenance the
-    stamp-carrying rows, PR 9)."""
-    from . import (broadcast, counter, kafka, provenance, scenario,
-                   telemetry)
+    stamp-carrying rows, PR 9; kvstore the sharded-rows CAS drivers
+    and txn the wound-or-die transaction rounds, PR 14)."""
+    from . import (broadcast, counter, kafka, kvstore, provenance,
+                   scenario, telemetry, txn)
     out: list[ProgramContract] = []
     for mod in (broadcast, counter, kafka, telemetry, provenance,
-                scenario):
+                scenario, kvstore, txn):
         out.extend(mod.audit_contracts())
     names = [c.name for c in out]
     if len(set(names)) != len(names):
@@ -475,6 +476,37 @@ def _fuzz_roots() -> str:
                             for n in fuzz.TRACED_EVALUATORS) + ")$")
 
 
+def _kvstore_roots() -> str:
+    # kvstore.py declares its split the same way (PR 14; totality
+    # pinned by tests/test_kvstore.py)
+    from . import kvstore
+    return ("^(" + "|".join(re.escape(n)
+                            for n in kvstore.TRACED_EVALUATORS)
+            + ")$")
+
+
+def _txn_roots() -> str:
+    # txn.py's traced module-level surface is tiny (the batch
+    # convergence predicate); the round body is the TxnSim._round
+    # method plus the _build_* builder closures — _round is rooted
+    # below, the builders ride the _BUILDERS mechanism.  Totality
+    # pinned by tests/test_txn.py.
+    from . import txn
+    return ("^(_round$|"
+            + "|".join(re.escape(n) + "$"
+                       for n in txn.TRACED_EVALUATORS) + ")")
+
+
+def _harness_txn_roots() -> str:
+    # harness/txn.py is PURE HOST campaign driving (PR 14) — same
+    # empty-traced-tuple contract as harness/fuzz.py; totality pinned
+    # by tests/test_txn.py.
+    from ..harness import txn as harness_txn
+    return ("^(" + "|".join(re.escape(n)
+                            for n in harness_txn.TRACED_EVALUATORS)
+            + ")$")
+
+
 def _frontier_roots() -> str:
     # harness/frontier.py is PURE HOST cartography (PR 13) — same
     # empty-traced-tuple contract as harness/fuzz.py (the traced
@@ -504,6 +536,9 @@ _TRACED_ROOTS: dict[str, str] = {
     "tpu_sim/telemetry.py": _telemetry_roots(),
     "tpu_sim/provenance.py": _provenance_roots(),
     "tpu_sim/scenario.py": _scenario_roots(),
+    "tpu_sim/kvstore.py": _kvstore_roots(),
+    "tpu_sim/txn.py": _txn_roots(),
+    "harness/txn.py": _harness_txn_roots(),
     "harness/fuzz.py": _fuzz_roots(),
     "harness/frontier.py": _frontier_roots(),
     "tpu_sim/engine.py":

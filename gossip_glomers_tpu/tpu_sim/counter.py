@@ -42,7 +42,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import faults, provenance, telemetry, traffic
+from . import faults, kvstore, provenance, telemetry, traffic
 from .engine import (Collectives, collectives, donate_argnums_for,
                      fori_rounds, jit_program, resolve_block,
                      scan_blocks)
@@ -70,6 +70,11 @@ class CounterState(NamedTuple):
     kv: jnp.ndarray        # () int32 — the seq-kv key's value
     t: jnp.ndarray         # () int32
     msgs: jnp.ndarray      # () uint32 — KV request/response messages
+    # kv_backend="device" (PR 14): the authoritative sharded key rows
+    # (tpu_sim/kvstore.py) — ``kv`` above becomes the derived one-psum
+    # view of them.  None (an empty pytree subtree) on the host
+    # backend, so every existing driver is untouched.
+    rows: "kvstore.KVRows | None" = None
 
 
 def _reach(t: jnp.ndarray, row_ids: jnp.ndarray,
@@ -101,7 +106,12 @@ class CounterSim:
                  mesh: Mesh | None = None, seed: int = 0,
                  winner_key: str = "auto",
                  fault_plan: "faults.FaultPlan | None" = None,
-                 union_block: "int | str | None" = None) -> None:
+                 union_block: "int | str | None" = None,
+                 kv_backend: str = "host",
+                 kv_amnesia: bool = False,
+                 stale_prob: float = 0.0,
+                 stale_until: int = 0,
+                 stale_seed: int | None = None) -> None:
         """``fault_plan`` (tpu_sim/faults.py): the crash/loss nemesis.
         A down node cannot flush, poll, or win the CAS; on restart its
         AMNESIA row loses ``pending`` (acked-but-unflushed deltas die
@@ -120,16 +130,59 @@ class CounterSim:
         counter's masks are O(N), so this is a driver-uniformity knob
         rather than a memory cliff; None defers to ``GG_UNION_BLOCK``
         (auto = materialized at every practical N), and parity across
-        block sizes is pinned by tests/test_nemesis.py."""
+        block sizes is pinned by tests/test_nemesis.py.
+
+        ``kv_backend`` (PR 14): ``"host"`` models the seq-kv key as the
+        replicated ``kv`` scalar (the Maelstrom service node, host
+        ``KVService`` twin); ``"device"`` hosts the key in the sharded
+        :class:`~.kvstore.KVRows` slab — ``kv`` each round is DERIVED
+        from the rows in one psum view, and the round's winning CAS is
+        a masked compare-update against them, so the serving path is
+        device-resident end to end.  Bit-exact vs the host backend in
+        ``(pending, cached, kv, t, msgs)`` (tests/test_kvstore.py).
+        ``kv_amnesia=True`` additionally wipes a restarting owner's
+        rows (the durable-service default False is the KVService pin).
+        ``stale_prob``/``stale_until``/``stale_seed``: seq-kv stale
+        reads as seeded :func:`~.kvstore.stale_coin` coins (device
+        backend, cas mode): a behind, non-winning reader's refresh may
+        re-serve its last-observed value for rounds < ``stale_until``
+        — the same coins the harness KVService draws via
+        ``stale_coin_fn`` (the wire-count calibration satellite).
+        Dup streams are REJECTED loudly on the device backend
+        (:func:`~.kvstore.reject_dup_stream`, ROADMAP item 6)."""
         if mode not in ("cas", "allreduce"):
             raise ValueError(f"unknown mode {mode!r}")
         if winner_key not in ("auto", "packed", "wide"):
             raise ValueError(f"unknown winner_key {winner_key!r}")
+        if kv_backend not in ("host", "device"):
+            raise ValueError(f"unknown kv_backend {kv_backend!r}")
+        if kv_backend != "device" and (kv_amnesia or stale_prob):
+            raise ValueError(
+                "kv_amnesia/stale_prob need kv_backend='device' "
+                "(host-backend staleness lives in harness KVService)")
+        if stale_prob and mode != "cas":
+            raise ValueError("stale_prob models the cas read-retry "
+                             "loop; allreduce has no read path")
+        if kv_backend == "device":
+            kvstore.reject_dup_stream(fault_plan, "CounterSim")
         self.n_nodes = n_nodes
         self.mode = mode
         self.poll_every = poll_every
         self.mesh = mesh
         self.seed = seed
+        self.kv_backend = kv_backend
+        self.kv_amnesia = bool(kv_amnesia)
+        self._device_kv = kv_backend == "device"
+        if self._device_kv:
+            # ONE seq-kv key, routed + sharded by the store's
+            # stateless hash (the 1-key special case of the layout)
+            self._kv_layout = kvstore.make_layout(1, n_nodes,
+                                                  seed=seed)
+            self._key_at = jnp.asarray(self._kv_layout.key_at)
+        self._stale_num = (int(kvstore.stale_num_of(stale_prob))
+                           if stale_prob else 0)
+        self._stale_until = int(stale_until)
+        self._stale_seed = seed if stale_seed is None else stale_seed
         # cas-winner key layouts:
         # - "packed" (n < 2^24): per-round hashed priority in the high
         #   bits, row id in the low bits (tie-break + winner recovery),
@@ -199,8 +252,11 @@ class CounterSim:
                     arr, NamedSharding(self.mesh, self._node_spec))
             return arr
 
+        rows = (kvstore.init_rows(self._kv_layout, self.mesh)
+                if self._device_kv else None)
         return CounterState(pending=z(), cached=z(), kv=jnp.int32(0),
-                            t=jnp.int32(0), msgs=jnp.uint32(0))
+                            t=jnp.int32(0), msgs=jnp.uint32(0),
+                            rows=rows)
 
     # -- op injection ------------------------------------------------------
 
@@ -240,6 +296,11 @@ class CounterSim:
             state = state._replace(
                 pending=jnp.where(wipe, 0, state.pending),
                 cached=jnp.where(wipe, 0, state.cached))
+            if self._device_kv and self.kv_amnesia:
+                # the KV rows are node state: a restarting owner loses
+                # its registers through the SAME amnesia coin (PR 14)
+                state = state._replace(rows=kvstore.rows_wipe(
+                    state.rows, plan, state.t, row_ids))
             if self._ub is not None and self.mode == "allreduce":
                 # streaming fault gate (ISSUE 5): evaluate the per-node
                 # liveness + KV-loss coins slab by slab on the engine's
@@ -264,10 +325,21 @@ class CounterSim:
                          & ~faults.kv_drop(plan, state.t, row_ids))
         want = (state.pending > 0) & reach
 
+        if self._device_kv:
+            # the authoritative value is READ from the sharded rows
+            # (one psum view) — the carried ``kv`` scalar is only the
+            # previous round's view and must agree except after a
+            # row-wipe (kv_amnesia), where the store is the truth
+            ka = self._key_at[row_ids]
+            kv0 = kvstore.rows_view(state.rows, ka, 1,
+                                    coll.reduce_sum)[0, 0]
+        else:
+            kv0 = state.kv
+
         if self.mode == "allreduce":
             flushed = jnp.where(want, state.pending, 0)
             total = allsum(flushed)
-            kv = state.kv + total
+            kv = kv0 + total
             pending = state.pending - flushed
             # each flush is a read + CAS round-trip: 4 messages
             attempts = allsum(want.astype(jnp.uint32)) * jnp.uint32(4)
@@ -281,7 +353,7 @@ class CounterSim:
             # contention (add.go:56-58) instead of a systematic
             # lowest-index bias: key = hashed priority (high bits) |
             # row id (low bits, tie-break + winner recovery).
-            fresh = want & (state.cached == state.kv)
+            fresh = want & (state.cached == kv0)
             x = (row_ids.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
                  + (state.t.astype(jnp.uint32)
                     + jnp.uint32(self.seed)) * jnp.uint32(0x85EBCA6B))
@@ -319,7 +391,7 @@ class CounterSim:
                     jnp.int32(self.n_nodes))
             winner_delta = allsum(
                 jnp.where(row_ids == winner, state.pending, 0))
-            kv = state.kv + jnp.where(has_winner, winner_delta, 0)
+            kv = kv0 + jnp.where(has_winner, winner_delta, 0)
             winner_mask = (row_ids == winner)
             pending = jnp.where(winner_mask, 0, state.pending)
             # every contender pays a read + CAS exchange (4 msgs);
@@ -337,15 +409,43 @@ class CounterSim:
             polled = reach & ((state.t % jnp.int32(self.poll_every)) == 0)
         else:
             polled = jnp.zeros_like(reach)
-        cached = jnp.where(want | winner_mask | polled, kv, state.cached)
+        refreshed = jnp.broadcast_to(kv, state.cached.shape)
+        if self._stale_num:
+            # seq-kv staleness (PR 14): a behind, non-winning reader's
+            # refresh is served its LAST-OBSERVED value when the
+            # seeded coin fires (read-your-writes + per-reader
+            # monotonicity hold; the coin stream is the one the host
+            # KVService draws via stale_coin_fn, so both backends
+            # retry in lockstep — the wire-count calibration)
+            h = kvstore.stale_coin(self._stale_seed, state.t, row_ids)
+            stale = ((h < jnp.uint32(self._stale_num))
+                     & (state.t < jnp.int32(self._stale_until))
+                     & ~winner_mask & (state.cached != kv))
+            refreshed = jnp.where(stale, state.cached, refreshed)
+        cached = jnp.where(want | winner_mask | polled, refreshed,
+                           state.cached)
         attempts = attempts + allsum(
             (polled & ~winner_mask).astype(jnp.uint32)) * jnp.uint32(2)
+        rows = state.rows
+        if self._device_kv:
+            # commit the round's one linearization step into the
+            # sharded rows: a masked CAS from the pre-round view —
+            # guaranteed to hit (frm IS the authoritative value), so
+            # the carried scalar and the store never diverge
+            changed = jnp.reshape(kv != kv0, (1,))
+            rows = kvstore.cas_apply(rows, ka, changed,
+                                     jnp.reshape(kv0, (1,)),
+                                     jnp.reshape(kv, (1,)))
         return CounterState(pending=pending, cached=cached, kv=kv,
-                            t=state.t + 1, msgs=state.msgs + attempts)
+                            t=state.t + 1, msgs=state.msgs + attempts,
+                            rows=rows)
 
     def _state_spec(self):
         node_spec = self._node_spec
-        return CounterState(node_spec, node_spec, P(), P(), P())
+        rows = (kvstore.rows_spec(self.mesh) if self._device_kv
+                else None)
+        return CounterState(node_spec, node_spec, P(), P(), P(),
+                            rows=rows)
 
     def _fp_extra(self):
         """(in_specs, args) for the FaultPlan operand — replicated,

@@ -88,7 +88,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from . import faults, provenance, telemetry, traffic
+from . import faults, kvstore, provenance, telemetry, traffic
 from .counter import KVReach, _reach
 from .engine import (analytic_peak_bytes, collectives,
                      donate_argnums_for, fori_rounds, jit_program,
@@ -107,6 +107,10 @@ class KafkaState(NamedTuple):
     origin_bits: jnp.ndarray
     t: jnp.ndarray                # () int32
     msgs: jnp.ndarray             # () uint32
+    # kv_backend="device" (PR 14): the authoritative sharded lin-kv
+    # rows (tpu_sim/kvstore.py) — ``kv_val`` above becomes the derived
+    # one-psum view of them.  None on the host backend.
+    rows: "kvstore.KVRows | None" = None
 
 
 def _rank_within_key(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
@@ -179,7 +183,9 @@ class KafkaSim:
                  fault_plan: "faults.FaultPlan | None" = None,
                  resync_every: int = 4,
                  resync_mode: str = "pull",
-                 union_block: "int | str | None" = None) -> None:
+                 union_block: "int | str | None" = None,
+                 kv_backend: str = "host",
+                 kv_amnesia: bool = False) -> None:
         """``kv_sched``: lin-kv reachability windows (counter.KVReach —
         the same nemesis shape the counter's flush is gated by).  A
         node partitioned from lin-kv at round t:
@@ -259,7 +265,32 @@ class KafkaSim:
         slab budget — small shapes keep the measured PR-4 programs);
         an int pins the slab; ``"materialized"`` pins the unblocked
         path as the blocking bit-exactness oracle (the ``repl_fast=
-        False`` pattern, one level up)."""
+        False`` pattern, one level up).
+
+        ``kv_backend`` (PR 14): ``"host"`` keeps the lin-kv cells as
+        the replicated ``kv_val`` vector; ``"device"`` hosts them in
+        the sharded :class:`~.kvstore.KVRows` slab (stateless-hash
+        key→owner routing) — ``kv_val`` each round is DERIVED from the
+        rows in one psum view and the round's net cell updates (alloc
+        bumps + commit CAS/create wins) land as ONE masked
+        compare-update per key per round, the same round-counter
+        linearization the host cells follow.  Bit-exact vs the host
+        backend (tests/test_kvstore.py).  ``kv_amnesia=True`` lets a
+        restarting owner's rows die with it (default False = the
+        durable Maelstrom service, the KVService pin).  Dup streams
+        are rejected loudly on the device backend (ROADMAP item 6)."""
+        if kv_backend not in ("host", "device"):
+            raise ValueError(f"unknown kv_backend {kv_backend!r}")
+        if kv_amnesia and kv_backend != "device":
+            raise ValueError("kv_amnesia needs kv_backend='device'")
+        if kv_backend == "device":
+            kvstore.reject_dup_stream(fault_plan, "KafkaSim")
+        self.kv_backend = kv_backend
+        self.kv_amnesia = bool(kv_amnesia)
+        self._device_kv = kv_backend == "device"
+        if self._device_kv:
+            self._kv_layout = kvstore.make_layout(n_keys, n_nodes)
+            self._key_at = jnp.asarray(self._kv_layout.key_at)
         self.n_nodes = n_nodes
         self.n_keys = n_keys
         self.capacity = capacity
@@ -315,7 +346,9 @@ class KafkaSim:
             kv_val=jnp.zeros((k,), jnp.int32),
             local_committed=jnp.zeros((n, k), jnp.int32),
             origin_bits=jnp.zeros((n, k, wo), jnp.uint32),
-            t=jnp.int32(0), msgs=jnp.uint32(0))
+            t=jnp.int32(0), msgs=jnp.uint32(0),
+            rows=(kvstore.init_rows(self._kv_layout, self.mesh)
+                  if self._device_kv else None))
         if self.mesh is not None:
             node3 = NamedSharding(self.mesh, P("nodes", None, None))
             state = state._replace(
@@ -394,6 +427,17 @@ class KafkaSim:
             # exchange (retried next round, like a 1-round window)
             reach = reach & up_rows & ~faults.kv_drop(plan, state.t,
                                                       row_ids)
+        if self._device_kv:
+            # the authoritative lin-kv cells are READ from the sharded
+            # rows (PR 14): one psum view replaces the carried
+            # replicated vector for the whole round — identical unless
+            # a kv_amnesia wipe just ate an owner's rows
+            if plan is not None and self.kv_amnesia:
+                state = state._replace(rows=kvstore.rows_wipe(
+                    state.rows, plan, state.t, row_ids))
+            ka_kv = self._key_at[row_ids]
+            state = state._replace(kv_val=kvstore.rows_view(
+                state.rows, ka_kv, k_dim, reduce_sum)[0])
 
         # -- offset allocation (globally linearized in (node, slot)
         #    order: the reference's lin-kv CAS loop, logmap.go:255-285).
@@ -754,14 +798,26 @@ class KafkaSim:
                 + n_active * jnp.uint32(2) + n_write_leg * jnp.uint32(2)
                 + n_blocked_c * jnp.uint32(self.kv_retries)
                 + n_resync)
+        rows_kv = state.rows
+        if self._device_kv:
+            # commit the round's net cell updates into the sharded
+            # rows as ONE masked CAS per key (frm IS the authoritative
+            # pre-round view, so every changed cell hits): the same
+            # one-linearization-step-per-round the host cells follow
+            rows_kv = kvstore.cas_apply(rows_kv, ka_kv,
+                                        kv_val != state.kv_val,
+                                        state.kv_val, kv_val)
         return KafkaState(log_vals, present, kv_val,
                           local_committed, origin_bits,
-                          state.t + 1, msgs)
+                          state.t + 1, msgs, rows=rows_kv)
 
     def _state_spec(self):
+        rows = (kvstore.rows_spec(self.mesh) if self._device_kv
+                else None)
         return KafkaState(P(None, None), P("nodes", None, None),
                           P(), P("nodes", None),
-                          P("nodes", None, None), P(), P())
+                          P("nodes", None, None), P(), P(),
+                          rows=rows)
 
     def _repl_mode(self, repl_ok) -> str:
         """Host-side path pick (see :meth:`_round`): the origin-union

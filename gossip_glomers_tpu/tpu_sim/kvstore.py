@@ -1,0 +1,372 @@
+"""Device-resident, node-sharded ``lin-kv``/``seq-kv`` service (PR 14).
+
+Maelstrom's special service nodes ``seq-kv`` and ``lin-kv`` (PAPER.md
+§1, Layer 0) were the last host component in the serving path
+(harness/services.py): every counter flush and kafka offset CAS
+round-tripped off device.  This module promotes the KV store to a
+device-resident sim with the same layout discipline as every other
+workload state:
+
+- **Sharded key rows.** Key ``k`` lives in exactly one row of a
+  ``(N, cap)`` slab at ``[owner(k), slot(k)]`` — owner chosen by a
+  stateless hash (:func:`owner_of`, same ``_mix32`` family as the fault
+  coins, so routing is a pure function of ``(key, n_nodes, seed)`` on
+  host and device alike), slot by per-owner rank.  The slab shards
+  ``P('nodes', None)`` exactly like node state, so under ``shard_map``
+  each shard holds only its own keys.
+- **CAS as a masked compare-update.** A request batch is three
+  replicated ``(K,)`` vectors (``on``/``frm``/``to``); each owner row
+  applies ``vals == frm`` → ``to`` element-wise and bumps the row's
+  version on hit (:func:`cas_apply`).  No gather, no scatter across
+  shards: requests are replicated, rows are local, the compare-update
+  is pure arithmetic — the sharded step's HLO carries all-reduce only
+  (pinned by the ``kvstore/sharded-cas-step`` audit contract).
+- **Linearization from the round counter.** One request batch commits
+  per round; the store's serialization order IS the round order, the
+  same clock every sim already linearizes against.  Within a round the
+  batch must be conflict-free (one writer per key) — the counter's
+  one-winner CAS and the txn workload's wound-or-die winner fold
+  (tpu_sim/txn.py) both guarantee it by construction.
+- **Reads as one psum.** :func:`rows_view` scatters the local rows
+  into a replicated ``(2, K)`` (value, version) view and globalizes it
+  in ONE ``reduce_sum`` — the read path costs one all-reduce per round
+  regardless of K.
+- **Faults compose.** ``kv_amnesia=True`` wipes a restarting owner's
+  rows via the SAME :func:`faults.amnesia` coin as node state
+  (:func:`rows_wipe`): a crashed owner shard loses its keys, exactly
+  like acked-unflushed deltas die with a counter node.  The default
+  (``False``) models Maelstrom's always-up service node — the
+  bit-exact pin against the host ``KVService``.
+- **Staleness as seeded coins.** The seq-kv flavor's
+  ``stale_read_prob`` becomes :func:`stale_coin` — a stateless
+  ``(seed, round, node)`` hash with a numpy twin
+  (:func:`host_stale_coin`), so the host harness and the device sim
+  draw the SAME stale reads and the flush retry loop sees the same
+  wire-message counts on both backends
+  (tests/test_kvstore.py calibration).
+
+srv-ledger semantics (ROADMAP item 6, decided here): KV messages are
+**charged at send**.  A request from a node that crashes mid-round has
+already been charged (the reach gate samples liveness at the round
+edge, so "mid-round" death is modeled as dying with the request in
+flight: request charged, no reply charged — the pair is counted
+together as the 4-msg attempt, matching the harness where the timeout
+path re-charges on retry).  Duplicate delivery of KV *request* streams
+is REJECTED loudly (:func:`reject_dup_stream`): a duplicated CAS
+re-applied against the authoritative device rows would double-commit,
+and the host harness correlates by msg id instead — the two paths
+cannot be calibrated, so the ledger refuses rather than drifting.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import faults
+from .engine import collectives, fori_rounds, jit_program
+
+# Host/device split, DECLARED (PR 6): the determinism lint
+# (tpu_sim/audit.py) treats exactly TRACED_EVALUATORS as traced scope.
+# tests/test_kvstore.py pins the split TOTAL.
+TRACED_EVALUATORS = (
+    "owner_of", "rows_view", "cas_apply", "cas_ver_apply",
+    "write_apply", "rows_wipe", "stale_coin")
+HOST_SIDE = (
+    "host_owner_of", "make_layout", "init_rows", "rows_spec",
+    "host_stale_coin", "stale_num_of", "reject_dup_stream",
+    "audit_contracts")
+
+# distinct stream salts (the faults.py convention): routing and the
+# seq-kv stale coin draw independent streams from the same seed
+_SALT_ROUTE = 0x4B565F31      # "KV_1"
+_SALT_STALE = 0x5EC4C0DE      # the KVService host-rng salt family
+
+
+class KVLayout(NamedTuple):
+    """Host-side static key layout: where every key's row lives.
+
+    ``key_at[i, c]`` is the key hosted at node i, slot c (-1 = empty).
+    Baked into traced programs as a replicated constant — each shard
+    local-gathers its own rows' keys; the layout never moves at
+    runtime (stateless-hash routing, no directory service)."""
+
+    owner: np.ndarray     # (K,) int32 — owning node per key
+    slot: np.ndarray      # (K,) int32 — row slot at the owner
+    key_at: np.ndarray    # (N, cap) int32 — key per row slot, -1 empty
+    n_keys: int
+    n_nodes: int
+    cap: int
+    seed: int
+
+
+class KVRows(NamedTuple):
+    """The device store: one (value, version) register per key row,
+    sharded over nodes like every sim state.  Versions start at 0 and
+    bump once per committed write — the txn workload's wound-or-die
+    CAS compares against them (:func:`cas_ver_apply`)."""
+
+    vals: jnp.ndarray     # (N, cap) int32
+    vers: jnp.ndarray     # (N, cap) int32
+
+
+def host_owner_of(keys: np.ndarray, n_nodes: int,
+                  seed: int = 0) -> np.ndarray:
+    """(K,) int32 — numpy twin of :func:`owner_of` (op staging and the
+    layout builder route with the same hash the device uses)."""
+    x = (np.asarray(keys).astype(np.uint32) * np.uint32(0x27D4EB2F)
+         ^ np.uint32((seed ^ _SALT_ROUTE) & 0xFFFFFFFF))
+    return (faults._mix32_np(x) % np.uint32(n_nodes)).astype(np.int32)
+
+
+def owner_of(keys: jnp.ndarray, n_nodes: int,
+             seed: int = 0) -> jnp.ndarray:
+    """(K,) int32 — owning node per key: a stateless ``_mix32`` hash,
+    bit-identical to :func:`host_owner_of`."""
+    x = (keys.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F)
+         ^ jnp.uint32((seed ^ _SALT_ROUTE) & 0xFFFFFFFF))
+    return (faults._mix32(x) % jnp.uint32(n_nodes)).astype(jnp.int32)
+
+
+def make_layout(n_keys: int, n_nodes: int, *, seed: int = 0,
+                min_cap: int = 1) -> KVLayout:
+    """Build the static sharded layout for keys ``0..n_keys-1``:
+    stateless-hash owners, per-owner slot ranks, capacity padded to
+    the max-loaded owner (``cap`` rows per node, empty slots -1)."""
+    keys = np.arange(n_keys, dtype=np.int32)
+    owner = host_owner_of(keys, n_nodes, seed)
+    slot = np.zeros(n_keys, np.int32)
+    counts = np.zeros(n_nodes, np.int32)
+    for k in range(n_keys):        # key order: deterministic ranks
+        slot[k] = counts[owner[k]]
+        counts[owner[k]] += 1
+    cap = max(int(min_cap), int(counts.max()) if n_keys else 0)
+    key_at = np.full((n_nodes, cap), -1, np.int32)
+    key_at[owner, slot] = keys
+    return KVLayout(owner=owner, slot=slot, key_at=key_at,
+                    n_keys=n_keys, n_nodes=n_nodes, cap=cap,
+                    seed=seed)
+
+
+def init_rows(layout: KVLayout, mesh=None) -> KVRows:
+    """All-zero rows (Maelstrom's counter key starts at 0; absent txn
+    registers read as (0, version 0)).  vals and vers are DISTINCT
+    buffers so the donated drivers can consume the whole pytree."""
+    def z():
+        arr = jnp.zeros((layout.n_nodes, layout.cap), jnp.int32)
+        if mesh is not None:
+            arr = jax.device_put(
+                arr, NamedSharding(mesh, P("nodes", None)))
+        return arr
+
+    return KVRows(vals=z(), vers=z())
+
+
+def rows_spec(mesh=None) -> KVRows:
+    """shard_map in/out specs for a :class:`KVRows` operand."""
+    spec = P("nodes", None) if mesh is not None else None
+    return KVRows(vals=spec, vers=spec)
+
+
+# -- traced evaluators ---------------------------------------------------
+
+
+def rows_view(rows: KVRows, key_at: jnp.ndarray, n_keys: int,
+              reduce_sum) -> jnp.ndarray:
+    """(2, K) int32 replicated (values row 0, versions row 1): each
+    shard scatters its local rows into the key axis, then ONE packed
+    ``reduce_sum`` globalizes both planes — the whole read path is a
+    single all-reduce, never a gather."""
+    occ = key_at >= 0
+    idx = jnp.where(occ, key_at, 0).ravel()
+    v = jnp.zeros((n_keys,), jnp.int32).at[idx].add(
+        jnp.where(occ, rows.vals, 0).ravel())
+    r = jnp.zeros((n_keys,), jnp.int32).at[idx].add(
+        jnp.where(occ, rows.vers, 0).ravel())
+    return reduce_sum(jnp.stack([v, r]))
+
+
+def cas_apply(rows: KVRows, key_at: jnp.ndarray, on: jnp.ndarray,
+              frm: jnp.ndarray, to: jnp.ndarray) -> KVRows:
+    """CAS as a masked compare-update: for every key ``k`` with
+    ``on[k]``, if the owner row's value equals ``frm[k]`` it becomes
+    ``to[k]`` and the version bumps; misses leave the row untouched
+    (the caller observes hit/miss through the next round's
+    :func:`rows_view`, i.e. one linearization step per round).
+    Requests are replicated ``(K,)``; the update is element-wise over
+    local rows — zero collectives."""
+    occ = key_at >= 0
+    idx = jnp.where(occ, key_at, 0)
+    hit = occ & on[idx] & (rows.vals == frm[idx])
+    return KVRows(vals=jnp.where(hit, to[idx], rows.vals),
+                  vers=jnp.where(hit, rows.vers + 1, rows.vers))
+
+
+def cas_ver_apply(rows: KVRows, key_at: jnp.ndarray, on: jnp.ndarray,
+                  ver: jnp.ndarray, val: jnp.ndarray) -> KVRows:
+    """Version-compare CAS (the txn workload's commit primitive):
+    write ``val[k]`` iff the row's VERSION still equals ``ver[k]`` —
+    optimistic concurrency over the per-key version registers.  Same
+    masked-update shape as :func:`cas_apply`, zero collectives."""
+    occ = key_at >= 0
+    idx = jnp.where(occ, key_at, 0)
+    hit = occ & on[idx] & (rows.vers == ver[idx])
+    return KVRows(vals=jnp.where(hit, val[idx], rows.vals),
+                  vers=jnp.where(hit, rows.vers + 1, rows.vers))
+
+
+def write_apply(rows: KVRows, key_at: jnp.ndarray, on: jnp.ndarray,
+                val: jnp.ndarray) -> KVRows:
+    """Unconditional masked write (seq-kv ``write``): set and bump
+    version, no compare."""
+    occ = key_at >= 0
+    idx = jnp.where(occ, key_at, 0)
+    hit = occ & on[idx]
+    return KVRows(vals=jnp.where(hit, val[idx], rows.vals),
+                  vers=jnp.where(hit, rows.vers + 1, rows.vers))
+
+
+def rows_wipe(rows: KVRows, plan, t, row_ids: jnp.ndarray) -> KVRows:
+    """Crash amnesia over KV rows (``kv_amnesia=True``): an owner
+    restarting this round loses its registers, via the SAME
+    :func:`faults.amnesia` coin that wipes node state — the store is
+    node state, so it dies like node state."""
+    wipe = faults.amnesia(plan, t, row_ids)[:, None]
+    return KVRows(vals=jnp.where(wipe, 0, rows.vals),
+                  vers=jnp.where(wipe, 0, rows.vers))
+
+
+def stale_coin(seed, t, ids: jnp.ndarray) -> jnp.ndarray:
+    """uint32 per-(round, node) stale-read coin for the seq-kv flavor:
+    a read is served stale iff ``stale_coin(...) < stale_num`` (and the
+    reader is behind).  Stateless ``_mix32`` hash — bit-identical to
+    :func:`host_stale_coin`, so the harness KVService can draw the
+    same coins and the two backends retry in lockstep."""
+    x = (ids.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+         ^ t.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         ^ jnp.uint32(seed) ^ jnp.uint32(_SALT_STALE))
+    return faults._mix32(x)
+
+
+# -- host twins / knobs --------------------------------------------------
+
+
+def host_stale_coin(seed: int, t: int, node) -> np.ndarray:
+    """numpy twin of :func:`stale_coin` (inject into
+    ``KVService(stale_coin_fn=...)`` for the calibration test)."""
+    t_term = np.uint32((int(t) * 0x9E3779B9) & 0xFFFFFFFF)
+    x = (np.asarray(node, np.int64).astype(np.uint32)
+         * np.uint32(0xC2B2AE35)
+         ^ t_term ^ np.uint32(seed & 0xFFFFFFFF)
+         ^ np.uint32(_SALT_STALE))
+    return faults._mix32_np(x)
+
+
+def stale_num_of(prob: float) -> np.uint32:
+    """Probability → uint32 coin threshold (the faults.py rate
+    convention)."""
+    return faults._rate_to_num(prob)
+
+
+def reject_dup_stream(fault_plan, where: str) -> None:
+    """The still-open half of ROADMAP item 6, refused LOUDLY: a dup
+    stream over KV *requests* would re-apply CAS/write batches against
+    the authoritative device rows (double-commit), while the host
+    harness dedups by msg id — the ledgers cannot be calibrated.
+    Raise at sim construction rather than drift silently."""
+    if fault_plan is None:
+        return
+    if int(np.asarray(fault_plan.dup_num)) > 0:
+        raise ValueError(
+            f"{where}: kv_backend='device' refuses dup streams "
+            "(dup_rate > 0) — duplicated KV request delivery against "
+            "the authoritative device rows is undefined (a re-applied "
+            "CAS double-commits; the host harness correlates by msg "
+            "id).  srv-ledger calibration covers loss + crash only "
+            "(ROADMAP item 6); use dup_rate=0 with the device "
+            "backend.")
+
+
+# -- program contracts (tpu_sim/audit.py registry) -----------------------
+
+
+def audit_contracts():
+    """The KV store's :class:`~.audit.ProgramContract` rows: the
+    sharded CAS step (all-reduce only — the zero-all-gather HLO gate
+    over the request/view path) and the donated fused CAS loop (cap-0,
+    rows alias in place, analytic memory band)."""
+    from .audit import AuditProgram, ProgramContract
+    from .engine import analytic_peak_bytes
+
+    def sharded_cas_step(mesh):
+        n, k = 32, 24
+        layout = make_layout(k, n, seed=3)
+        key_at = jnp.asarray(layout.key_at)
+        spec = rows_spec(mesh)
+
+        def step(rows, on, frm, to):
+            coll = collectives(rows.vals.shape[0], mesh)
+            ka = key_at[coll.row_ids]
+            rows = cas_apply(rows, ka, on, frm, to)
+            return rows, rows_view(rows, ka, k, coll.reduce_sum)
+
+        prog = jit_program(
+            step, mesh=mesh,
+            in_specs=(spec, P(), P(), P()),
+            out_specs=(spec, P()))
+        view0 = jnp.zeros((k,), jnp.int32)
+        args = (init_rows(layout, mesh), jnp.ones((k,), bool),
+                view0, view0 + 7)
+        return AuditProgram(prog, args)
+
+    def fused_cas_donated(mesh):
+        del mesh
+        n, k, rounds = 256, 512, 16
+        layout = make_layout(k, n, seed=3)
+        key_at = jnp.asarray(layout.key_at)
+        coll = collectives(n)
+
+        def run(rows, n_rounds):
+            def body(carry):
+                rows, t = carry
+                view = rows_view(rows, key_at, k, coll.reduce_sum)
+                on = jnp.ones((k,), bool)
+                rows = cas_apply(rows, key_at, on, view[0],
+                                 view[0] + 1)
+                return rows, t + 1
+
+            return fori_rounds(body, (rows, jnp.int32(0)), n_rounds)
+
+        prog = jit_program(run, donate_argnums=(0,))
+        state_bytes = 2 * n * layout.cap * 4
+        analytic = analytic_peak_bytes(state_bytes=state_bytes,
+                                       donated=True)
+        return AuditProgram(prog, (init_rows(layout), jnp.int32(rounds)),
+                            donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    return [
+        ProgramContract(
+            name="kvstore/sharded-cas-step",
+            build=sharded_cas_step,
+            collectives={"all-reduce": None},
+            notes="sharded key rows, replicated request batch: the "
+                  "masked compare-update is element-wise and the read "
+                  "view is ONE packed psum — all-reduce only, NO "
+                  "all-gather (the tentpole HLO gate)"),
+        ProgramContract(
+            name="kvstore/fused-cas-donated",
+            build=fused_cas_donated,
+            collectives={},
+            donation=True,
+            mem_lo=0.2, mem_hi=4.0,
+            needs_mesh=False,
+            notes="donated fori CAS loop: the (vals, vers) KV rows "
+                  "alias in place; compiled peak within band of 1x "
+                  "rows + view/select temps"),
+    ]

@@ -51,6 +51,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from . import broadcast as B
 from . import counter as CT
 from . import faults, kafka as KF, telemetry, traffic
+from . import txn as TX
 from .engine import scenario_placement, scenario_program
 
 # The module's host/device split, DECLARED (the PR-6 faults.py
@@ -70,6 +71,7 @@ HOST_SIDE = (
     "_dispatch_broadcast_batch", "_collect_broadcast_batch",
     "_dispatch_counter_batch", "_collect_counter_batch",
     "_dispatch_kafka_batch", "_collect_kafka_batch",
+    "_dispatch_txn_batch", "_collect_txn_batch", "run_txn_batch",
     "dispatch_scenario_batch", "collect_scenario_batch",
     "dispatch_serving_batch", "collect_serving_batch",
     "run_serving_batch", "serving_state_bytes",
@@ -133,7 +135,8 @@ class ScenarioBatch:
     max_recovery_rounds: int = 64
 
     def __post_init__(self) -> None:
-        if self.workload not in ("broadcast", "counter", "kafka"):
+        if self.workload not in ("broadcast", "counter", "kafka",
+                                 "txn"):
             raise ValueError(
                 f"unknown scenario workload {self.workload!r}")
         if not self.scenarios:
@@ -937,15 +940,143 @@ def run_kafka_batch(batch: ScenarioBatch, *, mesh=None,
         min_rounds=min_rounds))
 
 
+def _dispatch_txn_batch(batch: ScenarioBatch, *, mesh=None,
+                        telemetry_spec=None,
+                        signatures: bool = False,
+                        n_windows: int | None = None,
+                        min_rounds: int = 0) -> dict:
+    """Stage + enqueue S txn-rw-register campaigns (PR 14): each
+    scenario's seeded transaction program and arrival schedule ride
+    as stacked traced operands (TxnOps / batched TrafficPlan), the
+    wound-or-die round runs with identity collectives under scenario
+    sharding, and serializability is certified host-side at collect
+    (``checkers.check_txn_serializable`` per scenario)."""
+    if telemetry_spec is not None or signatures:
+        raise ValueError(
+            "the txn workload's observability record is the "
+            "per-transaction stamp pair riding TxnState — telemetry "
+            "rings / behavioral signatures are not wired for it")
+    for sc in batch.scenarios:
+        if sc.spec.dup_rate:
+            raise ValueError(
+                "txn scenarios cannot carry dup streams "
+                "(kvstore.reject_dup_stream: a re-applied CAS would "
+                "double-commit)")
+    kw = batch.runner_kw
+    n = batch.n_nodes
+    n_keys = int(kw.get("n_keys", 8))
+    t_dim = int(kw.get("txns_per_node", 4))
+    o = int(kw.get("ops_per_txn", 2))
+    rate = float(kw.get("rate", 0.5))
+    until = int(kw.get("until") or 4 * t_dim)
+    kv_amnesia = bool(kw.get("kv_amnesia", False))
+    scs = batch.scenarios
+    s_count = len(scs)
+    sim = TX.TxnSim(n, n_keys, txns_per_node=t_dim, ops_per_txn=o,
+                    rate=rate, until=until, kv_amnesia=kv_amnesia)
+
+    plans = faults.batch_plans([sc.spec for sc in scs], n_windows)
+    # convergence is meaningful only past BOTH horizons (the
+    # sequential runner's clear = max(spec.clear_round, until))
+    clears_np = np.array([max(sc.spec.clear_round, until)
+                          for sc in scs], np.int32)
+    clears = jnp.asarray(clears_np)
+    r_total = max(int(clears_np.max()) + batch.max_recovery_rounds,
+                  int(min_rounds))
+    ops = stack_pytrees([
+        TX.stage_txn_ops(n, t_dim, o, n_keys, sc.workload_seed)
+        for sc in scs])
+    tplans = traffic.batch_tplans([
+        traffic.TrafficSpec(n_nodes=n, n_clients=n,
+                            ops_per_client=t_dim, until=until,
+                            rate=rate, seed=sc.workload_seed)
+        for sc in scs])
+    states = stack_pytrees([sim.init_state()
+                            for _ in range(s_count)])
+    rnd = TX._build_batch_round(sim)
+
+    def one(state, plan, ops_s, tplan, clear):
+        step1 = lambda st, i: rnd(st, plan, ops_s, tplan)  # noqa: E731
+        return certify_loop(step1, TX._batch_converged, state, clear,
+                            batch.max_recovery_rounds, r_total)
+
+    args = _place((states, plans, ops, tplans, clears), mesh)
+    prog = _build_batch_program(
+        "txn", one, args, mesh, (0,),
+        key=(n, n_keys, t_dim, o, rate, until, kv_amnesia, s_count,
+             r_total, int(plans.starts.shape[1])))
+    out = prog(*args)
+    return {"out": out, "batch": batch, "telemetry_spec": None,
+            "signatures": False, "n": n, "sim": sim, "ops": ops}
+
+
+def _collect_txn_batch(handle: dict) -> dict:
+    """Block on + certify a dispatched txn batch: the batched recovery
+    rows AND a per-scenario serializability verdict over the recorded
+    history (lost updates / lost acked commits land in the row's
+    lost-writes evidence; any other anomaly still fails the row)."""
+    from ..harness.checkers import check_txn_serializable
+
+    out, batch = handle["out"], handle["batch"]
+    sim, ops = handle["sim"], handle["ops"]
+    s_count = len(batch.scenarios)
+    final, conv_round, msgs_clear = out[0], out[1], out[2]
+    lost_lists, ser_rows = [], []
+    for i in range(s_count):
+        st_i = jax.tree_util.tree_map(lambda x, i=i: x[i], final)
+        ops_i = jax.tree_util.tree_map(lambda x, i=i: x[i], ops)
+        hist = TX.history_of(st_i, ops_i)
+        ok_ser, det = check_txn_serializable(
+            hist, final=TX.final_registers(st_i, sim.layout))
+        lost_lists.append(
+            [p for p in det["problems"]
+             if p["kind"] in ("lost-update", "lost-acked-commit")])
+        ser_rows.append(
+            {"serializable": ok_ser, "ser_by_kind": det["by_kind"],
+             "n_txns": len(hist),
+             "n_committed": det["n_committed"]})
+    res = _verdict_rows(batch, conv_round, msgs_clear,
+                        np.asarray(final.msgs), lost_lists,
+                        extra=ser_rows)
+    # a non-serializable history fails its row even when recovery
+    # certified clean (e.g. a planted cycle with zero lost writes)
+    for i, row in enumerate(res["scenarios"]):
+        if not ser_rows[i]["serializable"]:
+            row["ok"] = False
+    res["failing"] = [i for i, row in enumerate(res["scenarios"])
+                      if not row["ok"]]
+    res["ok"] = not res["failing"]
+    res.update(n_nodes=handle["n"], final=final)
+    return res
+
+
+def run_txn_batch(batch: ScenarioBatch, *, mesh=None,
+                  telemetry_spec=None, signatures: bool = False,
+                  n_windows: int | None = None,
+                  min_rounds: int = 0) -> dict:
+    """S txn-rw-register campaigns in ONE dispatch: per-scenario
+    seeded transactions and arrivals, wound-or-die commits on the
+    sharded device KV, convergence = every offered transaction
+    committed, certification = bounded recovery AND a serializable
+    device-recorded history with zero lost acked commits."""
+    return _collect_txn_batch(_dispatch_txn_batch(
+        batch, mesh=mesh, telemetry_spec=telemetry_spec,
+        signatures=signatures, n_windows=n_windows,
+        min_rounds=min_rounds))
+
+
 _RUNNERS = {"broadcast": run_broadcast_batch,
             "counter": run_counter_batch,
-            "kafka": run_kafka_batch}
+            "kafka": run_kafka_batch,
+            "txn": run_txn_batch}
 _DISPATCHERS = {"broadcast": _dispatch_broadcast_batch,
                 "counter": _dispatch_counter_batch,
-                "kafka": _dispatch_kafka_batch}
+                "kafka": _dispatch_kafka_batch,
+                "txn": _dispatch_txn_batch}
 _COLLECTORS = {"broadcast": _collect_broadcast_batch,
                "counter": _collect_counter_batch,
-               "kafka": _collect_kafka_batch}
+               "kafka": _collect_kafka_batch,
+               "txn": _collect_txn_batch}
 
 
 def dispatch_scenario_batch(batch: ScenarioBatch, *, mesh=None,

@@ -72,6 +72,17 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 # check_slo failure from the bundle's JSON alone.  (CPU, seconds.)
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/frontier_smoke.py || rc=1
+# Txn smoke (PR 14): one certified crash+loss txn-rw-register
+# campaign on the device-native sharded KV (wound-or-die commits,
+# serializable device-recorded history), a fuzzed 64-scenario
+# crash+loss campaign certified in ONE batched dispatch on the 8-way
+# virtual mesh with zero lost acked commits, and the planted-anomaly
+# probes: kv_amnesia owner wipes MUST fail with named lost updates
+# and a bundle that replays to the same verdict, and a hand-planted
+# write-skew history MUST fail the checker naming both transaction
+# ids.  (CPU, seconds.)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/txn_smoke.py || rc=1
 # Program-contract audit (PR 6): every registered driver contract
 # (collective census, donation alias table, host boundary, memory
 # band) on the CPU 8-way virtual mesh, plus the AST determinism lint

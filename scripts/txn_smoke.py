@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""CI txn smoke (PR 14): the txn-rw-register workload end to end on
+CPU, seconds — the budget-safe slice the tier-1 gate runs on every
+push:
+
+1. one certified crash+loss campaign (``run_txn_nemesis``): bounded
+   recovery, zero lost acked commits, serializable device-recorded
+   history (``check_txn_serializable`` over the per-op version/value
+   stamps + commit-round provenance);
+2. a fuzzed 64-scenario crash+loss campaign certified in ONE batched
+   dispatch on the 8-way virtual mesh (``scenario.run_txn_batch``) —
+   the acceptance-criterion shape;
+3. planted-anomaly probe: ``kv_amnesia=True`` owner wipes MUST fail
+   the serializability check with named lost updates, the failure's
+   flight bundle replays to the same verdict from its JSON alone with
+   bit-faithful per-transaction stamps (first-divergence None), and a
+   hand-planted write-skew history fails the checker naming both
+   transaction ids (a checker that cannot fail certifies nothing).
+
+Exits nonzero on any failure.  Output dir: ``GG_OBSERVE_DIR``
+(default ``artifacts/txn_smoke``).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gossip_glomers_tpu.parallel.mesh import force_virtual_devices  # noqa: E402
+
+force_virtual_devices(8)
+
+import jax                                                    # noqa: E402
+import numpy as np                                            # noqa: E402
+from jax.sharding import Mesh                                 # noqa: E402
+
+from gossip_glomers_tpu.harness import fuzz as FZ             # noqa: E402
+from gossip_glomers_tpu.harness import observe                # noqa: E402
+from gossip_glomers_tpu.harness import txn as HTX             # noqa: E402
+from gossip_glomers_tpu.harness.checkers import (             # noqa: E402
+    check_txn_serializable)
+from gossip_glomers_tpu.tpu_sim import kvstore as KV          # noqa: E402
+from gossip_glomers_tpu.tpu_sim import scenario as SC         # noqa: E402
+from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec     # noqa: E402
+
+
+def main() -> int:
+    out = pathlib.Path(os.environ.get("GG_OBSERVE_DIR",
+                                      "artifacts/txn_smoke"))
+    out.mkdir(parents=True, exist_ok=True)
+    failed = []
+
+    # 1. certified crash+loss campaign
+    spec = NemesisSpec(n_nodes=8, seed=3, crash=((3, 6, (4,)),),
+                       loss_rate=0.2, loss_until=6)
+    res = HTX.run_txn_nemesis(spec, n_keys=8, until=12,
+                              max_recovery_rounds=48,
+                              observe_dir=str(out))
+    print(f"txn-smoke nemesis    {'ok' if res['ok'] else 'FAIL'}  "
+          f"converged={res['converged_round']} "
+          f"committed={res['n_committed']}/{res['n_txns']} "
+          f"by_kind={res['serializability']['by_kind']}")
+    if not res["ok"]:
+        failed.append(("nemesis", res["serializability"]["problems"]))
+
+    # 2. 64 fuzzed crash+loss scenarios, ONE batched dispatch, 8-way
+    # virtual mesh — the acceptance-criterion shape
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("nodes",))
+    scs = FZ.sample_scenarios("txn", 64, n_nodes=16, seed=3,
+                              horizon=8)
+    batch = SC.ScenarioBatch(
+        workload="txn", scenarios=tuple(scs),
+        runner_kw=dict(n_keys=8, txns_per_node=4, ops_per_txn=2,
+                       rate=0.5, until=16),
+        max_recovery_rounds=48)
+    bres = SC.run_txn_batch(batch, mesh=mesh)
+    n_comm = sum(r["n_committed"] for r in bres["scenarios"])
+    n_lost = sum(r["n_lost_writes"] for r in bres["scenarios"])
+    print(f"txn-smoke batch-64   {'ok' if bres['ok'] else 'FAIL'}  "
+          f"scenarios={len(bres['scenarios'])} committed={n_comm} "
+          f"lost_acked={n_lost}")
+    if not bres["ok"] or n_lost:
+        failed.append(("batch-64", bres["failing"]))
+    observe.write_json_atomic(
+        str(out / "txn_batch64_report.json"),
+        {"n_scenarios": len(bres["scenarios"]),
+         "n_committed": n_comm, "n_lost_acked": n_lost,
+         "ok": bool(bres["ok"]),
+         "rows": [{k: row[k] for k in
+                   ("ok", "converged_round", "recovery_rounds",
+                    "msgs_total", "n_committed", "serializable")}
+                  for row in bres["scenarios"]]})
+
+    # 3a. planted anomaly: kv_amnesia owner wipes fail loudly with
+    # named lost updates, and the bundle replays to the same verdict
+    owners = KV.host_owner_of(np.arange(8, dtype=np.int32), 8, 0)
+    aspec = NemesisSpec(n_nodes=8, seed=3,
+                        crash=((3, 6, (int(owners[0]),)),))
+    bad = HTX.run_txn_nemesis(aspec, n_keys=8, until=12,
+                              max_recovery_rounds=48,
+                              kv_amnesia=True, observe_dir=str(out))
+    lost = [p for p in bad["serializability"]["problems"]
+            if p["kind"] in ("lost-update", "lost-acked-commit")]
+    named = bool(lost) and all(p["txns"] for p in lost)
+    if bad["ok"] or not named or "flight_bundle" not in bad:
+        print("txn-smoke amnesia    FAIL  wipe did not fail loudly")
+        failed.append(("amnesia", bad["serializability"]["by_kind"]))
+    else:
+        replay = observe.replay_bundle(bad["flight_bundle"])
+        faithful = (not replay["ok"]
+                    and replay["first_divergence_round"] is None
+                    and replay["serializability"]["by_kind"]
+                    == bad["serializability"]["by_kind"])
+        print(f"txn-smoke amnesia    {'ok' if faithful else 'FAIL'}  "
+              f"by_kind={bad['serializability']['by_kind']} "
+              f"first_txns={lost[0]['txns']} "
+              f"divergence={replay['first_divergence_round']}")
+        if not faithful:
+            failed.append(("amnesia-replay",
+                           replay["first_divergence_round"]))
+
+    # 3b. planted history: classic write skew must fail naming ids
+    skew = [
+        {"id": 1, "status": "committed", "commit_round": 2,
+         "ops": [{"kind": "r", "key": 0, "ver": 0, "val": 0},
+                 {"kind": "w", "key": 1, "ver": 1, "val": 5}]},
+        {"id": 2, "status": "committed", "commit_round": 2,
+         "ops": [{"kind": "r", "key": 1, "ver": 0, "val": 0},
+                 {"kind": "w", "key": 0, "ver": 1, "val": 6}]},
+    ]
+    ok_s, det_s = check_txn_serializable(skew)
+    cyc = [p for p in det_s["problems"] if p["kind"] == "write-cycle"]
+    hit = not ok_s and cyc and cyc[0]["txns"] == [1, 2]
+    print(f"txn-smoke falsifiable {'ok' if hit else 'FAIL'}  "
+          f"by_kind={det_s['by_kind']}")
+    if not hit:
+        failed.append(("falsifiability", det_s["by_kind"]))
+
+    if failed:
+        print(f"txn-smoke: {len(failed)} leg(s) failed: {failed}",
+              file=sys.stderr)
+        return 1
+    print("txn-smoke: all legs ok, artifacts in", out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -255,12 +255,14 @@ def test_default_registry_is_well_formed():
                      "kafka/sharded-step-union",
                      "kafka/sharded-step-union-nem-blocked",
                      "kafka/sharded-step-union-nem-materialized",
-                     "kafka/sharded-step-matmul-oracle"):
+                     "kafka/sharded-step-matmul-oracle",
+                     "kvstore/sharded-cas-step",
+                     "txn/sharded-step"):
         assert expected in names, names
     # at least one donation + memory contract per stateful sim
     donating = [c for c in contracts if c.donation]
     assert {c.name.split("/")[0] for c in donating} == {
-        "broadcast", "counter", "kafka"}
+        "broadcast", "counter", "kafka", "kvstore", "txn"}
     for c in donating:
         assert c.mem_hi is not None
 

@@ -1,0 +1,339 @@
+"""Device-resident KV store (tpu_sim/kvstore.py, PR 14): stateless-hash
+routing parity between host and device, masked CAS/write semantics over
+the sharded key rows, the counter/kafka ``kv_backend='device'``
+bit-exact pins against the host path (single-device AND the 8-way
+virtual mesh), crash-amnesia row wipes, loud dup-stream rejection
+(ROADMAP item 6), the zero-all-gather audit contract, and the declared
+traced/host split's totality under the determinism lint.
+"""
+
+import ast as ast_mod
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import gossip_glomers_tpu
+from gossip_glomers_tpu.tpu_sim import CounterSim, KafkaSim
+from gossip_glomers_tpu.tpu_sim import audit, faults
+from gossip_glomers_tpu.tpu_sim import kvstore as KV
+from gossip_glomers_tpu.tpu_sim import txn as TX
+from gossip_glomers_tpu.tpu_sim.engine import collectives
+
+PKG_DIR = os.path.dirname(gossip_glomers_tpu.__file__)
+
+
+def mesh_8() -> Mesh:
+    return Mesh(np.array(jax.devices()).reshape(8), ("nodes",))
+
+
+# -- routing + layout ----------------------------------------------------
+
+
+def test_owner_routing_host_device_bit_exact():
+    keys = np.arange(257, dtype=np.int32)
+    for n, seed in ((5, 0), (8, 3), (32, 11)):
+        host = KV.host_owner_of(keys, n, seed)
+        dev = np.asarray(KV.owner_of(jnp.asarray(keys), n, seed))
+        assert (host == dev).all(), (n, seed)
+        assert host.min() >= 0 and host.max() < n
+    # distinct seeds re-deal the keys (the hash really consumes seed)
+    a = KV.host_owner_of(keys, 8, 0)
+    b = KV.host_owner_of(keys, 8, 1)
+    assert (a != b).any()
+
+
+def test_make_layout_places_every_key_exactly_once():
+    n_keys, n = 40, 7
+    lay = KV.make_layout(n_keys, n, seed=2)
+    assert lay.key_at.shape == (n, lay.cap)
+    seen = set()
+    for k in range(n_keys):
+        i, c = int(lay.owner[k]), int(lay.slot[k])
+        assert lay.key_at[i, c] == k
+        seen.add((i, c))
+    assert len(seen) == n_keys
+    assert int((lay.key_at >= 0).sum()) == n_keys   # empties are -1
+    # owners come from the routing hash itself
+    assert (lay.owner
+            == KV.host_owner_of(np.arange(n_keys), n, 2)).all()
+
+
+def test_stale_coin_host_device_bit_exact():
+    ids = np.arange(64, dtype=np.int32)
+    for seed, t in ((0, 0), (3, 5), (123, 31)):
+        dev = np.asarray(KV.stale_coin(seed, jnp.int32(t),
+                                       jnp.asarray(ids)))
+        host = KV.host_stale_coin(seed, t, ids)
+        assert (dev == host).all(), (seed, t)
+    # threshold convention: prob 0 never fires, prob 1 always fires
+    assert int(KV.stale_num_of(0.0)) == 0
+    h = KV.host_stale_coin(0, 0, ids)
+    assert (h < KV.stale_num_of(1.0)).all()
+
+
+# -- CAS / write semantics -----------------------------------------------
+
+
+def test_cas_write_and_version_semantics():
+    n, k = 3, 6
+    lay = KV.make_layout(k, n, seed=0)
+    ka = jnp.asarray(lay.key_at)
+    coll = collectives(n)
+
+    def view(rows):
+        return np.asarray(KV.rows_view(rows, ka, k, coll.reduce_sum))
+
+    rows = KV.init_rows(lay)
+    v = view(rows)
+    assert v.shape == (2, k) and (v == 0).all()
+
+    on = jnp.asarray(np.ones(k, bool))
+    rows = KV.write_apply(rows, ka, on, jnp.full((k,), 7, jnp.int32))
+    v = view(rows)
+    assert (v[0] == 7).all() and (v[1] == 1).all()
+
+    # value-compare CAS: hit on key 2 only (frm matches), miss elsewhere
+    frm = np.zeros(k, np.int32)
+    frm[2] = 7
+    rows = KV.cas_apply(rows, ka, on, jnp.asarray(frm),
+                        jnp.full((k,), 9, jnp.int32))
+    v = view(rows)
+    others = [i for i in range(k) if i != 2]
+    assert v[0, 2] == 9 and v[1, 2] == 2
+    assert (v[0, others] == 7).all() and (v[1, others] == 1).all()
+
+    # version-compare CAS (the txn commit primitive): hit where ver==1
+    rows = KV.cas_ver_apply(rows, ka, on, jnp.ones((k,), jnp.int32),
+                            jnp.full((k,), 11, jnp.int32))
+    v = view(rows)
+    assert v[0, 2] == 9 and v[1, 2] == 2                    # ver 2: miss
+    assert (v[0, others] == 11).all() and (v[1, others] == 2).all()
+
+    # masked off: nothing moves
+    rows2 = KV.cas_apply(rows, ka, jnp.zeros((k,), bool),
+                         jnp.asarray(v[0]), jnp.asarray(v[0] + 1))
+    assert (np.asarray(rows2.vals) == np.asarray(rows.vals)).all()
+    assert (np.asarray(rows2.vers) == np.asarray(rows.vers)).all()
+
+
+def test_rows_wipe_fires_on_the_amnesia_coin_only():
+    n, k = 4, 8
+    spec = faults.NemesisSpec(n_nodes=n, seed=0, crash=((1, 3, (2,)),))
+    plan = spec.compile()
+    lay = KV.make_layout(k, n, seed=1)
+    vals = jnp.arange(n * lay.cap, dtype=jnp.int32).reshape(n, lay.cap)
+    rows = KV.KVRows(vals=vals + 1, vers=jnp.ones_like(vals))
+    ids = jnp.arange(n, dtype=jnp.int32)
+    wiped_rounds = []
+    for t in range(6):
+        out = KV.rows_wipe(rows, plan, jnp.int32(t), ids)
+        zeroed = np.asarray(out.vals == 0).all(axis=1)
+        assert not zeroed[[0, 1, 3]].any(), t   # only the crashed node
+        if zeroed[2]:
+            wiped_rounds.append(t)
+            assert np.asarray(out.vers)[2].sum() == 0
+    # exactly one restart edge inside the horizon
+    assert len(wiped_rounds) == 1
+
+
+# -- counter: device backend bit-exact vs host ---------------------------
+
+
+def _counter_pair(n, spec, **kw):
+    return [CounterSim(n, mode="cas", seed=7,
+                       fault_plan=spec.compile(), kv_backend=b, **kw)
+            for b in ("host", "device")]
+
+
+def test_counter_device_backend_bit_exact_vs_host():
+    n, rounds = 8, 12
+    spec = faults.NemesisSpec(n_nodes=n, seed=4, crash=((1, 3, (2,)),),
+                              loss_rate=0.2, loss_until=5)
+    sims = _counter_pair(n, spec, poll_every=2)
+    deltas = np.arange(1, n + 1, dtype=np.int32)
+    states = [s.add(s.init_state(), deltas) for s in sims]
+    for t in range(rounds):
+        states = [s.step(st) for s, st in zip(sims, states)]
+        h, d = states
+        assert (np.asarray(h.pending) == np.asarray(d.pending)).all(), t
+        assert (np.asarray(h.cached) == np.asarray(d.cached)).all(), t
+        assert int(h.kv) == int(d.kv), t
+        assert int(h.msgs) == int(d.msgs), t
+    # node 2's acked-but-unflushed delta died with its crash (the
+    # ack-before-durability risk — node-state amnesia, SAME on both
+    # backends); everything else landed
+    assert int(states[1].kv) == int(deltas.sum()) - int(deltas[2])
+    # the sharded rows agree with the carried scalar (store == truth)
+    lay = sims[1]._kv_layout
+    i, c = int(lay.owner[0]), int(lay.slot[0])
+    assert (int(np.asarray(states[1].rows.vals)[i, c])
+            == int(states[1].kv))
+    # the fused driver lands the identical ledger and value
+    st_f = sims[1].run_fused(
+        sims[1].add(sims[1].init_state(), deltas), rounds)
+    assert int(st_f.msgs) == int(states[1].msgs)
+    assert int(st_f.kv) == int(states[1].kv)
+
+
+def test_counter_device_backend_bit_exact_on_8way_mesh():
+    n, rounds = 16, 10
+    spec = faults.NemesisSpec(n_nodes=n, seed=9, crash=((2, 4, (5,)),),
+                              loss_rate=0.15, loss_until=6)
+    single = CounterSim(n, mode="cas", poll_every=2, seed=3,
+                        fault_plan=spec.compile(), kv_backend="device")
+    sharded = CounterSim(n, mode="cas", poll_every=2, seed=3,
+                         fault_plan=spec.compile(),
+                         kv_backend="device", mesh=mesh_8())
+    deltas = np.arange(1, n + 1, dtype=np.int32)
+    a = single.add(single.init_state(), deltas)
+    b = sharded.add(sharded.init_state(), deltas)
+    for t in range(rounds):
+        a, b = single.step(a), sharded.step(b)
+        assert (np.asarray(a.pending) == np.asarray(b.pending)).all(), t
+        assert (np.asarray(a.cached) == np.asarray(b.cached)).all(), t
+        assert int(a.kv) == int(b.kv), t
+        assert int(a.msgs) == int(b.msgs), t
+        assert (np.asarray(a.rows.vals) == np.asarray(b.rows.vals)).all()
+
+
+def test_counter_kv_amnesia_loses_acked_flushes():
+    """kv_amnesia composes the FaultPlan's restart coin into the KV
+    rows: the crashed OWNER's registers die with it, so sums flushed
+    before the wipe are genuinely lost — the durable-service twin
+    (default) keeps them.  This is the falsifiable direction of the
+    KVService pin: amnesia MUST diverge."""
+    n = 6
+    owner = int(KV.host_owner_of(np.array([0]), n, 7)[0])
+    spec = faults.NemesisSpec(n_nodes=n, seed=2,
+                              crash=((1, 3, (owner,)),))
+    durable, amnesic = (
+        CounterSim(n, mode="cas", poll_every=0, seed=7,
+                   fault_plan=spec.compile(), kv_backend="device",
+                   kv_amnesia=flag)
+        for flag in (False, True))
+    deltas = np.arange(1, n + 1, dtype=np.int32)
+    # the crashing owner contributes nothing itself, so its node-state
+    # amnesia (pending wipe, both flags) cannot mask the ROW wipe —
+    # any shortfall below is lost COMMITTED sums, not lost acks
+    deltas[owner] = 0
+    std = durable.run(durable.add(durable.init_state(), deltas), n + 4)
+    sta = amnesic.run(amnesic.add(amnesic.init_state(), deltas), n + 4)
+    assert int(std.kv) == int(deltas.sum())        # durable: all there
+    assert 0 < int(sta.kv) < int(deltas.sum())     # amnesia: real loss
+
+
+# -- kafka: device backend bit-exact vs host -----------------------------
+
+
+def _drive_kafka(sim, mesh=None):
+    """A scripted allocator/commit dance; returns the observable trail
+    (lin-kv cells, per-node committed HWMs, ledger) after each phase."""
+    n = 8
+    st = sim.init_state()
+    trail = []
+
+    def snap(st):
+        trail.append((sim.lin_kv(st),
+                      {i: sim.list_committed(st, i) for i in range(n)},
+                      int(st.msgs)))
+
+    # phase A: burst sends on key 0 (nodes 0-3) + key 1 (nodes 4-5)
+    sk = np.full((n, 1), -1, np.int32)
+    sv = np.zeros((n, 1), np.int32)
+    sk[0:4, 0] = 0
+    sk[4:6, 0] = 1
+    sv[0:6, 0] = np.arange(10, 16, dtype=np.int32)
+    st = sim.step(st, sk, sv)
+    snap(st)
+    # phase B: commit dances — active, overshoot-learn, local-skip
+    cr = np.full((n, 2), -1, np.int32)
+    cr[0, 0] = 2
+    cr[6, 0] = 1
+    cr[4, 1] = 1
+    st = sim.step(st, commit_req=cr)
+    snap(st)
+    # phase C: a second send wave + a contended commit CAS
+    sk2 = np.full((n, 1), -1, np.int32)
+    sv2 = np.zeros((n, 1), np.int32)
+    sk2[7, 0] = 0
+    sv2[7, 0] = 99
+    st = sim.step(st, sk2, sv2)
+    cr2 = np.full((n, 2), -1, np.int32)
+    cr2[2, 0] = 4
+    cr2[3, 0] = 4
+    st = sim.step(st, commit_req=cr2)
+    snap(st)
+    trail.append([sim.poll(st, i, 0, 0) for i in range(n)])
+    return trail
+
+
+def test_kafka_device_backend_bit_exact_vs_host():
+    host = KafkaSim(8, 2, capacity=32, max_sends=1)
+    dev = KafkaSim(8, 2, capacity=32, max_sends=1, kv_backend="device")
+    assert _drive_kafka(host) == _drive_kafka(dev)
+
+
+def test_kafka_device_backend_bit_exact_on_8way_mesh():
+    single = KafkaSim(8, 2, capacity=32, max_sends=1,
+                      kv_backend="device")
+    sharded = KafkaSim(8, 2, capacity=32, max_sends=1,
+                       kv_backend="device", mesh=mesh_8())
+    assert _drive_kafka(single) == _drive_kafka(sharded)
+
+
+# -- dup-stream rejection (ROADMAP item 6, the still-open half) ---------
+
+
+def test_device_backend_rejects_dup_streams_loudly():
+    dup = faults.NemesisSpec(n_nodes=4, seed=0, dup_rate=0.2,
+                             dup_until=4)
+    with pytest.raises(ValueError, match="dup"):
+        CounterSim(4, mode="cas", kv_backend="device",
+                   fault_plan=dup.compile())
+    with pytest.raises(ValueError, match="dup"):
+        KafkaSim(4, 2, capacity=16, kv_backend="device",
+                 fault_plan=dup.compile())
+    with pytest.raises(ValueError, match="dup"):
+        TX.TxnSim(4, 8, fault_plan=dup.compile())
+    # the host backend keeps its id-correlated dedup semantics
+    CounterSim(4, mode="cas", fault_plan=dup.compile())
+    # and loss+crash plans stay accepted on the device backend
+    ok = faults.NemesisSpec(n_nodes=4, seed=0, loss_rate=0.2,
+                            loss_until=4, crash=((1, 2, (0,)),))
+    CounterSim(4, mode="cas", kv_backend="device",
+               fault_plan=ok.compile())
+
+
+# -- audit contract: the zero-all-gather HLO gate -----------------------
+
+
+def test_kvstore_sharded_cas_contract_is_all_reduce_only():
+    cs = {c.name: c for c in KV.audit_contracts()}
+    res = audit.audit_contract(cs["kvstore/sharded-cas-step"], mesh_8())
+    assert res["ok"], res
+    counts = res["checks"]["collectives"]["counts"]
+    assert counts.get("all-gather", 0) == 0
+    assert counts.get("all-reduce", 0) >= 1
+
+
+# -- declared traced/host split (determinism lint) ----------------------
+
+
+def test_kvstore_traced_host_split_is_total():
+    src = open(os.path.join(PKG_DIR, "tpu_sim", "kvstore.py")).read()
+    tree = ast_mod.parse(src)
+    top_fns = {node.name for node in tree.body
+               if isinstance(node, ast_mod.FunctionDef)}
+    declared = set(KV.TRACED_EVALUATORS) | set(KV.HOST_SIDE)
+    assert top_fns == declared, (
+        f"undeclared: {sorted(top_fns - declared)}, "
+        f"stale: {sorted(declared - top_fns)}")
+    pat = audit._root_pattern_for("tpu_sim/kvstore.py")
+    for name in KV.TRACED_EVALUATORS:
+        assert pat.match(name), name
+    for name in KV.HOST_SIDE:
+        assert not pat.match(name), name

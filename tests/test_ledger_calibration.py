@@ -352,6 +352,103 @@ def test_counter_kv_retries_lossy_harness_ledger_calibration():
             == net.services["seq-kv"].store[cfg.kv_key] == 7)
 
 
+def test_counter_stale_read_coins_calibrate_wire_counts():
+    """PR 14 seq-kv staleness calibration: the device backend's seeded
+    stale-read coins (tpu_sim/kvstore.py ``stale_coin``) injected into
+    the harness KVService via ``stale_coin_fn`` make the counter's
+    flush retry ladder pay IDENTICAL wire-message counts on both
+    backends — each fired coin serves the behind loser one more stale
+    read, whose doomed CAS costs exactly one extra 4-message wave.
+
+    Scenario (seed-searched against the HOST twins of the device's
+    two coin streams, both pure functions): two contenders, the
+    device's hashed round-0 winner is n0 (matching the harness's
+    delivery-order winner), and the stale coin fires for the loser n1
+    at round 0 — so wave 1 re-serves n1 its pre-CAS value, wave 2
+    (past ``stale_until``) is fresh and commits.  Ladder: 8 + 4
+    (stale retry) + 4 = 16 messages, vs the stale-free 12."""
+    from gossip_glomers_tpu.tpu_sim import kvstore as KV
+
+    n, until, deltas = 2, 1, (5, 9)
+    num = int(KV.stale_num_of(0.5))
+
+    def dev_winner_round0(seed: int) -> int:
+        # host mirror of the cas-mode packed winner key at t=0
+        # (counter.py _round: hash-min over fresh contenders)
+        row_bits = max(1, (n - 1).bit_length())
+        pri_bits = 31 - row_bits
+        ids = np.arange(n, dtype=np.uint32)
+        tt = np.uint32((seed * 0x85EBCA6B) & 0xFFFFFFFF)
+        x = ids * np.uint32(0x9E3779B9) + tt
+        x = x ^ (x >> np.uint32(16))
+        x = x * np.uint32(0x7FEB352D)
+        x = x ^ (x >> np.uint32(15))
+        pri = np.minimum(
+            (x >> np.uint32(32 - pri_bits)).astype(np.int32),
+            np.int32(2 ** pri_bits - 2))
+        return int(np.argmin((pri << row_bits) | ids.astype(np.int32)))
+
+    seed = next(
+        s for s in range(256)
+        if dev_winner_round0(s) == 0
+        and int(KV.host_stale_coin(s, 0, np.array([1]))[0]) < num)
+
+    # -- virtual harness: KVService with the device coin stream --------
+    cfg = CounterConfig(flush_interval=1.0, retry_min=0.1,
+                        retry_max=0.1, poll_interval=1e6)
+    net = VirtualNetwork(NetConfig(seed=0))
+    for i in range(n):
+        net.spawn(f"n{i}", CounterProgram(cfg))
+
+    def coin(now: float, src: str, key: str) -> bool:
+        # flush wave k sits at now == 1.0 + 0.1 * k; its read maps to
+        # the refresh the device served at the END of round k-1
+        k = int(round((now - 1.0) * 10))
+        t_dev = k - 1
+        if t_dev < 0 or t_dev >= until:
+            return False
+        node = int(src[1:])
+        return int(KV.host_stale_coin(seed, t_dev,
+                                      np.array([node]))[0]) < num
+
+    svc = KVService(net, "seq-kv", stale_coin_fn=coin)
+    net.add_service(svc)
+    net.init_cluster()
+    net.client("c9").rpc("seq-kv", {"type": "write", "key": cfg.kv_key,
+                                    "value": 0})
+    net.run_for(0.0)
+    client = net.client("c1")
+    for i in range(n):
+        client.rpc(f"n{i}", {"type": "add", "delta": deltas[i]})
+    net.run_for(0.0)
+    assert net.ledger.server_to_server == 0
+    net.run_for(1.05)                 # through wave 0
+    # the harness's delivery-order winner must be the searched-for n0
+    # (else the coin would be gating the wrong survivor)
+    assert svc.store[cfg.kv_key] == deltas[0]
+    net.run_for(0.45)                 # waves 1 (stale) and 2 (commit)
+    harness_msgs = net.ledger.server_to_server
+    assert svc.stale_served == 1
+    assert svc.errors_by_code[22] == 2   # wave-0 loss + the stale CAS
+    assert svc.store[cfg.kv_key] == sum(deltas)
+
+    # -- device twin: same coins drive the rows-backed retry ladder ----
+    sim = CounterSim(n, mode="cas", poll_every=0, kv_backend="device",
+                     stale_prob=0.5, stale_until=until, seed=seed)
+    st = sim.add(sim.init_state(), np.array(deltas, np.int32))
+    st = sim.run(st, 3)
+    assert harness_msgs == int(st.msgs) == 16
+    assert int(sim.kv_value(st)) == sum(deltas)
+
+    # stale-free control: the same ladder without the coin is 4*(2+1)
+    sim0 = CounterSim(n, mode="cas", poll_every=0,
+                      kv_backend="device", seed=seed)
+    st0 = sim0.add(sim0.init_state(), np.array(deltas, np.int32))
+    st0 = sim0.run(st0, 2)
+    assert int(st0.msgs) == 12
+    assert int(sim0.kv_value(st0)) == sum(deltas)
+
+
 # -- kafka --------------------------------------------------------------
 
 

@@ -1,0 +1,396 @@
+"""txn-rw-register workload (tpu_sim/txn.py, PR 14): wound-or-die
+batched transactions over the sharded device KV, the on-device
+serializability record (per-op version/value stamps + commit-round
+provenance), and the host checker's FALSIFIABILITY — every anomaly
+class (lost update, G1a, G1b, write cycle, round-order violation,
+lost acked commit) is planted into a hand-crafted history and must
+fail loudly naming the offending transaction ids.  Driver parity
+(step vs run vs run_fused, single device vs the 8-way virtual mesh),
+the nemesis runner's two-sided certification (crash+loss certifies
+clean; ``kv_amnesia`` owner wipes MUST fail with named lost updates
+and a replayable flight bundle), the scenario-axis batch (64 fuzzed
+crash+loss campaigns certified in ONE dispatch — the acceptance
+criterion), the fuzz/frontier smokes, the zero-all-gather audit
+contract, and the declared traced/host splits' totality.
+"""
+
+import ast as ast_mod
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import gossip_glomers_tpu
+from gossip_glomers_tpu.harness import fuzz as FZ
+from gossip_glomers_tpu.harness import observe
+from gossip_glomers_tpu.harness import txn as HTX
+from gossip_glomers_tpu.harness.checkers import check_txn_serializable
+from gossip_glomers_tpu.tpu_sim import audit, faults
+from gossip_glomers_tpu.tpu_sim import kvstore as KV
+from gossip_glomers_tpu.tpu_sim import scenario as SC
+from gossip_glomers_tpu.tpu_sim import txn as TX
+
+PKG_DIR = os.path.dirname(gossip_glomers_tpu.__file__)
+
+
+def mesh_8() -> Mesh:
+    return Mesh(np.array(jax.devices()).reshape(8), ("nodes",))
+
+
+def _trees_equal(a, b) -> bool:
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree_util.tree_leaves(a),
+                               jax.tree_util.tree_leaves(b)))
+
+
+# -- clean runs + driver parity ------------------------------------------
+
+
+def test_clean_run_commits_all_and_serializes():
+    n, t_dim = 8, 4
+    sim = TX.TxnSim(n, 8, txns_per_node=t_dim, rate=0.5, until=12,
+                    workload_seed=11)
+    st = sim.init_state()
+    for _ in range(40):
+        st = sim.step(st)
+        if bool(np.all(np.asarray(st.cur) >= np.asarray(st.arrived))) \
+                and int(st.t) >= 12:
+            break
+    hist = TX.history_of(st, sim.ops)
+    final = TX.final_registers(st, sim.layout)
+    ok, det = check_txn_serializable(hist, final=final)
+    assert ok, det["problems"]
+    assert det["by_kind"] == {}
+    committed = [h for h in hist if h["status"] == "committed"]
+    assert det["n_committed"] == len(committed) == len(hist)
+    # provenance stamps: every committed txn carries a round pair
+    # with commit >= issue (wound-or-die retries only move commit up)
+    for h in committed:
+        assert 0 <= h["issue_round"] <= h["commit_round"]
+    # the store's registers really are the max committed versions
+    for key, (val, ver) in final.items():
+        installs = [op for h in committed for op in h["ops"]
+                    if op["kind"] == "w" and op["key"] == key]
+        if installs:
+            top = max(op["ver"] for op in installs)
+            assert ver == top
+            assert val in [op["val"] for op in installs
+                           if op["ver"] == top]
+
+
+def test_step_run_fused_and_mesh_all_bit_exact():
+    n = 16
+    spec = faults.NemesisSpec(n_nodes=n, seed=7,
+                              crash=((2, 4, (3,)),),
+                              loss_rate=0.2, loss_until=5)
+    kw = dict(txns_per_node=4, ops_per_txn=2, rate=0.5, until=10,
+              workload_seed=3, fault_plan=spec.compile())
+    single = TX.TxnSim(n, 8, **kw)
+    meshed = TX.TxnSim(n, 8, mesh=mesh_8(), **kw)
+    sa, sb = single.init_state(), meshed.init_state()
+    for _ in range(14):
+        sa, sb = single.step(sa), meshed.step(sb)
+        assert _trees_equal(sa, sb), int(sa.t)
+    assert _trees_equal(single.run(single.init_state(), 14), sa)
+    assert _trees_equal(single.run_fused(single.init_state(), 14), sa)
+
+
+# -- nemesis runner: certify clean, fail loudly under kv_amnesia ---------
+
+
+def test_nemesis_certifies_crash_loss_campaign():
+    spec = faults.NemesisSpec(n_nodes=8, seed=3,
+                              crash=((3, 6, (4,)),),
+                              loss_rate=0.2, loss_until=6)
+    res = HTX.run_txn_nemesis(spec, n_keys=8, until=12,
+                              max_recovery_rounds=48)
+    assert res["ok"] and res["serializable"]
+    assert res["serializability"]["by_kind"] == {}
+    assert res["n_lost_writes"] == 0
+    assert res["converged_round"] is not None
+    assert res["provenance"]["check"]["ok"]
+    # stamps rode the state: one (issue, commit) pair per slot
+    arr = res["provenance"]["arrays"]
+    assert np.asarray(arr["issue_round"]).shape == (8, 4)
+    assert np.asarray(arr["commit_round"]).shape == (8, 4)
+
+
+def test_kv_amnesia_fails_loudly_with_named_lost_updates(tmp_path):
+    # node 4 owns keys {0, 2, 6} under the default layout seed — its
+    # crash with kv_amnesia wipes acked registers, so later commits
+    # re-install already-acked versions: the planted lost update
+    n, n_keys = 8, 8
+    owners = KV.host_owner_of(np.arange(n_keys, dtype=np.int32), n, 0)
+    own = int(owners[0])
+    spec = faults.NemesisSpec(n_nodes=n, seed=3,
+                              crash=((3, 6, (own,)),))
+    res = HTX.run_txn_nemesis(spec, n_keys=n_keys, until=12,
+                              max_recovery_rounds=48,
+                              kv_amnesia=True,
+                              observe_dir=str(tmp_path))
+    assert not res["ok"] and not res["serializable"]
+    lost = [p for p in res["serializability"]["problems"]
+            if p["kind"] in ("lost-update", "lost-acked-commit")]
+    assert lost
+    for p in lost:
+        assert p["txns"], p           # every verdict names txn ids
+    # the identical spec WITHOUT owner wipes certifies clean — the
+    # failure is the amnesia, not the crash
+    durable = HTX.run_txn_nemesis(spec, n_keys=n_keys, until=12,
+                                  max_recovery_rounds=48)
+    assert durable["ok"] and durable["serializable"]
+    # flight bundle: written on failure, replays to the same verdict
+    # with bit-faithful per-transaction stamps
+    bundle = res["flight_bundle"]
+    assert os.path.exists(bundle)
+    replay = observe.replay_bundle(bundle)
+    assert not replay["ok"]
+    assert replay["serializability"]["by_kind"] == \
+        res["serializability"]["by_kind"]
+    assert replay["first_divergence_round"] is None
+
+
+def test_nemesis_rejects_telemetry_series():
+    spec = faults.NemesisSpec(n_nodes=4, seed=0)
+    with pytest.raises(ValueError, match="stamps"):
+        HTX.run_txn_nemesis(spec, telemetry=True)
+
+
+# -- scenario-axis batch -------------------------------------------------
+
+
+def test_batch_rows_match_sequential_runner():
+    n = 8
+    specs = [
+        faults.NemesisSpec(n_nodes=n, seed=11),
+        faults.NemesisSpec(n_nodes=n, seed=5, crash=((2, 5, (1,)),)),
+        faults.NemesisSpec(n_nodes=n, seed=9, loss_rate=0.3,
+                           loss_until=8),
+        faults.NemesisSpec(n_nodes=n, seed=4,
+                           crash=((3, 6, (2, 5)),),
+                           loss_rate=0.2, loss_until=6),
+    ]
+    batch = SC.ScenarioBatch(
+        workload="txn",
+        scenarios=tuple(SC.Scenario(spec=sp, workload_seed=sp.seed)
+                        for sp in specs),
+        runner_kw=dict(n_keys=8, txns_per_node=4, ops_per_txn=2,
+                       rate=0.5, until=12),
+        max_recovery_rounds=32)
+    res = SC.run_txn_batch(batch)
+    assert res["ok"] and len(res["scenarios"]) == 4
+    for sp, row in zip(specs, res["scenarios"]):
+        seq = HTX.run_txn_nemesis(sp, n_keys=8, until=12,
+                                  workload_seed=sp.seed,
+                                  max_recovery_rounds=32)
+        assert row["ok"] == seq["ok"]
+        assert row["converged_round"] == seq["converged_round"]
+        assert row["msgs_total"] == seq["msgs_total"]
+        assert row["n_committed"] == seq["n_committed"]
+        assert row["serializable"] == seq["serializable"]
+
+
+def test_batch_64_fuzzed_scenarios_certify_in_one_dispatch():
+    # THE acceptance criterion: >= 64 fuzzed crash+loss txn campaigns
+    # in ONE batched dispatch on the 8-way mesh, every scenario's
+    # history serializable with zero lost acked commits
+    scs = FZ.sample_scenarios("txn", 64, n_nodes=16, seed=3,
+                              horizon=8)
+    assert sum(1 for s in scs if s.spec.crash) >= 16
+    assert sum(1 for s in scs if s.spec.loss_rate) >= 16
+    batch = SC.ScenarioBatch(
+        workload="txn", scenarios=tuple(scs),
+        runner_kw=dict(n_keys=8, txns_per_node=4, ops_per_txn=2,
+                       rate=0.5, until=16),
+        max_recovery_rounds=48)
+    res = SC.run_txn_batch(batch, mesh=mesh_8())
+    assert res["ok"], res["failing"]
+    assert len(res["scenarios"]) == 64
+    for row in res["scenarios"]:
+        assert row["serializable"]
+        assert row["ser_by_kind"] == {}
+        assert row["n_lost_writes"] == 0
+    assert sum(r["n_committed"] for r in res["scenarios"]) > 0
+
+
+def test_batch_rejects_dup_scenarios_loudly():
+    dup = faults.NemesisSpec(n_nodes=8, seed=0, dup_rate=0.2,
+                             dup_until=4)
+    batch = SC.ScenarioBatch(
+        workload="txn", scenarios=(SC.Scenario(spec=dup),),
+        runner_kw=dict(until=8))
+    with pytest.raises(ValueError, match="dup"):
+        SC.run_txn_batch(batch)
+
+
+# -- checker falsifiability (one planted history per anomaly) ------------
+
+
+def _txn(tid, ops, *, status="committed", commit=1, issue=0):
+    return {"id": tid, "node": 0, "slot": tid, "status": status,
+            "issue_round": issue, "commit_round": commit,
+            "ops": [{"kind": k, "key": key, "ver": ver, "val": val}
+                    for k, key, ver, val in ops]}
+
+
+def test_checker_passes_a_clean_history():
+    hist = [
+        _txn(1, [("w", 0, 1, 5)], commit=1),
+        _txn(2, [("r", 0, 1, 5), ("w", 1, 1, 6)], commit=2),
+    ]
+    ok, det = check_txn_serializable(
+        hist, final={0: (5, 1), 1: (6, 1)})
+    assert ok, det["problems"]
+    assert det["n_edges"] >= 1
+
+
+def test_checker_flags_planted_lost_update():
+    hist = [
+        _txn(1, [("w", 0, 1, 5)], commit=1),
+        _txn(7, [("w", 0, 1, 9)], commit=3),
+    ]
+    ok, det = check_txn_serializable(hist)
+    assert not ok
+    [p] = [p for p in det["problems"] if p["kind"] == "lost-update"]
+    assert p["txns"] == [1, 7] and p["key"] == 0 and p["ver"] == 1
+
+
+def test_checker_flags_planted_g1a_aborted_read():
+    hist = [
+        _txn(3, [("w", 0, 1, 42)], status="open", commit=-1),
+        _txn(8, [("r", 0, 1, 42)], commit=2),
+    ]
+    ok, det = check_txn_serializable(hist)
+    assert not ok
+    [p] = [p for p in det["problems"]
+           if p["kind"] == "G1a-aborted-read"]
+    assert p["txns"] == [3, 8] and p["val"] == 42
+
+
+def test_checker_flags_planted_g1b_intermediate_read():
+    hist = [
+        _txn(1, [("w", 0, 1, 7)], commit=1),
+        _txn(2, [("r", 0, 1, 8)], commit=2),
+    ]
+    ok, det = check_txn_serializable(hist)
+    assert not ok
+    [p] = [p for p in det["problems"]
+           if p["kind"] == "G1b-intermediate-read"]
+    assert p["txns"] == [1, 2]
+    assert p["saw"] == 8 and p["committed"] == [7]
+
+
+def test_checker_flags_planted_write_skew_cycle():
+    # classic write skew: each reads the OTHER's key at v0, then
+    # writes its own — rw edges both ways, a cycle with no lost write
+    hist = [
+        _txn(1, [("r", 0, 0, 0), ("w", 1, 1, 5)], commit=2),
+        _txn(2, [("r", 1, 0, 0), ("w", 0, 1, 6)], commit=2),
+    ]
+    ok, det = check_txn_serializable(hist)
+    assert not ok
+    [p] = [p for p in det["problems"] if p["kind"] == "write-cycle"]
+    assert p["txns"] == [1, 2]
+    assert set(p["cycle"]) == {1, 2}
+
+
+def test_checker_flags_planted_round_order_violation():
+    # a wr dependency running BACKWARD in commit rounds falsifies the
+    # linearization claim even before any cycle closes
+    hist = [
+        _txn(1, [("w", 0, 1, 3)], commit=5),
+        _txn(2, [("r", 0, 1, 3)], commit=2),
+    ]
+    ok, det = check_txn_serializable(hist)
+    assert not ok
+    [p] = [p for p in det["problems"]
+           if p["kind"] == "round-order-violation"]
+    assert p["txns"] == [1, 2] and tuple(p["rounds"]) == (5, 2)
+
+
+def test_checker_flags_planted_lost_acked_commit():
+    hist = [_txn(4, [("w", 0, 1, 9)], commit=1)]
+    ok, det = check_txn_serializable(hist, final={0: (0, 0)})
+    assert not ok
+    [p] = [p for p in det["problems"]
+           if p["kind"] == "lost-acked-commit"]
+    assert p["txns"] == [4]
+    assert p["final_ver"] == 0 and p["max_committed_ver"] == 1
+
+
+def test_checker_flags_dangling_version_read():
+    hist = [_txn(6, [("r", 0, 3, 77)], commit=1)]
+    ok, det = check_txn_serializable(hist)
+    assert not ok
+    [p] = [p for p in det["problems"]
+           if p["kind"] == "dangling-version-read"]
+    assert p["txns"] == [6]
+
+
+# -- fuzz + frontier smokes ----------------------------------------------
+
+
+def test_fuzz_run_txn_smoke():
+    res = FZ.fuzz_run("txn", 8, n_nodes=8, batch_size=4, horizon=6,
+                      max_recovery_rounds=32, seed=7, shrink=False,
+                      runner_kw=dict(n_keys=8, until=10))
+    assert res["n_failing"] == 0
+    assert res["n_certified_ok"] == len(res["rows"]) == 8
+    for row in res["rows"]:
+        assert row["serializable"]
+    # no telemetry ring for this workload: signatures/adapt refuse
+    with pytest.raises(ValueError, match="stamps"):
+        FZ.fuzz_run("txn", 4, n_nodes=8, batch_size=4, horizon=6,
+                    signatures=True)
+    with pytest.raises(ValueError, match="planted-failure"):
+        FZ.planted_failure("txn", 8, 6)
+
+
+def test_frontier_txn_smoke_with_slo():
+    specs = [faults.NemesisSpec(n_nodes=8, seed=1),
+             faults.NemesisSpec(n_nodes=8, seed=2,
+                                crash=((2, 4, (1,)),))]
+    res = HTX.run_txn_frontier(
+        [0.3, 0.8], specs, n_keys=8, until=10,
+        max_recovery_rounds=32,
+        slo={"p99_max_rounds": 40, "max_recovery_rounds": 32})
+    assert res["ok"] and res["n_cells"] == 4
+    for cell in res["cells"]:
+        assert cell["slo_ok"]
+        assert cell["lat_p50"] <= cell["lat_p99"] <= cell["lat_max"]
+        assert cell["n_committed"] > 0
+
+
+# -- audit contract + declared split totality ----------------------------
+
+
+def test_txn_sharded_step_contract_is_all_reduce_only():
+    [contract] = [c for c in TX.audit_contracts()
+                  if c.name == "txn/sharded-step"]
+    res = audit.audit_contract(contract, mesh_8())
+    assert res["ok"], res
+    counts = res["checks"]["collectives"]["counts"]
+    assert counts.get("all-gather", 0) == 0
+    assert counts.get("all-reduce", 0) >= 1
+
+
+@pytest.mark.parametrize("mod, relpath", [
+    (TX, os.path.join("tpu_sim", "txn.py")),
+    (HTX, os.path.join("harness", "txn.py")),
+])
+def test_txn_traced_host_split_is_total(mod, relpath):
+    src = open(os.path.join(PKG_DIR, relpath)).read()
+    tree = ast_mod.parse(src)
+    top_fns = {node.name for node in tree.body
+               if isinstance(node, ast_mod.FunctionDef)}
+    declared = set(mod.TRACED_EVALUATORS) | set(mod.HOST_SIDE)
+    assert top_fns == declared, (
+        f"undeclared: {sorted(top_fns - declared)}, "
+        f"stale: {sorted(declared - top_fns)}")
+    pat = audit._root_pattern_for(relpath.replace(os.sep, "/"))
+    for name in mod.TRACED_EVALUATORS:
+        assert pat.match(name), name
+    for name in mod.HOST_SIDE:
+        assert not pat.match(name), name
