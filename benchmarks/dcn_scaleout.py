@@ -1,0 +1,228 @@
+"""DCN scale-out benchmark (PR 15) -> BENCH_PR15.json.
+
+Four evidence legs for the hierarchical ICI x DCN story:
+
+1. **Broadcast past the single-host wall** — the config-7 scale sweep
+   recorded the 16.8M-node w=128 tree as "exceeds single-chip HBM:
+   ~3 x 8.6 GB state".  With the node axis host-split, each host
+   holds N/H rows and the SAME 3-array analytic model
+   (:func:`engine.analytic_peak_bytes`: donated received+frontier +
+   one exchange temp) prices the per-host footprint: the sweep
+   reports the largest power-of-two N per host count, crossing 100M+
+   nodes at 16 hosts.
+2. **Kafka past the presence boundary** — the PR-5 sweep's boundary
+   row (n=262,144, K=N/16: a 34.4 GB presence matrix, ~1.5x donated
+   footprint) host-splits the node-major presence rows the same way.
+3. **Measured multi-process rows** — a REAL 2-process gloo cluster
+   (scripts/dcn_smoke.py's spawner, shared ``parallel.dcn_worker``)
+   runs the structured-flood round-time anchor (ICI-vs-DCN cost
+   model, digests pinned bit-exact against the 1-host twin) and the
+   certified HOST-loss takeover.
+4. **Fuzzer throughput vs host count** — the 64-scenario counter
+   campaign dispatched on 1 host x 4 devices, then 2 hosts x 4
+   devices: the leading scenario axis splits over DCN with zero
+   cross-host traffic, so per-device scenario load halves; verdict
+   rows are asserted identical across host counts.
+
+CPU: "hosts" are OS processes over gloo — same partitioner, same
+collectives, shared physical cores (so measured speedups are lower
+bounds distorted by core contention; the analytic rows carry the
+memory-scaling claim, the measured rows carry correctness + the cost
+anchors).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from gossip_glomers_tpu.parallel.dcn_worker import (  # noqa: E402
+    spawn_local_cluster)
+from gossip_glomers_tpu.tpu_sim.engine import (  # noqa: E402
+    analytic_peak_bytes)
+
+HBM_BUDGET = 14.0e9          # usable bytes of a 16 GB chip (config 7)
+
+
+def _max_pow2(fits) -> int:
+    n = 1
+    while fits(n * 2):
+        n *= 2
+    return n
+
+
+def broadcast_scale() -> dict:
+    """Largest power-of-two tree broadcast (w=128 words, nv=4096) per
+    host count: per host, received+frontier donated + one exchange
+    temp — 3 x (N/H x w x 4) bytes under the HBM budget."""
+    w = 128
+    rows = []
+    for hosts in (1, 2, 4, 8, 16):
+        def fits(n, hosts=hosts):
+            per_host = n * w * 4 // hosts
+            peak = analytic_peak_bytes(state_bytes=2 * per_host,
+                                       donated=True,
+                                       slab_bytes=per_host)
+            return peak["peak_live_bytes"] <= HBM_BUDGET
+        n = _max_pow2(fits)
+        per_host = n * w * 4 // hosts
+        peak = analytic_peak_bytes(state_bytes=2 * per_host,
+                                   donated=True, slab_bytes=per_host)
+        rows.append({
+            "hosts": hosts, "n_nodes": n, "nv": w * 32,
+            "state_gb_global": round(2 * n * w * 4 / 1e9, 1),
+            "per_host_peak_gb": round(
+                peak["peak_live_bytes"] / 1e9, 2),
+        })
+    single = rows[0]["n_nodes"]
+    top = rows[-1]
+    return {
+        "model": "per-host analytic_peak_bytes: donated "
+                 "received+frontier + 1 exchange temp <= 14 GB",
+        "single_host_ceiling_n": single,
+        "pr3_oom_row": {"n": 16777216, "w_words": 128,
+                        "error": "exceeds single-chip HBM: "
+                                 "~3 x 8.6 GB state"},
+        "rows": rows,
+        "past_16_8M": top["n_nodes"] > 16_777_216,
+        "past_100M": top["n_nodes"] > 100_000_000,
+    }
+
+
+def kafka_scale() -> dict:
+    """Largest power-of-two kafka shape (K=N/16 keys, capacity 64)
+    per host count: the node-major presence rows split over hosts,
+    donated footprint ~1.5 x the per-host presence block."""
+    cap = 64
+    wc = (cap + 31) // 32
+    rows = []
+    for hosts in (1, 16, 64):
+        def fits(n, hosts=hosts):
+            presence = n * (n // 16) * wc * 4
+            peak = analytic_peak_bytes(
+                state_bytes=presence // hosts, donated=True,
+                slab_bytes=presence // (2 * hosts))
+            return n >= 16 and peak["peak_live_bytes"] <= HBM_BUDGET
+        n = _max_pow2(lambda n, f=fits: n < 32 or f(n))
+        presence = n * (n // 16) * wc * 4
+        rows.append({
+            "hosts": hosts, "n_nodes": n, "n_keys": n // 16,
+            "capacity": cap,
+            "presence_gb_global": round(presence / 1e9, 1),
+            "per_host_peak_gb": round(
+                1.5 * presence / hosts / 1e9, 2),
+        })
+    return {
+        "model": "per-host presence block, ~1.5x donated footprint "
+                 "<= 14 GB (the PR-5 boundary convention)",
+        "pr5_boundary_row": {"n": 262144, "n_keys": 16384,
+                             "presence_gb": 34.4},
+        "rows": rows,
+        "past_262144": rows[1]["n_nodes"] > 262_144,
+    }
+
+
+def measured_rows(tmp: str) -> dict:
+    """The real 2-process cluster legs + the 1-host twins."""
+    out = {}
+
+    flat = spawn_local_cluster("roundtime,takeover", tmp, n_procs=1,
+                               local_devices=8)[0]
+    hier = spawn_local_cluster("roundtime,takeover", tmp, n_procs=2,
+                               local_devices=4)
+    r0 = hier[0]
+    rt_flat, rt_hier = (flat["tasks"]["roundtime"],
+                        r0["tasks"]["roundtime"])
+    out["roundtime"] = {
+        "n": rt_flat["n"], "rounds": rt_flat["rounds"],
+        "flat_1x8_us_per_round": rt_flat["us_per_round"],
+        "dcn_2x4_us_per_round": rt_hier["us_per_round"],
+        "dcn_overhead_x": round(
+            rt_hier["us_per_round"] / rt_flat["us_per_round"], 3),
+        "digest_match_across_host_counts":
+            rt_flat["state"] == rt_hier["state"],
+        "note": "the ICI-vs-DCN cost anchor: the DCN hop (loopback "
+                "gloo between processes) dominates the w=1 round by "
+                "~an order of magnitude over in-process ICI — why "
+                "every reduce moves ONE per-host partial over DCN, "
+                "never operands",
+    }
+    tk_flat, tk_hier = (flat["tasks"]["takeover"],
+                        r0["tasks"]["takeover"])
+    out["host_loss_takeover"] = {
+        "n_nodes": 16, "lost_rows": tk_hier["lost_rows"],
+        "certified_converged": bool(tk_hier["converged"]),
+        "rounds": tk_hier["rounds"], "msgs": tk_hier["msgs"],
+        "bit_exact_vs_single_host":
+            {k: tk_flat[k] for k in ("state", "msgs", "rounds")}
+            == {k: tk_hier[k] for k in ("state", "msgs", "rounds")},
+    }
+
+    def _strip(report):
+        return {k: v for k, v in report["tasks"]["batch"].items()
+                if k != "wall_s"}
+
+    h1 = spawn_local_cluster("batch", tmp, n_procs=1,
+                             local_devices=4, timed=True)[0]
+    h2 = spawn_local_cluster("batch", tmp, n_procs=2,
+                             local_devices=4, timed=True)
+    w1 = h1["tasks"]["batch"]["wall_s"]
+    w2 = max(r["tasks"]["batch"]["wall_s"] for r in h2)
+    out["fuzzer_throughput"] = {
+        "n_scenarios": 64,
+        "hosts1_4dev": {"wall_s": w1,
+                        "scenarios_per_sec": round(64 / w1, 2),
+                        "scenarios_per_device": 16},
+        "hosts2_4dev": {"wall_s": w2,
+                        "scenarios_per_sec": round(64 / w2, 2),
+                        "scenarios_per_device": 8},
+        "speedup_x": round(w1 / w2, 2),
+        "verdicts_identical_across_host_counts":
+            _strip(h1) == _strip(h2[0]),
+        "all_certified": bool(h1["tasks"]["batch"]["ok"]),
+        "cross_host_collectives_in_batch_hlo": 0,
+        "note": "single-core CI host: both processes time-slice ONE "
+                "physical core, so measured wall-clock cannot improve "
+                "with host count here.  The linear-in-hosts claim is "
+                "structural: the counter/dcn-scenario-batch audit row "
+                "proves the batched program contains ZERO collectives "
+                "(cap-0 census), so per-host dispatches share nothing "
+                "and per-device scenario load halves exactly "
+                "(16 -> 8) with identical verdict rows",
+    }
+    return out
+
+
+def main() -> int:
+    report = {"benchmark": "dcn_scaleout_pr15", "backend": "cpu",
+              "broadcast_scale": broadcast_scale(),
+              "kafka_scale": kafka_scale()}
+    with tempfile.TemporaryDirectory() as tmp:
+        report.update(measured_rows(tmp))
+    ok = (report["broadcast_scale"]["past_100M"]
+          and report["kafka_scale"]["past_262144"]
+          and report["roundtime"]["digest_match_across_host_counts"]
+          and report["host_loss_takeover"]["certified_converged"]
+          and report["host_loss_takeover"]["bit_exact_vs_single_host"]
+          and report["fuzzer_throughput"][
+              "verdicts_identical_across_host_counts"]
+          and report["fuzzer_throughput"]["all_certified"])
+    report["ok"] = bool(ok)
+    path = os.path.join(REPO, "BENCH_PR15.json")
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    print(json.dumps(report, indent=1))
+    print(f"wrote {path}  ok={ok}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -203,6 +203,7 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
     (``checkers.check_provenance``), and surface the stamps + the
     dissemination-tree summary in ``details['provenance']``."""
     from ..tpu_sim import structured as S
+    from ..tpu_sim.engine import node_axes, node_shards
     from . import observe
     n = spec.n_nodes
     nv = n_values if n_values is not None else 2 * n
@@ -258,12 +259,13 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
     if structured:
         groups = (np.asarray(parts.group) if parts is not None
                   else None)
-        n_shards = (int(mesh.shape["nodes"])
+        n_shards = (node_shards(mesh)
                     if mesh is not None else None)
         kw = dict(exchange=S.make_exchange(topology, n),
                   nemesis=S.make_nemesis(
                       topology, n, spec, groups=groups,
                       n_shards=n_shards,
+                      axis_name=node_axes(mesh),
                       dir_delays=(None if dir_delays is None
                                   else tuple(dir_delays))))
     elif dir_delays is not None:

@@ -25,6 +25,10 @@ from ..utils.config import NetConfig
 
 # drop_fn(src, dest, now) -> True when the link is currently cut
 DropFn = Callable[[str, str, float], bool]
+# down_fn(node_id, now) -> True when that PROCESS is dead: its sends
+# never enter the network (a dead process sends nothing — not charged),
+# unlike drop_fn losses, which are charged at send and die in flight
+DownFn = Callable[[str, float], bool]
 # latency_fn(src, dest, now) -> per-edge delivery latency in seconds;
 # overrides the uniform NetConfig.latency/jitter when set (the virtual
 # analogue of Maelstrom's per-link latency knobs)
@@ -145,6 +149,7 @@ class VirtualNetwork:
         self.clients: dict[str, Client] = {}
         self.ledger = Ledger()
         self.drop_fn: DropFn | None = None
+        self.down_fn: DownFn | None = None
         self.latency_fn: LatencyFn | None = None
         self.trace: list[tuple[float, Message]] | None = None
 
@@ -194,6 +199,8 @@ class VirtualNetwork:
     def submit(self, msg: Message) -> None:
         """Route one message: account it, apply partitions, apply latency,
         deliver."""
+        if self.down_fn is not None and self.down_fn(msg.src, self.now):
+            return
         self.ledger.total += 1
         self.ledger.by_type[msg.type] += 1
         if is_server_msg(msg.src, msg.dest, self.nodes, self.services):

@@ -34,6 +34,7 @@ from ..parallel.topology import grid, to_padded_neighbors, tree
 from ..tpu_sim import traffic
 from ..tpu_sim.broadcast import BroadcastSim
 from ..tpu_sim.counter import CounterSim
+from ..tpu_sim.engine import node_axes, node_shards
 from ..tpu_sim.faults import NemesisSpec
 from ..tpu_sim.kafka import KafkaSim
 from .checkers import check_recovery
@@ -93,8 +94,9 @@ def make_serving_sim(kind: str, tspec: "traffic.TrafficSpec", *,
         kw = dict(sync_every=sync_every, srv_ledger=False, mesh=mesh,
                   fault_plan=plan, **sim_kw)
         if structured:
-            n_sh = (int(mesh.shape["nodes"]) if mesh is not None
+            n_sh = (node_shards(mesh) if mesh is not None
                     else None)
+            n_ax = node_axes(mesh)
             kw["exchange"] = S.make_exchange(topology, n)
             if edge_delay_rows is not None:
                 if nemesis is not None:
@@ -106,18 +108,20 @@ def make_serving_sim(kind: str, tspec: "traffic.TrafficSpec", *,
                 kw["edge_delayed"] = S.make_edge_delayed(
                     topology, n,
                     np.asarray(edge_delay_rows, np.int32),
-                    n_shards=n_sh)
+                    n_shards=n_sh, axis_name=n_ax)
             elif nemesis is not None:
                 kw["nemesis"] = S.make_nemesis(
                     topology, n, nemesis, n_shards=n_sh,
+                    axis_name=n_ax,
                     dir_delays=(None if dir_delays is None
                                 else tuple(dir_delays)))
             elif dir_delays is not None:
                 kw["delayed"] = S.make_delayed(
-                    topology, n, tuple(dir_delays), n_shards=n_sh)
+                    topology, n, tuple(dir_delays), n_shards=n_sh,
+                    axis_name=n_ax)
             elif n_sh is not None:
                 kw["sharded_exchange"] = S.make_sharded_exchange(
-                    topology, n, n_sh)
+                    topology, n, n_sh, axis_name=n_ax)
         try:
             build = _TOPOLOGIES[topology]
         except KeyError:
@@ -253,7 +257,7 @@ def run_serving(kind: str, tspec: "traffic.TrafficSpec", *,
     total_rounds = clear + drained
     details.update(
         workload=kind, n_nodes=tspec.n_nodes, mesh=(
-            None if mesh is None else int(mesh.shape["nodes"])),
+            None if mesh is None else node_shards(mesh)),
         traffic=tspec.to_meta(), **summ,
         offered_per_round=traffic.offered_per_round(tspec),
         sustained_per_round=summ["completed"] / max(1, total_rounds),

@@ -1,0 +1,384 @@
+"""Multi-process DCN worker — the subprocess body behind
+``tests/test_dcn.py`` and ``scripts/dcn_smoke.py``.
+
+Each CI "host" is one of these processes: it joins the
+``jax.distributed`` cluster from the ``GG_*`` env contract
+(``parallel.mesh.DIST_ENV``), builds the hierarchical
+``("hosts", "nodes")`` mesh with :func:`pick_mesh_2d`, runs the task
+list from ``GG_DCN_TASKS`` (comma-separated), and writes one JSON
+digest file per process to ``GG_DCN_OUT`` (suffix ``.<rank>``).
+
+Every reported number is either a replicated ledger scalar or a
+position-weighted uint32 checksum reduced ON DEVICE to a replicated
+scalar — so all ranks compute identical files (asserted by the
+spawner), and the single-process twin can reproduce them bit-for-bit
+on the same global mesh shape without any cross-process state fetch.
+
+Tasks:
+
+- ``sims``      broadcast (grid) + counter (cas) + kafka digests,
+                stepwise AND donated-fused, plus a second counter
+                replay under the same seed (seed-replay determinism
+                across host counts).
+- ``batch``     a 64-scenario counter fault campaign in ONE
+                host-sharded dispatch — per-scenario verdict rows.
+- ``certify``   one certified crash+loss broadcast nemesis run
+                (structured words-major path, ledger-calibrated).
+- ``takeover``  a HOST-loss smoke: every node shard owned by process
+                1 crashed over a window via FaultPlan liveness, the
+                survivors' flood re-converges after restart.
+- ``roundtime`` measured per-round wall time of the structured tree
+                flood at a serving-scale shape — the ICI-vs-DCN
+                cost-model anchor (timing is per-rank and NOT part of
+                the bit-exact surface; the state digest still is).
+
+``GG_DCN_TIME=1`` adds per-task ``wall_s`` to each report (for the
+throughput benchmark; timing differs across ranks, so the parity
+spawners leave it unset).  Run as
+``python -m gossip_glomers_tpu.parallel.dcn_worker``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _digest_fn(jnp):
+    """(device array) -> replicated uint32 checksum, jitted by the
+    caller: 4-byte leaves are bitcast (bit-exact), narrower ones
+    widen losslessly through int32.  Position-weighted so shard-order
+    swaps cannot cancel."""
+    import jax
+
+    def digest(x):
+        if x.dtype == jnp.bool_:
+            x = x.astype(jnp.int32)
+        if x.dtype.itemsize < 4:
+            x = x.astype(jnp.int32)
+        words = jax.lax.bitcast_convert_type(x, jnp.uint32)
+        flat = words.reshape(-1)
+        w = (jnp.arange(flat.shape[0], dtype=jnp.uint32)
+             * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9))
+        return jnp.sum(flat * w, dtype=jnp.uint32)
+
+    return digest
+
+
+def state_digest(state) -> dict:
+    """Checksum every array leaf of a (possibly cross-process sharded)
+    state pytree into replicated host ints, field-keyed."""
+    import jax
+    import jax.numpy as jnp
+
+    digest = jax.jit(_digest_fn(jnp))
+    out = {}
+    for name in state._fields:
+        value = getattr(state, name)
+        if value is None:
+            continue
+        out[name] = int(digest(value))
+    return out
+
+
+def _task_sims(mesh) -> dict:
+    import numpy as np
+
+    from ..tpu_sim.broadcast import BroadcastSim, make_inject
+    from ..tpu_sim.counter import CounterSim
+    from ..tpu_sim.kafka import KafkaSim
+    from .topology import grid, to_padded_neighbors
+
+    res = {}
+    n, nv = 16, 16
+    nbrs = to_padded_neighbors(grid(n))
+    inject = make_inject(n, nv)
+    bres = {}
+    for runner in ("run", "run_fused"):
+        sim = BroadcastSim(nbrs, n_values=nv, mesh=mesh)
+        state, rounds = getattr(sim, runner)(inject)
+        bres[runner] = {"rounds": int(rounds),
+                        "msgs": int(state.msgs),
+                        "state": state_digest(state)}
+    res["broadcast"] = bres
+
+    nc = 8
+    deltas = np.arange(1, nc + 1, dtype=np.int32)
+    cres = {}
+    for runner in ("run", "run_fused", "replay"):
+        sim = CounterSim(nc, mode="cas", seed=7, mesh=mesh)
+        state = getattr(sim, "run" if runner == "replay"
+                        else runner)(sim.add(sim.init_state(),
+                                             deltas), 12)
+        cres[runner] = {"msgs": int(state.msgs),
+                        "state": state_digest(state)}
+    # seed-replay determinism INSIDE this host count; the spawner
+    # asserts it ACROSS host counts too
+    if cres["run"] != cres["replay"]:                # pragma: no cover
+        raise AssertionError("counter seed replay diverged in-process")
+    res["counter"] = cres
+
+    rng = np.random.default_rng(0)
+    sim = KafkaSim(nc, 4, capacity=32, mesh=mesh)
+    state = sim.init_state()
+    for _ in range(6):
+        send_key = rng.integers(-1, 4,
+                                size=(nc, sim.max_sends)).astype(
+                                    np.int32)
+        send_val = rng.integers(0, 100,
+                                size=(nc, sim.max_sends)).astype(
+                                    np.int32)
+        state = sim.step(state, send_key, send_val)
+    res["kafka"] = {"msgs": int(state.msgs),
+                    "state": state_digest(state)}
+    return res
+
+
+def _task_batch(mesh) -> dict:
+    from ..tpu_sim import scenario as SC
+    from ..tpu_sim.faults import random_spec
+
+    from ..tpu_sim.faults import NemesisSpec
+
+    n, s_count = 16, 64
+    specs = []
+    for s in range(s_count):
+        sp = random_spec(n, seed=s, horizon=8,
+                         n_crash_windows=1 + (s % 2), loss_rate=0.1)
+        # crash after the cas drain so amnesia cannot kill an
+        # undrained delta (the acked-write-survives regime — the
+        # verdict rows must all certify ok on every host count)
+        meta = sp.to_meta()
+        meta["crash"] = [[a + n + 2, b + n + 2, ns]
+                         for a, b, ns in meta["crash"]]
+        meta["loss_until"] += n + 2
+        specs.append(NemesisSpec.from_meta(meta))
+    batch = SC.ScenarioBatch(
+        workload="counter",
+        scenarios=tuple(SC.Scenario(spec=sp) for sp in specs),
+        runner_kw={"mode": "cas", "poll_every": 2},
+        max_recovery_rounds=32)
+    res = SC.run_scenario_batch(batch, mesh=mesh)
+    rows = [{k: row[k] for k in
+             ("scenario", "ok", "converged_round", "msgs_total", "kv")}
+            for row in res["scenarios"]]
+    return {"ok": bool(res["ok"]), "n_scenarios": res["n_scenarios"],
+            "failing": list(res["failing"]), "scenarios": rows}
+
+
+def _task_certify(mesh) -> dict:
+    from ..harness.nemesis import run_broadcast_nemesis
+    from ..tpu_sim.faults import NemesisSpec
+
+    spec = NemesisSpec(n_nodes=16, seed=5, crash=((2, 4, (3, 9)),),
+                       loss_rate=0.15, loss_until=5)
+    res = run_broadcast_nemesis(spec, topology="tree", n_values=16,
+                                structured=True, mesh=mesh)
+    return {"ok": bool(res["ok"]),
+            "converged_round": int(res["converged_round"]),
+            "msgs_total": int(res["msgs_total"])}
+
+
+def _task_takeover(mesh) -> dict:
+    """Host loss: crash EVERY node row owned by one DCN host for a
+    window; the flood must stall on the survivors and re-converge
+    after the host restarts (FaultPlan liveness is per-node, so a
+    host death is just the block of its rows).  The lost block is the
+    SECOND host's rows under the hosts-major (2, ...) layout — a
+    constant, so the 1x8 twin runs the identical spec and the digests
+    stay comparable."""
+    import numpy as np
+
+    from ..tpu_sim.broadcast import BroadcastSim
+    from ..tpu_sim.faults import NemesisSpec
+    from .topology import grid, to_padded_neighbors
+
+    n, nv = 16, 16
+    lost_host = tuple(range(n // 2, n))
+    spec = NemesisSpec(n_nodes=n, seed=3,
+                       crash=((1, 6, lost_host),))
+    sim = BroadcastSim(to_padded_neighbors(grid(n)), n_values=nv,
+                       mesh=mesh, fault_plan=spec.compile())
+    # every value starts on the SURVIVING host (node 0): the dead
+    # host's amnesia wipe must lose nothing, only delay delivery
+    inject = np.zeros((n, 1), np.uint32)
+    inject[0, 0] = np.uint32((1 << nv) - 1)
+    state, rounds = sim.run(inject)
+    reads = sim.read(state)
+    converged = all(r == list(range(nv)) for r in reads)
+    return {"rounds": int(rounds), "msgs": int(state.msgs),
+            "lost_rows": list(lost_host), "converged": converged,
+            "state": state_digest(state)}
+
+
+def _task_roundtime(mesh) -> dict:
+    """Measured per-round wall time of the structured (words-major)
+    tree flood — pure ppermute halo exchanges, ledger off, fixed
+    round count known in closed form.  On a hierarchical mesh every
+    exchange decomposes intra-ICI first with one per-host block move
+    over DCN, so this number IS the recorded cost-model anchor."""
+    import jax
+
+    from ..tpu_sim import structured as S
+    from ..tpu_sim.broadcast import BroadcastSim, make_inject
+    from ..tpu_sim.engine import node_axes, node_shards
+    from ..tpu_sim.timing import discover_rounds
+    from .topology import to_padded_neighbors, tree
+
+    n, nv = 65536, 32
+    sharded = None
+    if mesh is not None:
+        sharded = S.make_sharded_exchange(
+            "tree", n, node_shards(mesh), axis_name=node_axes(mesh))
+    sim = BroadcastSim(to_padded_neighbors(tree(n)), n_values=nv,
+                       sync_every=1 << 20, srv_ledger=False,
+                       mesh=mesh,
+                       exchange=S.make_exchange("tree", n),
+                       sharded_exchange=sharded)
+    rounds = discover_rounds("tree", n, nv)
+    state0, _ = sim.stage(make_inject(n, nv))
+    jax.block_until_ready(state0.received)
+    out = sim.run_staged_fixed(state0, rounds)      # compile + warm
+    jax.block_until_ready(out.received)
+    t0 = time.perf_counter()
+    out = sim.run_staged_fixed(state0, rounds)
+    jax.block_until_ready(out.received)
+    dt = time.perf_counter() - t0
+    return {"n": n, "nv": nv, "rounds": rounds,
+            "us_per_round": round(dt / rounds * 1e6, 1),
+            "state": state_digest(out)}
+
+
+TASKS = {"sims": _task_sims, "batch": _task_batch,
+         "certify": _task_certify, "takeover": _task_takeover,
+         "roundtime": _task_roundtime}
+
+
+def run_tasks(tasks, mesh) -> dict:
+    timed = bool(os.environ.get("GG_DCN_TIME"))
+    out = {}
+    for name in tasks:
+        t0 = time.perf_counter()
+        res = TASKS[name](mesh)
+        if timed:
+            res = dict(res, wall_s=round(time.perf_counter() - t0, 3))
+        out[name] = res
+    return out
+
+
+def spawn_local_cluster(tasks: str, out_dir: str, *, n_procs: int = 2,
+                        local_devices: int = 4, timeout: float = 600.0,
+                        timed: bool = False, attempts: int = 2):
+    """Host-side spawner: run this module as ``n_procs`` real OS
+    processes forming one local gloo cluster and return the parsed
+    per-rank reports (or raise with the tail of every rank log).  A
+    retry with a fresh coordinator port absorbs the rare gloo startup
+    flake.  The parent's ``XLA_FLAGS`` is dropped so each worker's
+    ``GG_LOCAL_DEVICES`` split applies."""
+    import socket
+    import subprocess
+    import tempfile
+
+    last_diag = ""
+    for attempt in range(attempts):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        out = os.path.join(tempfile.mkdtemp(dir=out_dir),
+                           "report.json")
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update(JAX_PLATFORMS="cpu",
+                   GG_COORDINATOR=f"127.0.0.1:{port}",
+                   GG_NUM_PROCS=str(n_procs),
+                   GG_LOCAL_DEVICES=str(local_devices),
+                   GG_DCN_TASKS=tasks, GG_DCN_OUT=out)
+        if timed:
+            env["GG_DCN_TIME"] = "1"
+        else:
+            env.pop("GG_DCN_TIME", None)
+        procs, logs = [], []
+        for rank in range(n_procs):
+            log = open(f"{out}.log.{rank}", "w+")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "gossip_glomers_tpu.parallel.dcn_worker"],
+                env=dict(env, GG_PROC_ID=str(rank)),
+                stdout=log, stderr=subprocess.STDOUT))
+        deadline = time.monotonic() + timeout
+        rcs = []
+        for p in procs:
+            try:
+                rcs.append(p.wait(
+                    timeout=max(1.0, deadline - time.monotonic())))
+            except subprocess.TimeoutExpired:
+                rcs.append(None)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        if all(rc == 0 for rc in rcs):
+            reports = []
+            for rank in range(n_procs):
+                with open(f"{out}.{rank}") as fh:
+                    reports.append(json.load(fh))
+            for log in logs:
+                log.close()
+            return reports
+        diag = []
+        for rank, log in enumerate(logs):
+            log.seek(0)
+            diag.append(f"-- rank {rank} rc={rcs[rank]} --\n"
+                        + log.read()[-3000:])
+            log.close()
+        last_diag = "\n".join(diag)
+    raise RuntimeError(
+        f"dcn cluster failed {attempts}x:\n{last_diag}")
+
+
+def main(argv=None) -> int:
+    # join the cluster BEFORE anything touches the backend — the env
+    # contract is parallel.mesh.DIST_ENV
+    from .mesh import (force_virtual_devices, init_distributed,
+                       pick_mesh_2d)
+
+    if not init_distributed():
+        # single-process run (GG_NUM_PROCS absent or 1): the device
+        # split still applies, so a 1-host twin can match a cluster's
+        # per-host device count exactly
+        raw = os.environ.get("GG_LOCAL_DEVICES")
+        if raw:
+            force_virtual_devices(int(raw))
+    import jax
+
+    from ..utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    tasks = [t for t in os.environ.get("GG_DCN_TASKS",
+                                       "sims").split(",") if t]
+    out_path = os.environ.get("GG_DCN_OUT")
+    mesh = pick_mesh_2d()
+    report = {
+        "process_id": int(jax.process_index()),
+        "n_processes": int(jax.process_count()),
+        "n_devices": int(jax.device_count()),
+        "local_devices": int(jax.local_device_count()),
+        "mesh_shape": (None if mesh is None
+                       else [int(s) for s in mesh.devices.shape]),
+        "tasks": run_tasks(tasks, mesh),
+    }
+    payload = json.dumps(report, indent=1, sort_keys=True) + "\n"
+    if out_path:
+        with open(f"{out_path}.{jax.process_index()}", "w") as fh:
+            fh.write(payload)
+    else:
+        sys.stdout.write(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

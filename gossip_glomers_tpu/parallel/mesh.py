@@ -3,6 +3,14 @@
 The node axis must divide evenly across the mesh, so the benchmarks use
 the largest power-of-two prefix of the visible devices (ICI-contiguous
 on real TPU slices), optionally capped by the simulated node count.
+
+Multi-process (PR 15): :func:`init_distributed` stands up the
+``jax.distributed`` runtime from env vars (multi-host TPU pods and the
+multi-process CPU clusters CI spawns), and :func:`pick_mesh_2d` builds
+the hierarchical ``("hosts", "nodes")`` mesh — DCN axis outermost, one
+row per process, the per-host ICI axis innermost — that
+``engine.collectives`` compiles into two-level exchange circuits.
+:func:`pick_mesh` stays the 1-D degenerate case.
 """
 
 from __future__ import annotations
@@ -11,6 +19,92 @@ import os
 
 import numpy as np
 from jax.sharding import Mesh
+
+#: env vars read by :func:`init_distributed` (the CI spawn contract —
+#: scripts/dcn_smoke.py and tests/test_dcn.py export exactly these):
+#:
+#: - ``GG_COORDINATOR``  host:port of process 0's coordinator service
+#: - ``GG_NUM_PROCS``    total process count (absent/1 -> single-process)
+#: - ``GG_PROC_ID``      this process's rank in [0, GG_NUM_PROCS)
+#: - ``GG_LOCAL_DEVICES``  per-PROCESS virtual CPU device count handed
+#:   to :func:`force_virtual_devices` (the global mesh then has
+#:   ``GG_NUM_PROCS x GG_LOCAL_DEVICES`` devices); ignored on real TPU
+#:   backends, which enumerate their own local chips
+DIST_ENV = ("GG_COORDINATOR", "GG_NUM_PROCS", "GG_PROC_ID",
+            "GG_LOCAL_DEVICES")
+
+
+def _backend_initialized() -> bool:
+    """Whether this process's JAX backend already spun up (device query
+    ran) — past that point the virtual-device flags are dead letters."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:                                # pragma: no cover
+        return False
+
+
+def init_distributed(*, coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None,
+                     local_devices: int | None = None) -> bool:
+    """Idempotent ``jax.distributed.initialize`` wrapper, env-driven
+    (``DIST_ENV``) with keyword overrides.  Returns True when the
+    distributed runtime was (newly) initialized, False for the
+    single-process no-op paths — callers can branch on it but never
+    need to.
+
+    On CPU the gloo collectives backend is selected and
+    ``local_devices`` (or ``GG_LOCAL_DEVICES``) routes through
+    :func:`force_virtual_devices`, which MUST precede backend init —
+    if the backend already spun up this raises instead of silently
+    handing every process the same un-split device, which would
+    deadlock the coordinator barrier three stack frames later.  On TPU
+    pods the runtime reads its own cluster env and ``local_devices``
+    is ignored.
+    """
+    import jax
+
+    if num_processes is None:
+        num_processes = int(os.environ.get("GG_NUM_PROCS", "1") or 1)
+    if num_processes <= 1:
+        return False
+    state = getattr(jax.distributed, "global_state", None)
+    if state is not None and getattr(state, "client", None) is not None:
+        return False                                 # already up
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("GG_COORDINATOR")
+    if process_id is None:
+        process_id = int(os.environ.get("GG_PROC_ID", "0") or 0)
+    if local_devices is None:
+        raw = os.environ.get("GG_LOCAL_DEVICES")
+        local_devices = int(raw) if raw else None
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    if local_devices is not None and "tpu" not in platform:
+        if _backend_initialized():
+            raise RuntimeError(
+                "init_distributed(local_devices=...) must run before "
+                "the JAX backend initializes (a device query already "
+                "ran); the virtual-device split cannot be applied now "
+                "— move init_distributed to process start, before any "
+                "jax.devices()/jit call")
+        force_virtual_devices(local_devices)
+    if "tpu" not in platform:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    if coordinator_address is None:
+        raise ValueError(
+            "init_distributed: GG_NUM_PROCS > 1 but no coordinator "
+            "address (set GG_COORDINATOR=host:port or pass "
+            "coordinator_address=)")
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
 
 
 def force_virtual_devices(n: int = 8) -> None:
@@ -54,3 +148,49 @@ def pick_mesh(max_axis: int | None = None,
     if n_dev <= 1:
         return None
     return Mesh(np.array(devices[:n_dev]), (axis_name,))
+
+
+def pick_mesh_2d(hosts: int | None = None, max_axis: int | None = None,
+                 axis_names: tuple = ("hosts", "nodes")) -> Mesh | None:
+    """Hierarchical 2-D mesh: the DCN axis (``hosts``, one row per
+    process) OUTERMOST, the per-host ICI axis innermost — the layout
+    ``engine.collectives`` reads to run its ppermute circuits intra-ICI
+    first and exchange one per-host partial over DCN (O(log hosts)
+    block moves).
+
+    ``hosts`` defaults to ``jax.process_count()``; pass it explicitly
+    to fold a single process's virtual devices into a simulated
+    hierarchy (the single-process twin the parity tests pin against
+    the real multi-process run).  Rows follow process ownership when
+    ``hosts`` matches the process count, so the inner axis is always
+    process-local (ICI-contiguous on real slices).  ``max_axis`` caps
+    the TOTAL node-shard count (hosts x per-host), shrinking the inner
+    axis first.  None on a single device, uneven host split, or a cap
+    below the host count — same contract as :func:`pick_mesh`.
+    """
+    import jax
+
+    devices = jax.devices()
+    if hosts is None:
+        hosts = max(int(jax.process_count()), 1)
+    if hosts < 1 or len(devices) % hosts != 0:
+        return None
+    if hosts > 1 and int(jax.process_count()) == hosts:
+        rows = [[d for d in devices if d.process_index == p]
+                for p in range(hosts)]
+        per = min(len(r) for r in rows)
+        if per == 0:
+            return None
+    else:
+        per = len(devices) // hosts
+        rows = [list(devices[h * per:(h + 1) * per])
+                for h in range(hosts)]
+    per = 1 << (per.bit_length() - 1)
+    if max_axis is not None:
+        while hosts * per > max_axis and per > 1:
+            per >>= 1
+        if hosts * per > max_axis:
+            return None
+    if hosts * per <= 1:
+        return None
+    return Mesh(np.array([r[:per] for r in rows]), axis_names)

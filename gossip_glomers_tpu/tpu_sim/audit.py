@@ -59,6 +59,8 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Mapping, NamedTuple
 
+import numpy as np
+
 from . import engine
 
 # -- HLO text analysis ---------------------------------------------------
@@ -241,6 +243,62 @@ def host_boundary_violations(hlo: str) -> list[str]:
     return out
 
 
+# XLA prints replica groups in two encodings: the explicit brace form
+# ``{{0,1,2,3},{4,5,6,7}}`` and (when the grouping is a reshape of an
+# iota) the compact ``[G,S]<=[dims]`` form, optionally with a
+# ``T(perm)`` transpose of the iota before the reshape
+_IOTA_GROUPS = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_ALL_GATHER = re.compile(r"(?<![\w-])all-gather(?:-start)?\(")
+
+
+def _parse_replica_groups(line: str) -> "list[list[int]] | None":
+    """One instruction line's replica groups as device-id lists, in
+    either encoding.  ``None`` when the line declares no groups and
+    ``[]`` for ``replica_groups={}`` — both mean ONE flattened world
+    group."""
+    m = _IOTA_GROUPS.search(line)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            ids = ids.transpose(
+                [int(x) for x in m.group(4).split(",")])
+        return [[int(d) for d in row] for row in ids.reshape(g, s)]
+    pos = line.find("replica_groups={")
+    if pos < 0:
+        return None
+    body = _brace_span(line, pos + len("replica_groups="))
+    return [[int(x) for x in grp.split(",") if x.strip()]
+            for grp in re.findall(r"\{([\d,\s]*)\}", body)]
+
+
+def dcn_gather_violations(hlo: str, per_host: int) -> list[str]:
+    """Every ``all-gather`` whose replica groups cross a host boundary
+    (host = device id // ``per_host`` under the hosts-major device
+    order of ``pick_mesh_2d``) — the DCN scale-out gate: a structured
+    exchange may widen INSIDE a host's ICI block, but an operand
+    all-gather over the DCN axis turns the slow links into the
+    bottleneck and is forbidden (gather-path widens are exempt by
+    simply not declaring ``dcn_per_host`` on those contracts)."""
+    out = []
+    for line in _strip_metadata(hlo).splitlines():
+        if not _ALL_GATHER.search(line):
+            continue
+        groups = _parse_replica_groups(line)
+        if not groups:
+            out.append("all-gather over the flattened world group "
+                       "(crosses every host)")
+            continue
+        for grp in groups:
+            hosts = sorted({d // per_host for d in grp})
+            if len(hosts) > 1:
+                out.append(
+                    f"all-gather group {grp} spans hosts {hosts}")
+    return out
+
+
 # -- program contracts ---------------------------------------------------
 
 
@@ -281,6 +339,9 @@ class ProgramContract:
     mem_lo: float = 0.0
     mem_hi: float | None = None
     needs_mesh: bool = True
+    # DCN gate (PR 15): devices per host block; when set, no all-gather
+    # replica group in the compiled HLO may cross a host boundary
+    dcn_per_host: int | None = None
     notes: str = ""
 
 
@@ -326,6 +387,15 @@ def _check_host(contract: ProgramContract, hlo: str) -> dict:
     return {"ok": ok, "violations": violations}
 
 
+def _check_dcn(contract: ProgramContract, hlo: str) -> dict:
+    if contract.dcn_per_host is None:
+        return {"ok": True, "checked": False}
+    violations = dcn_gather_violations(hlo, contract.dcn_per_host)
+    return {"ok": not violations, "checked": True,
+            "per_host": contract.dcn_per_host,
+            "violations": violations}
+
+
 def _check_memory(contract: ProgramContract, built: AuditProgram,
                   footprint) -> dict:
     if contract.mem_hi is None or built.analytic_peak_bytes is None:
@@ -366,6 +436,7 @@ def audit_contract(contract: ProgramContract, mesh=None) -> dict:
         "collectives": _check_census(contract, hlo),
         "donation": _check_donation(contract, hlo, built),
         "host_boundary": _check_host(contract, hlo),
+        "dcn": _check_dcn(contract, hlo),
         "memory": _check_memory(contract, built, footprint),
     }
     return {"name": contract.name, "notes": contract.notes,
@@ -378,12 +449,14 @@ def default_registry() -> list[ProgramContract]:
     stateful sim module owns its own ``audit_contracts()``; telemetry
     registers the observed-driver rows, PR 8; provenance the
     stamp-carrying rows, PR 9; kvstore the sharded-rows CAS drivers
-    and txn the wound-or-die transaction rounds, PR 14)."""
-    from . import (broadcast, counter, kafka, kvstore, provenance,
-                   scenario, telemetry, txn)
+    and txn the wound-or-die transaction rounds, PR 14; dcn the
+    hierarchical ICI x DCN re-audits with the host-crossing gather
+    gate, PR 15)."""
+    from . import (broadcast, counter, dcn, kafka, kvstore,
+                   provenance, scenario, telemetry, txn)
     out: list[ProgramContract] = []
     for mod in (broadcast, counter, kafka, telemetry, provenance,
-                scenario, kvstore, txn):
+                scenario, kvstore, txn, dcn):
         out.extend(mod.audit_contracts())
     names = [c.name for c in out]
     if len(set(names)) != len(names):

@@ -45,9 +45,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import faults, provenance, telemetry, traffic
 from .engine import (collectives, donate_argnums_for, fori_rounds,
-                     jit_program, resolve_block, scan_blocks,
-                     shard_map, stepwise_converge, unpack_bits,
-                     while_converge, windows_fold)
+                     host_view, jit_program, node_axes, node_shards,
+                     resolve_block, scan_blocks, shard_map,
+                     stepwise_converge, unpack_bits, while_converge,
+                     windows_fold)
 from .structured import _take_delayed
 
 WORD = 32
@@ -505,17 +506,26 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
             # single live edges
             ack_edges = live_now
             diff_edges = live_now
+            req_deg = deg_topo
         else:
-            # LOSS-ONLY plan (crash/dup force the ledger off at
-            # construction): requests are charged at send time like
-            # every message (live_now), but replies exist only when
-            # the triggering request DELIVERED — the outgoing
-            # (row -> neighbor) coin at this round — and a sync pair
+            # LOSS/CRASH plan (dup rejects at construction): requests
+            # are charged at send time like every message, but replies
+            # exist only when the triggering request DELIVERED — the
+            # outgoing (row -> neighbor) coin at this round over a
+            # live edge (both endpoints up) — and a sync pair
             # exchanges its diff only when BOTH direction coins
             # survive (read delivered AND read_ok delivered; the diff
-            # pushes then ride the already-delivered direction).  The
-            # flood ack term assumes the sender-edge coin delivered
-            # (the sim does not track per-value senders); windows of
+            # pushes then ride the already-delivered direction).
+            # Crash charge-at-send: a DOWN row sends nothing (req_deg
+            # zeroed — its reads don't fire — and its frontier is
+            # empty from the amnesia wipe), while requests TO a down
+            # neighbor stay charged at full topology degree and die
+            # with the process (live_now excludes the edge, so no
+            # ack); the post-recovery anti-entropy wave re-pushes and
+            # RE-CHARGES the repair (calibrated against the virtual
+            # harness with its down_fn process model).  The flood ack
+            # term assumes the sender-edge coin delivered (the sim
+            # does not track per-value senders); windows of
             # disagreement are one ack per (value, node) whose
             # sender-edge coin drops during its flood round — exact
             # otherwise, pinned in test_ledger_calibration.py.
@@ -524,13 +534,15 @@ def _round(state: BroadcastState, *, row_ids: jnp.ndarray,
                                        row_ids[:, None], src_c)
             ack_edges = live_now & out_ok
             diff_edges = live_del & out_ok
+            req_deg = jnp.where(
+                faults.node_up(plan, state.t, row_ids), deg_topo, 0)
         ack_deg = ack_edges.sum(axis=1).astype(jnp.int32)
         pcf = _popcount(fr0).sum(axis=1).astype(jnp.uint32)
-        coef = jnp.where(state.t == 0, deg_topo + ack_deg,
-                         jnp.maximum(deg_topo + ack_deg - 2, 0))
+        coef = jnp.where(state.t == 0, req_deg + ack_deg,
+                         jnp.maximum(req_deg + ack_deg - 2, 0))
         flood = jnp.sum(pcf * coef.astype(jnp.uint32), dtype=jnp.uint32)
         base = sync_base_once(
-            jnp.sum(deg_topo + ack_deg, dtype=jnp.int32).astype(
+            jnp.sum(req_deg + ack_deg, dtype=jnp.int32).astype(
                 jnp.uint32))
         # computed every round and masked (a lax.cond would need equal
         # sharding types across branches under shard_map); on sync
@@ -972,16 +984,21 @@ class BroadcastSim:
         block, absorbed by dedup, charged to the msgs ledger at send
         time) rather than the source's full received set.  On the
         words-major structured path a plan needs the mask bundle:
-        pass ``nemesis=`` (below).  The server ledger: LOSS-ONLY
-        plans (no crash windows, no dup) keep it on the gather path —
-        requests charged at send time, replies only when the
-        triggering request's per-round edge coin delivered, sync
-        diffs over both-coin pairs (calibrated against the virtual
-        harness in test_ledger_calibration.py) — while crash or dup
-        (no defined accounting for a process dying mid-round or for
-        re-delivered sets) and every delays/words-major composition
-        force ``srv_ledger`` off; the ``msgs`` ledger counts loss at
-        send time and dup re-deliveries as real traffic either way.
+        pass ``nemesis=`` (below).  The server ledger: LOSS and
+        CRASH plans keep it on the gather path — requests charged at
+        send time, replies only when the triggering request's
+        per-round edge coin delivered over a live edge, sync diffs
+        over both-coin pairs; crash cells charge-at-send (a request
+        to a down node is charged and dies with the process, a down
+        row sends nothing, the post-recovery retry re-charges — the
+        PR-14 KV decision, calibrated against the virtual harness in
+        test_ledger_calibration.py).  A dup stream REJECTS loudly at
+        construction when the ledger is requested (re-delivered sets
+        vs reference msg-id dedup cannot be calibrated — the
+        kvstore.reject_dup_stream stance); every delays composition
+        and words-major crash still force ``srv_ledger`` off; the
+        ``msgs`` ledger counts loss at send time and dup
+        re-deliveries as real traffic either way.
 
         ``nemesis`` (structured.StructuredNemesis, make_nemesis): the
         words-major decomposition of the SAME plan — host-precomputed
@@ -1212,29 +1229,45 @@ class BroadcastSim:
                 raise ValueError(
                     f"FaultPlan is for {fault_plan.down.shape[1]} "
                     f"nodes, sim has {n}")
-            # LOSS-ONLY plans (no crash windows, no dup stream) keep a
-            # DEFINED reference accounting: the per-(t, src, dst) coin
-            # makes a round's directed edge all-or-nothing, so
-            # requests are charged at send time (loss-invisible, like
-            # the harness ledger), replies only when the triggering
-            # request's edge-coin delivered, and sync diffs only where
-            # BOTH direction coins survive (the read AND its read_ok).
-            # Gather path: the srv block in _round; words-major
-            # nemesis runs (PR 5): the same formulas over the bundle's
-            # deg-contract coin rows and masked diff closures
-            # (_round_wm_nem) — both calibrated in
-            # test_ledger_calibration.py.  Crash brings amnesia rows
-            # (acks from a process that died mid-round have no
-            # reference semantics) and dup re-delivers whole received
-            # sets — both stay OFF; the value-message ledger (`msgs`)
-            # is the throughput signal there.  Same for every delays
+            # LOSS and CRASH plans keep a DEFINED reference
+            # accounting: the per-(t, src, dst) coin makes a round's
+            # directed edge all-or-nothing, so requests are charged at
+            # send time (loss-invisible, like the harness ledger),
+            # replies only when the triggering request's edge-coin
+            # delivered, and sync diffs only where BOTH direction
+            # coins survive (the read AND its read_ok).  Crash windows
+            # extend the same stance charge-at-send (the PR-14 KV
+            # decision, ROADMAP item 6): a request to a down node is
+            # charged when sent and dies with the process (no reply —
+            # live edges require both endpoints up), a down row sends
+            # nothing (its reads don't fire, its frontier was wiped at
+            # the amnesia entry), and the post-recovery anti-entropy
+            # retry re-charges.  Gather path: the srv block in _round;
+            # words-major nemesis runs (PR 5) keep the loss-only
+            # subset (the bundle's deg-contract coin rows have no
+            # crash liveness decomposition) — both calibrated in
+            # test_ledger_calibration.py.  A dup stream re-delivers
+            # whole received sets while the reference dedups by
+            # message id, so the ledgers CANNOT be calibrated — same
+            # stance as kvstore.reject_dup_stream: rejected loudly
+            # below when the ledger was requested.  Every delays
             # composition (gather `delays` and the bundle's
-            # dir_delays).
-            loss_only = (int(fault_plan.starts.shape[0]) == 0
-                         and int(fault_plan.dup_num) == 0)
+            # dir_delays) still forces the ledger off (documented
+            # current-state approximation only holds per wave).
+            if int(fault_plan.dup_num) > 0 and self._srv_on:
+                raise ValueError(
+                    "srv ledger under a dup stream: a dup edge "
+                    "re-delivers its source's whole received set "
+                    "while the reference dedups by message id, so "
+                    "the server ledgers cannot be calibrated (the "
+                    "kvstore backend's reject_dup_stream stance) — "
+                    "pass srv_ledger=False and read the `msgs` value "
+                    "ledger instead")
+            has_crash = int(fault_plan.starts.shape[0]) > 0
             if self.words_major:
                 wm_srv_ok = (
-                    nemesis is not None
+                    not has_crash
+                    and nemesis is not None
                     and nemesis.dir_delays is None
                     and (nemesis.sync_diff is not None if mesh is None
                          else (nemesis.sharded_exchange is not None
@@ -1242,7 +1275,7 @@ class BroadcastSim:
                                is not None)))
             else:
                 wm_srv_ok = delays is None
-            if not (loss_only and wm_srv_ok):
+            if not wm_srv_ok:
                 self._srv_on = False
         if delays is not None:
             if exchange is not None:
@@ -1260,11 +1293,11 @@ class BroadcastSim:
                 "union_block streams the GATHER path's 1-hop faulted "
                 "rounds; the words-major path is already gather-free "
                 "and the delays ring keeps the materialized shape")
+        na = self._na = node_axes(mesh)
         if self.words_major or delays is not None or fault_plan is None:
             self._ub = None
         else:
-            n_sh_nodes = (int(mesh.shape["nodes"])
-                          if mesh is not None else 1)
+            n_sh_nodes = node_shards(mesh)
             # per destination row: D edges x (liveness + loss/dup
             # coins + gather temps) ~ 16 bytes per edge slot
             self._ub = resolve_block(n // n_sh_nodes, union_block,
@@ -1316,12 +1349,12 @@ class BroadcastSim:
         self._host_deg = deg
         has_words = mesh is not None and "words" in mesh.axis_names
         if self.words_major:
-            self._state_spec = (P("words", "nodes") if has_words
-                                else P(None, "nodes")) \
+            self._state_spec = (P("words", na) if has_words
+                                else P(None, na)) \
                 if mesh is not None else None
         else:
-            self._state_spec = (P("nodes", "words") if has_words
-                                else P("nodes", None)) \
+            self._state_spec = (P(na, "words") if has_words
+                                else P(na, None)) \
                 if mesh is not None else None
         if self.words_major:
             # the structured path never reads the adjacency on device —
@@ -1329,7 +1362,7 @@ class BroadcastSim:
             self.nbrs = None
             self.nbr_mask = None
             self.deg = (jax.device_put(jnp.asarray(deg),
-                                       NamedSharding(mesh, P("nodes")))
+                                       NamedSharding(mesh, P(na)))
                         if mesh is not None else jnp.asarray(deg))
             if self._edge is not None:
                 # delay rows ride as one traced (D, N) array, sharded
@@ -1337,7 +1370,7 @@ class BroadcastSim:
                 # rows, local masking, zero extra ICI)
                 rows = jnp.asarray(self._edge.delay_rows, jnp.int32)
                 if mesh is not None:
-                    self._ed_spec = P(None, "nodes")
+                    self._ed_spec = P(None, na)
                     rows = jax.device_put(
                         rows, NamedSharding(mesh, self._ed_spec))
                 self._ed_rows = rows
@@ -1349,8 +1382,8 @@ class BroadcastSim:
                     s2 = jnp.asarray(self._edge.same)
                     d2 = jnp.asarray(self._edge.del_same)
                     if mesh is not None:
-                        e_spec = P(None, "nodes")
-                        s_spec = P(None, None, "nodes")
+                        e_spec = P(None, na)
+                        s_spec = P(None, None, na)
                         e2 = jax.device_put(
                             e2, NamedSharding(mesh, e_spec))
                         s2 = jax.device_put(
@@ -1366,7 +1399,7 @@ class BroadcastSim:
                     # halo: positionally sharded with the node axis;
                     # all_gather fallback: replicated full-axis masks
                     self._nem_specs = faults.wm_specs(
-                        self._nem.sharded_exchange is not None)
+                        self._nem.sharded_exchange is not None, na)
                     arrs = faults.WMNemesisArrays(
                         *(jax.device_put(a, NamedSharding(mesh, s))
                           for a, s in zip(arrs, self._nem_specs)))
@@ -1381,8 +1414,8 @@ class BroadcastSim:
                     # axis; all_gather fallback: replicated (the full-
                     # axis masked exchange needs full-axis masks)
                     if masked_src.sharded_exchange is not None:
-                        e_spec = P(None, "nodes")
-                        s_spec = P(None, None, "nodes")
+                        e_spec = P(None, na)
+                        s_spec = P(None, None, na)
                     else:
                         e_spec = P(None, None)
                         s_spec = P(None, None, None)
@@ -1391,11 +1424,11 @@ class BroadcastSim:
                     self._f_specs = (e_spec, s_spec)
                 self._f_exists, self._f_same = ex, sm
         elif mesh is not None:
-            node_sh = NamedSharding(mesh, P("nodes", None))
+            node_sh = NamedSharding(mesh, P(na, None))
             self.nbrs = jax.device_put(jnp.asarray(nbrs, jnp.int32), node_sh)
             self.nbr_mask = jax.device_put(jnp.asarray(nbr_mask), node_sh)
             self.deg = jax.device_put(jnp.asarray(deg),
-                                      NamedSharding(mesh, P("nodes")))
+                                      NamedSharding(mesh, P(na)))
             if self.delays is not None:
                 self.delays = jax.device_put(self.delays, node_sh)
         else:
@@ -1471,7 +1504,7 @@ class BroadcastSim:
         stamps shard with the node axis, the attribution is local."""
         mesh_axes = tuple(self.mesh.axis_names)
         block = nbrs.shape[0]
-        start = lax.axis_index("nodes") * block
+        start = lax.axis_index(self._na) * block
         row_ids = start + jnp.arange(block, dtype=jnp.int32)
         if "words" in mesh_axes:
             # per-word-shard quantities (popcounts) psum linearly; the
@@ -1483,7 +1516,7 @@ class BroadcastSim:
         return _round(
             state, row_ids=row_ids, nbrs=nbrs, nbr_mask=nbr_mask,
             parts=parts, sync_every=self.sync_every,
-            widen=lambda p: lax.all_gather(p, "nodes", axis=0, tiled=True),
+            widen=lambda p: lax.all_gather(p, self._na, axis=0, tiled=True),
             reduce_sum=lambda s: lax.psum(s, mesh_axes),
             delays=delays, delay_set=self._delay_set,
             sync_base_once=sync_base_once, plan=plan,
@@ -1549,13 +1582,13 @@ class BroadcastSim:
             # all_gather fallback: replicated full-axis masks, full-
             # axis delivery per shard, local block sliced back out
             block = state.received.shape[1]
-            start = lax.axis_index("nodes") * block
+            start = lax.axis_index(self._na) * block
             return _round_wm_nem(
                 state, arrs, plan, pstarts, pends, nem=self._nem,
                 sync_every=self.sync_every, dup_on=self._fp_dup,
                 exchange=self._nem.exchange, src_pc=self._nem.src_pc,
                 reduce_sum=psum,
-                widen=lambda p: lax.all_gather(p, "nodes", axis=1,
+                widen=lambda p: lax.all_gather(p, self._na, axis=1,
                                                tiled=True),
                 local_slice=lambda x: lax.dynamic_slice_in_dim(
                     x, start, block, axis=1),
@@ -1625,12 +1658,12 @@ class BroadcastSim:
                            else self.sharded_sync_diff),
                 sync_base_once=sync_base_once, live_rows=live_rows)
         block = state.received.shape[1]
-        start = lax.axis_index("nodes") * block
+        start = lax.axis_index(self._na) * block
         return _round_wm(
             state, deg=deg, sync_every=self.sync_every,
             exchange=(f.exchange if masks is not None
                       else self.exchange),
-            widen=lambda p: lax.all_gather(p, "nodes", axis=1, tiled=True),
+            widen=lambda p: lax.all_gather(p, self._na, axis=1, tiled=True),
             reduce_sum=lambda s: lax.psum(s, mesh_axes),
             local_slice=lambda x: lax.dynamic_slice_in_dim(
                 x, start, block, axis=1),
@@ -1648,7 +1681,8 @@ class BroadcastSim:
         srv_spec = P() if self._srv_on else None
         return (BroadcastState(state_spec, state_spec, P(), P(),
                                hist_spec, srv_spec),
-                P("nodes", None), Partitions(P(), P(), P(None, None)))
+                P(self._na, None),
+                Partitions(P(), P(), P(None, None)))
 
     def _wm_round_single(self, state: BroadcastState, deg,
                          masks=None) -> BroadcastState:
@@ -1801,7 +1835,7 @@ class BroadcastSim:
             @jax.jit
             @functools.partial(
                 shard_map, mesh=self.mesh,
-                in_specs=(state_spec, P("nodes")) + extra_specs,
+                in_specs=(state_spec, P(self._na)) + extra_specs,
                 out_specs=state_spec,
                 check_vma=False,
             )
@@ -1937,7 +1971,7 @@ class BroadcastSim:
             @functools.partial(jax.jit, donate_argnums=dn)
             @functools.partial(
                 shard_map, mesh=mesh,
-                in_specs=(state_spec, P("nodes"), target_spec)
+                in_specs=(state_spec, P(self._na), target_spec)
                 + extra_specs,
                 out_specs=state_spec, check_vma=False,
             )
@@ -2081,7 +2115,7 @@ class BroadcastSim:
             st_spec = self._state_spec
             axes = tuple(mesh.axis_names)
             degs, mask_arrays = _degree_masks(self._host_deg)
-            mask_spec = P(None, "nodes")
+            mask_spec = P(None, self._na)
             masks = [jax.device_put(m, NamedSharding(mesh, mask_spec))
                      for m in mask_arrays]
 
@@ -2111,7 +2145,7 @@ class BroadcastSim:
             @functools.partial(jax.jit, donate_argnums=dn)
             @functools.partial(
                 shard_map, mesh=mesh,
-                in_specs=(state_spec, P("nodes")) + extra_specs,
+                in_specs=(state_spec, P(self._na)) + extra_specs,
                 out_specs=state_spec, check_vma=False,
             )
             def run_wm(state: BroadcastState, deg,
@@ -2298,7 +2332,8 @@ class BroadcastSim:
 
         state_spec, node_spec, part_spec = self._specs()
         tel_in = (telemetry.state_specs(),) if tl else ()
-        prov_in = (provenance.broadcast_specs(),) if pv else ()
+        prov_in = ((provenance.broadcast_specs(self._na),)
+                   if pv else ())
         axes = tuple(mesh.axis_names)
 
         if wm:
@@ -2308,7 +2343,7 @@ class BroadcastSim:
             @functools.partial(
                 shard_map, mesh=mesh,
                 in_specs=(state_spec,) + tel_in
-                + (P(), P("nodes")) + extra_specs,
+                + (P(), P(self._na)) + extra_specs,
                 out_specs=(state_spec,) + tel_in, check_vma=False,
             )
             def run_wm(state: BroadcastState, tel, n, deg, *masks):
@@ -2376,7 +2411,7 @@ class BroadcastSim:
         prov = provenance.init_broadcast(
             self.n_nodes, self.n_values, np.asarray(inject, np.uint32))
         if self.mesh is not None:
-            sh = NamedSharding(self.mesh, P("nodes", None))
+            sh = NamedSharding(self.mesh, P(self._na, None))
             prov = provenance.BroadcastProv(
                 *(jax.device_put(a, sh) for a in prov))
         return prov
@@ -2450,7 +2485,7 @@ class BroadcastSim:
             if "words" in self.mesh.axis_names:
                 raise ValueError(
                     "traffic drivers run on 1-D node meshes")
-            if tspec.n_clients % int(self.mesh.shape["nodes"]) != 0:
+            if tspec.n_clients % node_shards(self.mesh) != 0:
                 raise ValueError(
                     f"n_clients={tspec.n_clients} must shard evenly "
                     "over the node axis")
@@ -2535,7 +2570,7 @@ class BroadcastSim:
     def _build_traffic(self, tspec, donate: bool, tel_spec=None):
         self._traffic_validate(tspec)
         mesh = self.mesh
-        n_sh = 1 if mesh is None else int(mesh.shape["nodes"])
+        n_sh = node_shards(mesh)
         ub = traffic.traffic_block(tspec.n_clients // n_sh)
         tl = tel_spec is not None
         mask = tel_spec.static_mask if tl else None
@@ -2619,7 +2654,7 @@ class BroadcastSim:
             return prog, args_fn, runner
 
         state_spec, node_spec, part_spec = self._specs()
-        t_specs = traffic.state_specs(True)
+        t_specs = traffic.state_specs(True, self._na)
         tel_in = (telemetry.state_specs(),) if tl else ()
 
         if wm:
@@ -2642,7 +2677,7 @@ class BroadcastSim:
             prog = jit_program(
                 run_wm, mesh=mesh,
                 in_specs=(state_spec,) + tel_in
-                + (t_specs, P(), traffic.plan_specs(), P("nodes"))
+                + (t_specs, P(), traffic.plan_specs(), P(self._na))
                 + extra_specs,
                 out_specs=(state_spec, t_specs) + tel_in,
                 check_vma=False, donate_argnums=dn)
@@ -2842,8 +2877,9 @@ class BroadcastSim:
                                                 self.nbr_mask)
 
     def received_node_major(self, state: BroadcastState) -> np.ndarray:
-        """(N, W) received bitset regardless of the internal layout."""
-        rec = np.asarray(state.received)
+        """(N, W) received bitset regardless of the internal layout
+        (cross-process shards are replicated first — engine.host_view)."""
+        rec = host_view(state.received)
         return rec.T if self.words_major else rec
 
     def server_msgs(self, state: BroadcastState) -> int:
@@ -2857,11 +2893,14 @@ class BroadcastSim:
                 "server-message ledger is off: srv_ledger=False, a "
                 "words-major run without its sync_diff closure "
                 "(structured.make_sync_diff / make_sharded_sync_diff), "
-                "or a FaultPlan beyond the loss-only regime (crash/dup "
-                "have no defined reference accounting; loss-only plans "
-                "keep the ledger on the gather path AND on words-major "
-                "nemesis runs whose bundle carries the masked diff "
-                "closures — see __init__)")
+                "a delays composition, or a words-major FaultPlan "
+                "beyond the loss-only regime (the bundle's coin rows "
+                "have no crash liveness decomposition; loss AND crash "
+                "plans keep the ledger on the gather path — crash "
+                "cells charge-at-send — while loss-only plans keep it "
+                "on words-major nemesis runs whose bundle carries the "
+                "masked diff closures; dup streams reject at "
+                "construction — see __init__)")
         return int(state.srv_msgs)
 
     def inject_mid(self, state: BroadcastState, node: int,

@@ -44,8 +44,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import faults, kvstore, provenance, telemetry, traffic
 from .engine import (Collectives, collectives, donate_argnums_for,
-                     fori_rounds, jit_program, resolve_block,
-                     scan_blocks)
+                     fori_rounds, jit_program, node_axes, node_shards,
+                     resolve_block, scan_blocks)
 
 
 class KVReach(NamedTuple):
@@ -222,11 +222,12 @@ class CounterSim:
             raise ValueError(
                 f"FaultPlan is for {fault_plan.down.shape[1]} nodes, "
                 f"sim has {n_nodes}")
-        n_sh = 1 if mesh is None else int(mesh.shape["nodes"])
+        n_sh = node_shards(mesh)
         # two uint32 coin/mask evaluations per node row
         self._ub = resolve_block(max(1, n_nodes // n_sh), union_block,
                                  per_row_bytes=8)
-        self._node_spec = P("nodes") if mesh is not None else None
+        self._node_spec = (P(node_axes(mesh)) if mesh is not None
+                           else None)
         # raw jitted run-program handles by donate flag — the contract
         # auditor (tpu_sim/audit.py) lowers these directly
         self._run_progs: dict = {}
@@ -683,7 +684,7 @@ class CounterSim:
         else:
             sched_spec = KVReach(P(), P(), P(None, None))
             tel_in = ((telemetry.state_specs(),) if tl else ())
-            prov_in = ((provenance.counter_specs(),) if pv else ())
+            prov_in = ((provenance.counter_specs(node_axes(mesh)),) if pv else ())
 
             def run_n(*a):
                 a = list(a)
@@ -853,7 +854,7 @@ class CounterSim:
                 f"TrafficSpec is for {tspec.n_nodes} nodes, sim has "
                 f"{self.n_nodes}")
         mesh = self.mesh
-        n_sh = 1 if mesh is None else int(mesh.shape["nodes"])
+        n_sh = node_shards(mesh)
         if tspec.n_clients % n_sh != 0:
             raise ValueError(
                 f"n_clients={tspec.n_clients} must shard evenly over "
@@ -888,7 +889,7 @@ class CounterSim:
             prog = jit_program(run, donate_argnums=dn)
         else:
             sched_spec = KVReach(P(), P(), P(None, None))
-            t_specs = traffic.state_specs(True)
+            t_specs = traffic.state_specs(True, node_axes(mesh))
 
             def run(state, *rest):
                 rest = list(rest)

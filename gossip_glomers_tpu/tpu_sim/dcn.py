@@ -1,0 +1,134 @@
+"""DCN scale-out contracts (PR 15): the hierarchical ICI x DCN
+re-audits.
+
+Every row here compiles an EXISTING driver on the hierarchical
+``("hosts", "nodes")`` mesh of :func:`~..parallel.mesh.pick_mesh_2d`
+(2 hosts x 4 devices on the CPU 8-way virtual backend — the same
+global shape the multi-process parity suite pins bit-exact against a
+real 2-process gloo cluster) and adds the one check the 1-D registry
+cannot state: ``dcn_per_host`` — **no all-gather replica group may
+cross a host boundary**.  Structured exchanges, the counter wide
+round, and the kafka union round move operands with ppermute circuits
+and psums that decompose per axis, so intra-host ICI widens are the
+only gathers allowed; an operand gather over the slow DCN links is
+exactly the scaling failure the hierarchy exists to avoid.  The
+gather-path broadcast widen legitimately spans the composed axis —
+those contracts simply stay in their own modules without the gate.
+
+Most rows REBIND a sibling module's registered contract onto the 2-D
+mesh (same build closure, mesh pinned): if a future change makes a
+round program hierarchy-unaware, the rebound row fails before any
+multi-host run does.  The broadcast structured row is built here
+because the sibling's build hardcodes a 1-D shard layout; this one
+threads ``node_shards``/``node_axes`` like the harness does.
+"""
+
+from __future__ import annotations
+
+from . import faults
+
+HOSTS = 2          # CI hierarchy: 2 "hosts" x 4 devices
+PER_HOST = 4
+
+
+def _mesh2d():
+    from ..parallel.mesh import pick_mesh_2d
+
+    mesh = pick_mesh_2d(hosts=HOSTS)
+    if mesh is None:
+        raise RuntimeError(
+            f"dcn contracts need a {HOSTS}-host hierarchy "
+            f"({HOSTS * PER_HOST} devices; force_virtual_devices)")
+    return mesh
+
+
+def _rebind(rows, name, dcn_name, notes):
+    """A sibling module's registered contract, re-issued on the 2-D
+    mesh with the host-crossing gather gate added.  Caps, donation,
+    and the memory band carry over unchanged — node rows shard over
+    the COMPOSED hosts x nodes axes at the same global shard count, so
+    the per-shard byte claims still price the compiled header."""
+    from .audit import ProgramContract
+
+    row = next(r for r in rows if r.name == name)
+
+    def build(mesh, _build=row.build):
+        del mesh
+        return _build(_mesh2d())
+
+    return ProgramContract(
+        name=dcn_name, build=build, collectives=row.collectives,
+        donation=row.donation, mem_lo=row.mem_lo, mem_hi=row.mem_hi,
+        needs_mesh=False, dcn_per_host=PER_HOST, notes=notes)
+
+
+def audit_contracts():
+    """The ``*/dcn-*`` rows: structured broadcast nemesis round,
+    counter wide round + donated traffic driver, kafka union round,
+    and the host-sharded counter scenario batch — all on the
+    hierarchical mesh, all under the DCN gather gate."""
+    from . import broadcast, counter, kafka, scenario, structured
+    from .audit import AuditProgram, ProgramContract
+    from .broadcast import BroadcastSim, make_inject
+    from .engine import node_axes, node_shards
+    from ..parallel.topology import to_padded_neighbors, tree
+
+    def structured_nem(mesh):
+        del mesh
+        mesh = _mesh2d()
+        n, nv = 64, 64
+        spec = faults.NemesisSpec(n_nodes=n, seed=9,
+                                  crash=((1, 3, (0, 5)),),
+                                  loss_rate=0.15, loss_until=5,
+                                  dup_rate=0.1, dup_until=5)
+        sim = BroadcastSim(
+            to_padded_neighbors(tree(n)), n_values=nv, sync_every=4,
+            srv_ledger=False, mesh=mesh,
+            exchange=structured.make_exchange("tree", n),
+            fault_plan=spec.compile(),
+            nemesis=structured.make_nemesis(
+                "tree", n, spec, n_shards=node_shards(mesh),
+                axis_name=node_axes(mesh)))
+        prog, args_fn = sim.audit_step_program()
+        state, _ = sim.stage(make_inject(n, nv))
+        return AuditProgram(prog, args_fn(state))
+
+    return [
+        ProgramContract(
+            name="broadcast/dcn-halo-wm-nem",
+            build=structured_nem,
+            collectives={"all-reduce": None,
+                         "collective-permute": None},
+            needs_mesh=False,
+            dcn_per_host=PER_HOST,
+            notes="structured words-major nemesis round on the "
+                  "hierarchical mesh: the per-axis ppermute halo + "
+                  "mask decomposition stays gather-free, and no "
+                  "replica group crosses a host block"),
+        _rebind(
+            counter.audit_contracts(),
+            "counter/sharded-step-wide", "counter/dcn-wide-round",
+            notes="wide two-pmin winner on the hierarchical mesh: "
+                  "psum/pmin reduce over BOTH axes (partial-per-host "
+                  "then DCN) — still no gather anywhere"),
+        _rebind(
+            counter.audit_contracts(),
+            "counter/sharded-traffic-run", "counter/dcn-traffic-run",
+            notes="open-loop traffic driver on the hierarchical "
+                  "mesh: donation survives the 2-D resharding (the "
+                  "state aliases in place) and the compiled peak "
+                  "stays in the per-host analytic memory band"),
+        _rebind(
+            kafka.audit_contracts(),
+            "kafka/sharded-step-union", "kafka/dcn-union-round",
+            notes="blocked psum-of-OR + ppermute prefix scan on the "
+                  "hierarchical mesh: presence unions decompose "
+                  "per axis, no host-crossing gather"),
+        _rebind(
+            scenario.audit_contracts(),
+            "counter/scenario-batch-run", "counter/dcn-scenario-batch",
+            notes="host-sharded scenario batch: the leading scenario "
+                  "axis splits over DCN, every node axis runs "
+                  "locally — cap-0 census, donation and the "
+                  "per-host memory band intact on the 2-D mesh"),
+    ]

@@ -166,6 +166,37 @@ def sharded_shift(x_local: jnp.ndarray, s: int, n_shards: int,
 # -- collectives --------------------------------------------------------
 
 
+#: the reserved DCN axis name: a mesh carrying it is hierarchical
+#: (pick_mesh_2d), with the host axis OUTERMOST and the per-host ICI
+#: node axis inside — collectives() then runs two-level circuits
+HOSTS_AXIS = "hosts"
+
+
+def node_axes(mesh, axis: str = "nodes"):
+    """The axis name(s) the NODE dimension is sharded over: the plain
+    ``axis`` string on a 1-D (or nodes x words) mesh, the
+    ``(HOSTS_AXIS, axis)`` tuple on a hierarchical mesh — in exactly
+    the order ``PartitionSpec``/``ppermute``/``all_gather`` linearize
+    (hosts-major, matching the 2-D mesh layout).  Every spec-building
+    site threads this instead of hardcoding ``"nodes"``; off-mesh it
+    returns ``axis`` unused."""
+    if mesh is not None and HOSTS_AXIS in mesh.axis_names:
+        return (HOSTS_AXIS, axis)
+    return axis
+
+
+def node_shards(mesh, axis: str = "nodes") -> int:
+    """GLOBAL node-shard count of ``mesh`` (hosts x per-host on a
+    hierarchical mesh), 1 off-mesh — the ``n_shards`` every blocked
+    layout divides by."""
+    if mesh is None:
+        return 1
+    n = int(mesh.shape[axis])
+    if HOSTS_AXIS in mesh.axis_names:
+        n *= int(mesh.shape[HOSTS_AXIS])
+    return n
+
+
 class Collectives(NamedTuple):
     """The per-round cross-shard surface every sim round consumes, built
     identity single-device and from the mesh axis under shard_map —
@@ -207,14 +238,55 @@ class Collectives(NamedTuple):
     reduce_and: Callable[[jnp.ndarray], jnp.ndarray]
     exclusive_sum: Callable[[jnp.ndarray], jnp.ndarray]
     local_cols: Callable[[jnp.ndarray], jnp.ndarray]
-    axis_name: str | None
+    axis_name: str | tuple | None
+
+
+def _or_level(x, ax, k: int):
+    # OR all-reduce over ONE mesh axis via collective-permute only
+    # (class docstring): recursive doubling when the axis is a power of
+    # two (each step pairs shard p with p XOR d), ring otherwise
+    if k & (k - 1) == 0:
+        d = 1
+        while d < k:
+            x = x | lax.ppermute(x, ax, [(p ^ d, p) for p in range(k)])
+            d <<= 1
+        return x
+    acc, cur = x, x
+    for _ in range(k - 1):
+        cur = lax.ppermute(cur, ax,
+                           [((p + 1) % k, p) for p in range(k)])
+        acc = acc | cur
+    return acc
+
+
+def _excl_level(x, ax, k: int):
+    # Hillis-Steele inclusive scan over ONE shard axis (shards below
+    # the stride receive ppermute's missing-source zeros), minus the
+    # local contribution
+    acc, d = x, 1
+    while d < k:
+        acc = acc + lax.ppermute(
+            acc, ax, [(p, p + d) for p in range(k - d)])
+        d <<= 1
+    return acc - x
 
 
 def collectives(block: int, mesh=None, *, axis: str = "nodes",
                 gather_axis: int = 0) -> Collectives:
     """Build the :class:`Collectives` for a round over ``block`` local
     rows.  With a mesh this MUST be called from inside the shard_map'd
-    function (it reads ``lax.axis_index``); off-mesh it is pure."""
+    function (it reads ``lax.axis_index``); off-mesh it is pure.
+
+    On a hierarchical mesh (:data:`HOSTS_AXIS` present, pick_mesh_2d)
+    the exchange members run TWO-LEVEL circuits: the ppermute ladder
+    over the per-host ICI ``axis`` first, then the same ladder over the
+    DCN hosts axis carrying one per-host partial — O(log hosts) block
+    moves over DCN, never an all-gather of the operands (the PR-4
+    contract, now per level).  The indexing members compose the two
+    axis indices hosts-major (the tuple-axis linearization of the 2-D
+    mesh layout), so global row ids, gathers, and column slices are
+    identical to the flat 1-D mesh's — that identity is what the
+    2-proc x 4-dev == 1-proc x 8-dev parity suite pins."""
     if mesh is None:
         ident = lambda x: x                              # noqa: E731
         return Collectives(
@@ -224,52 +296,45 @@ def collectives(block: int, mesh=None, *, axis: str = "nodes",
             exclusive_sum=jnp.zeros_like,
             local_cols=ident, axis_name=None)
     axes = tuple(mesh.axis_names)
-    n_sh = int(mesh.shape[axis])
-    row_ids = (lax.axis_index(axis) * block
+    na = node_axes(mesh, axis)
+    hier = na != axis
+    n_inner = int(mesh.shape[axis])
+    n_hosts = int(mesh.shape[HOSTS_AXIS]) if hier else 1
+    # innermost level first: ICI circuits complete before any DCN hop
+    levels = [(axis, n_inner)] + ([(HOSTS_AXIS, n_hosts)] if hier else [])
+    row_ids = (lax.axis_index(na) * block
                + jnp.arange(block, dtype=jnp.int32))
 
     def reduce_or(x):
-        # OR all-reduce via collective-permute only (class docstring):
-        # recursive doubling when the axis is a power of two (each step
-        # pairs shard p with p XOR d), ring otherwise
-        if n_sh & (n_sh - 1) == 0:
-            d = 1
-            while d < n_sh:
-                x = x | lax.ppermute(x, axis,
-                                     [(p ^ d, p) for p in range(n_sh)])
-                d <<= 1
-            return x
-        acc, cur = x, x
-        for _ in range(n_sh - 1):
-            cur = lax.ppermute(cur, axis,
-                               [((p + 1) % n_sh, p) for p in range(n_sh)])
-            acc = acc | cur
-        return acc
+        for ax, k in levels:
+            if k > 1:
+                x = _or_level(x, ax, k)
+        return x
 
     def exclusive_sum(x):
-        # Hillis-Steele inclusive scan over the shard axis (shards below
-        # the stride receive ppermute's missing-source zeros), minus the
-        # local contribution
-        acc, d = x, 1
-        while d < n_sh:
-            acc = acc + lax.ppermute(
-                acc, axis, [(p, p + d) for p in range(n_sh - d)])
-            d <<= 1
-        return acc - x
+        # global exclusive prefix for shard (h, i), hosts-major: the
+        # intra-host exclusive scan plus, over DCN, the exclusive scan
+        # of each host's full partial (one psum-reduced block per host
+        # crosses DCN — not the per-shard operands)
+        out = _excl_level(x, axis, n_inner)
+        if hier and n_hosts > 1:
+            out = out + _excl_level(lax.psum(x, axis), HOSTS_AXIS,
+                                    n_hosts)
+        return out
 
     return Collectives(
         row_ids=row_ids,
-        widen=lambda x: lax.all_gather(x, axis, axis=gather_axis,
+        widen=lambda x: lax.all_gather(x, na, axis=gather_axis,
                                        tiled=True),
         reduce_sum=lambda x: lax.psum(x, axes),
-        reduce_max=lambda x: lax.pmax(x, axis),
-        reduce_min=lambda x: lax.pmin(x, axis),
+        reduce_max=lambda x: lax.pmax(x, na),
+        reduce_min=lambda x: lax.pmin(x, na),
         reduce_or=reduce_or,
         reduce_and=lambda x: ~reduce_or(~x),
         exclusive_sum=exclusive_sum,
         local_cols=lambda m: lax.dynamic_slice_in_dim(
-            m, lax.axis_index(axis) * block, block, axis=1),
-        axis_name=axis)
+            m, lax.axis_index(na) * block, block, axis=1),
+        axis_name=na)
 
 
 # -- round-fused drivers (traced-side combinators) ----------------------
@@ -480,6 +545,26 @@ def host_unpack_bits(words, n_bits: int | None = None):
     return out if n_bits is None else out[..., :n_bits]
 
 
+def host_view(x):
+    """``np.ndarray`` of ``x`` wherever it lives.  Single-process
+    arrays (and fully-replicated multi-process ones) fetch directly;
+    an array SHARDED across processes is first replicated with an
+    identity jit — the one permitted cross-host gather in the DCN
+    layer: collect-time verdict pulls on host, never an operand move
+    inside a round program (the PR-4 contract, audited per level)."""
+    if isinstance(x, jax.Array) and not (
+            x.is_fully_addressable or x.is_fully_replicated):
+        sharding = x.sharding
+        mesh = getattr(sharding, "mesh", None)
+        if mesh is None:                             # pragma: no cover
+            raise ValueError(
+                f"host_view: cannot replicate {type(sharding).__name__}"
+                " — expected a NamedSharding from the batch programs")
+        rep = jax.sharding.NamedSharding(mesh, P())
+        x = jax.jit(lambda v: v, out_shardings=rep)(x)
+    return np.asarray(x)
+
+
 def scan_rounds(round_fn: Callable, state, xs):
     """R pre-staged rounds as one ``lax.scan``: ``round_fn(state, x) ->
     state`` over the leading axis of the ``xs`` pytree."""
@@ -545,10 +630,16 @@ def scenario_placement(n_scenarios: int, mesh=None,
       (scenario.pad_batch) rather than sharding the node axis: a
       fuzzer's unit of work is the scenario, and padding keeps the
       single zero-collective program shape.
+
+    On a hierarchical mesh the scenario axis shards over BOTH axes
+    (hosts-major): the zero-collective batch is embarrassingly
+    DCN-parallel, so S scenarios on H hosts cost S/H per host with
+    zero cross-host traffic — the device count below is the global
+    hosts x per-host product.
     """
     if mesh is None:
         return "single"
-    n_sh = int(mesh.shape[axis])
+    n_sh = node_shards(mesh, axis)
     if n_scenarios >= n_sh and n_scenarios % n_sh == 0:
         return "scenario"
     return "single"
@@ -572,8 +663,9 @@ def scenario_program(per_scenario_fn: Callable, example_args: tuple,
         example_args[0])[0].shape[0]
     if scenario_placement(n_scenarios, mesh, axis) == "single":
         return jax.jit(batched, donate_argnums=donate_argnums)
+    na = node_axes(mesh, axis)
     lead = lambda tree: jax.tree_util.tree_map(         # noqa: E731
-        lambda _leaf: P(axis), tree)
+        lambda _leaf: P(na), tree)
     in_specs = tuple(lead(a) for a in example_args)
     out_shape = jax.eval_shape(batched, *example_args)
     out_specs = lead(out_shape)

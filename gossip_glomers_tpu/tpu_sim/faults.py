@@ -467,14 +467,15 @@ class WMNemesisArrays(NamedTuple):
     down_cols: jnp.ndarray      # (C, N) bool — amnesia / receiver-up
 
 
-def wm_specs(sharded: bool) -> WMNemesisArrays:
+def wm_specs(sharded: bool, axes="nodes") -> WMNemesisArrays:
     """shard_map in_specs for a :class:`WMNemesisArrays` operand: every
-    row positionally sharded with the node axis on the halo path (all
-    masking is receiver-column-local, zero extra ICI), replicated on
-    the all_gather fallback (the full-axis masked exchange needs
-    full-axis masks)."""
-    r2 = P(None, "nodes") if sharded else P(None, None)
-    r3 = P(None, None, "nodes") if sharded else P(None, None, None)
+    row positionally sharded with the node axis (``axes`` — the sim's
+    ``engine.node_axes`` result, a tuple on a hierarchical mesh) on
+    the halo path (all masking is receiver-column-local, zero extra
+    ICI), replicated on the all_gather fallback (the full-axis masked
+    exchange needs full-axis masks)."""
+    r2 = P(None, axes) if sharded else P(None, None)
+    r3 = P(None, None, axes) if sharded else P(None, None, None)
     return WMNemesisArrays(r2, r3, r3, r2, r2, r2, r3, r3, r2, r2, r2)
 
 

@@ -92,8 +92,9 @@ from . import faults, kvstore, provenance, telemetry, traffic
 from .counter import KVReach, _reach
 from .engine import (analytic_peak_bytes, collectives,
                      donate_argnums_for, fori_rounds, jit_program,
-                     operand_bytes, resolve_block, scan_blocks,
-                     scan_rounds, unpack_bits)
+                     node_axes, node_shards, operand_bytes,
+                     resolve_block, scan_blocks, scan_rounds,
+                     unpack_bits)
 
 
 class KafkaState(NamedTuple):
@@ -322,7 +323,8 @@ class KafkaSim:
         # streaming-union destination slab (None = materialized): per
         # LOCAL destination row the union_nem coin slab costs N·S
         # uint32 hashes
-        n_sh = 1 if mesh is None else int(mesh.shape["nodes"])
+        n_sh = node_shards(mesh)
+        self._na = node_axes(mesh)
         if n_nodes % n_sh != 0:
             raise ValueError("node axis must shard evenly")
         self._rows_local = n_nodes // n_sh
@@ -350,13 +352,13 @@ class KafkaSim:
             rows=(kvstore.init_rows(self._kv_layout, self.mesh)
                   if self._device_kv else None))
         if self.mesh is not None:
-            node3 = NamedSharding(self.mesh, P("nodes", None, None))
+            node3 = NamedSharding(self.mesh, P(self._na, None, None))
             state = state._replace(
                 present=jax.device_put(state.present, node3),
                 origin_bits=jax.device_put(state.origin_bits, node3),
                 local_committed=jax.device_put(
                     state.local_committed,
-                    NamedSharding(self.mesh, P("nodes", None))))
+                    NamedSharding(self.mesh, P(self._na, None))))
         return state
 
     # -- round -------------------------------------------------------------
@@ -814,9 +816,10 @@ class KafkaSim:
     def _state_spec(self):
         rows = (kvstore.rows_spec(self.mesh) if self._device_kv
                 else None)
-        return KafkaState(P(None, None), P("nodes", None, None),
-                          P(), P("nodes", None),
-                          P("nodes", None, None), P(), P(),
+        na = self._na
+        return KafkaState(P(None, None), P(na, None, None),
+                          P(), P(na, None),
+                          P(na, None, None), P(), P(),
                           rows=rows)
 
     def _repl_mode(self, repl_ok) -> str:
@@ -897,7 +900,7 @@ class KafkaSim:
             if mesh is None:
                 prog = jit_program(step)
             else:
-                node2 = P("nodes", None)
+                node2 = P(self._na, None)
                 state_spec = self._state_spec()
                 in_specs = ((state_spec, node2, node2, node2)
                             + ((P(None, None),) if matmul else ())
@@ -945,7 +948,7 @@ class KafkaSim:
             if mesh is None:
                 prog = jit_program(run, donate_argnums=dn)
             else:
-                node3 = P(None, "nodes", None)
+                node3 = P(None, self._na, None)
                 state_spec = self._state_spec()
                 in_specs = ((state_spec, node3, node3)
                             + ((node3,) if has_commits else ())
@@ -991,7 +994,7 @@ class KafkaSim:
         if has_commits:
             args.append(jnp.asarray(commit_req, jnp.int32))
         if self.mesh is not None:
-            sh = NamedSharding(self.mesh, P(None, "nodes", None))
+            sh = NamedSharding(self.mesh, P(None, self._na, None))
             args = [jax.device_put(a, sh) for a in args]
         if matmul:
             args.append(jnp.asarray(repl_ok))
@@ -1190,7 +1193,7 @@ class KafkaSim:
         if mesh is None:
             prog = jit_program(run, donate_argnums=dn)
         else:
-            node3 = P(None, "nodes", None)
+            node3 = P(None, self._na, None)
             state_spec = self._state_spec()
             tel_in = ((telemetry.state_specs(),) if tl else ())
             prov_in = ((provenance.kafka_specs(),) if pv else ())
@@ -1233,7 +1236,7 @@ class KafkaSim:
         if has_commits:
             args.append(jnp.asarray(commit_req, jnp.int32))
         if self.mesh is not None:
-            sh = NamedSharding(self.mesh, P(None, "nodes", None))
+            sh = NamedSharding(self.mesh, P(None, self._na, None))
             args = [jax.device_put(a, sh) for a in args]
         args.append(self.kv_sched)
         if self._fp_active:
@@ -1255,7 +1258,7 @@ class KafkaSim:
         prog = self._build_obs_prog(tspec, False, donate, prov_spec)
         args = [jnp.asarray(sks), jnp.asarray(svs)]
         if self.mesh is not None:
-            sh = NamedSharding(self.mesh, P(None, "nodes", None))
+            sh = NamedSharding(self.mesh, P(None, self._na, None))
             args = [jax.device_put(a, sh) for a in args]
         args.append(self.kv_sched)
         if self._fp_active:
@@ -1286,7 +1289,7 @@ class KafkaSim:
                 jnp.asarray(send_val, jnp.int32),
                 jnp.asarray(commit_req, jnp.int32)]
         if self.mesh is not None:
-            sh = NamedSharding(self.mesh, P("nodes", None))
+            sh = NamedSharding(self.mesh, P(self._na, None))
             args = [jax.device_put(a, sh) for a in args]
         if matmul:
             args.append(jnp.asarray(repl_ok))
@@ -1415,7 +1418,7 @@ class KafkaSim:
                 "compare blocked vs materialized via union_block "
                 "instead")
         mesh = self.mesh
-        n_sh = 1 if mesh is None else int(mesh.shape["nodes"])
+        n_sh = node_shards(mesh)
         if tspec.n_clients % n_sh != 0:
             raise ValueError(
                 f"n_clients={tspec.n_clients} must shard evenly over "
@@ -1452,7 +1455,7 @@ class KafkaSim:
         if mesh is None:
             prog = jit_program(run, donate_argnums=dn)
         else:
-            t_specs = traffic.state_specs(True)
+            t_specs = traffic.state_specs(True, self._na)
             state_spec = self._state_spec()
             tel_in = (telemetry.state_specs(),) if tl else ()
             in_specs = ((state_spec,) + tel_in
@@ -1680,7 +1683,7 @@ def _step_args(sim):
             jnp.zeros((n, s), jnp.int32),
             jnp.full((n, k), -1, jnp.int32)]
     if sim.mesh is not None:
-        sh = NamedSharding(sim.mesh, P("nodes", None))
+        sh = NamedSharding(sim.mesh, P(sim._na, None))
         args = [jax.device_put(a, sh) for a in args]
     return args
 

@@ -159,8 +159,10 @@ def init_rows(layout: KVLayout, mesh=None) -> KVRows:
     def z():
         arr = jnp.zeros((layout.n_nodes, layout.cap), jnp.int32)
         if mesh is not None:
+            from .engine import node_axes
+
             arr = jax.device_put(
-                arr, NamedSharding(mesh, P("nodes", None)))
+                arr, NamedSharding(mesh, P(node_axes(mesh), None)))
         return arr
 
     return KVRows(vals=z(), vers=z())
@@ -168,7 +170,11 @@ def init_rows(layout: KVLayout, mesh=None) -> KVRows:
 
 def rows_spec(mesh=None) -> KVRows:
     """shard_map in/out specs for a :class:`KVRows` operand."""
-    spec = P("nodes", None) if mesh is not None else None
+    if mesh is None:
+        return KVRows(vals=None, vers=None)
+    from .engine import node_axes
+
+    spec = P(node_axes(mesh), None)
     return KVRows(vals=spec, vers=spec)
 
 

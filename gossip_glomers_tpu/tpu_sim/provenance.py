@@ -176,13 +176,14 @@ def init_kafka(n_keys: int, capacity: int) -> KafkaProv:
                        for _ in range(3)))
 
 
-def broadcast_specs() -> BroadcastProv:
-    """shard_map in/out_specs: node-sharded with the gather state."""
-    return BroadcastProv(P("nodes", None), P("nodes", None))
+def broadcast_specs(axes="nodes") -> BroadcastProv:
+    """shard_map in/out_specs: node-sharded with the gather state
+    (``axes`` is the sim's ``engine.node_axes`` result)."""
+    return BroadcastProv(P(axes, None), P(axes, None))
 
 
-def counter_specs() -> CounterProv:
-    return CounterProv(P("nodes"), P("nodes"), P("nodes"))
+def counter_specs(axes="nodes") -> CounterProv:
+    return CounterProv(P(axes), P(axes), P(axes))
 
 
 def kafka_specs() -> KafkaProv:

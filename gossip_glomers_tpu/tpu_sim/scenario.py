@@ -52,7 +52,8 @@ from . import broadcast as B
 from . import counter as CT
 from . import faults, kafka as KF, telemetry, traffic
 from . import txn as TX
-from .engine import scenario_placement, scenario_program
+from .engine import (host_view, node_axes, node_shards,
+                     scenario_placement, scenario_program)
 
 # The module's host/device split, DECLARED (the PR-6 faults.py
 # pattern): the determinism lint (tpu_sim/audit.py) treats exactly
@@ -76,7 +77,7 @@ HOST_SIDE = (
     "dispatch_serving_batch", "collect_serving_batch",
     "run_serving_batch", "serving_state_bytes",
     "pad_serving_batch", "_serving_common", "_serving_sig",
-    "_sig_setup")
+    "_sig_setup", "_replicated_out")
 
 
 # -- scenario cases ------------------------------------------------------
@@ -349,7 +350,7 @@ def _place(args, mesh):
     s = jax.tree_util.tree_leaves(args[0])[0].shape[0]
     if scenario_placement(s, mesh) == "single":
         return args
-    sh = NamedSharding(mesh, P("nodes"))
+    sh = NamedSharding(mesh, P(node_axes(mesh)))
     return tuple(
         jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), a)
         for a in args)
@@ -371,6 +372,22 @@ def _build_batch_program(workload: str, per_scenario, example_args,
             per_scenario, example_args, mesh=mesh,
             donate_argnums=donate_argnums)
     return _PROGS[full_key]
+
+
+def _replicated_out(out):
+    """A dispatched batch's outputs, pulled to host when the mesh
+    spans processes (PR 15): every certify/collect read below is a
+    host-side numpy consumer, and a cross-process shard cannot be
+    fetched directly — ``engine.host_view`` replicates each leaf
+    first.  Single-process dispatches pass through untouched, so the
+    returned ``final`` pytree keeps its device arrays there."""
+    leaves = jax.tree_util.tree_leaves(out)
+    if not any(isinstance(leaf, jax.Array)
+               and not leaf.is_fully_addressable for leaf in leaves):
+        return out
+    return jax.tree_util.tree_map(
+        lambda x: (host_view(x) if isinstance(x, jax.Array) else x),
+        out)
 
 
 def _verdict_rows(batch: ScenarioBatch, conv_round, msgs_clear,
@@ -614,7 +631,8 @@ def _dispatch_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
 def _collect_broadcast_batch(handle: dict) -> dict:
     """Block on + certify a dispatched broadcast batch (the host half
     of :func:`run_broadcast_batch`)."""
-    out, batch = handle["out"], handle["batch"]
+    out = _replicated_out(handle["out"])
+    batch = handle["batch"]
     telemetry_spec = handle["telemetry_spec"]
     n, nv = handle["n"], handle["nv"]
     s_count = len(batch.scenarios)
@@ -743,7 +761,8 @@ def _dispatch_counter_batch(batch: ScenarioBatch, *, mesh=None,
 
 def _collect_counter_batch(handle: dict) -> dict:
     """Block on + certify a dispatched counter batch."""
-    out, batch = handle["out"], handle["batch"]
+    out = _replicated_out(handle["out"])
+    batch = handle["batch"]
     telemetry_spec = handle["telemetry_spec"]
     n, mode = handle["n"], handle["mode"]
     acked_sum = handle["acked_sum"]
@@ -881,7 +900,8 @@ def _dispatch_kafka_batch(batch: ScenarioBatch, *, mesh=None,
 
 def _collect_kafka_batch(handle: dict) -> dict:
     """Block on + certify a dispatched kafka batch."""
-    out, batch = handle["out"], handle["batch"]
+    out = _replicated_out(handle["out"])
+    batch = handle["batch"]
     telemetry_spec = handle["telemetry_spec"]
     n, n_keys = handle["n"], handle["n_keys"]
     s_count = len(batch.scenarios)
@@ -1017,7 +1037,8 @@ def _collect_txn_batch(handle: dict) -> dict:
     lost-writes evidence; any other anomaly still fails the row)."""
     from ..harness.checkers import check_txn_serializable
 
-    out, batch = handle["out"], handle["batch"]
+    out = _replicated_out(handle["out"])
+    batch = handle["batch"]
     sim, ops = handle["sim"], handle["ops"]
     s_count = len(batch.scenarios)
     final, conv_round, msgs_clear = out[0], out[1], out[2]
@@ -1098,7 +1119,7 @@ def dispatch_scenario_batch(batch: ScenarioBatch, *, mesh=None,
     n_real = len(batch.scenarios)
     mult = 1
     if mesh is not None and pad_to_mesh:
-        mult = int(mesh.shape["nodes"])
+        mult = node_shards(mesh)
     if pad_to:
         mult = max(mult, int(pad_to))
     if mult > 1:
@@ -1395,7 +1416,7 @@ def dispatch_serving_batch(batch: ServingBatch, *, mesh=None,
     n_real = len(batch.cells)
     if mesh is not None and pad_to_mesh:
         batch, n_real = pad_serving_batch(
-            batch, int(mesh.shape["nodes"]))
+            batch, node_shards(mesh))
     cells = batch.cells
     s_count = len(cells)
     n = batch.n_nodes
@@ -1579,7 +1600,8 @@ def collect_serving_batch(handle: dict) -> dict:
     the benchmark that timed it)."""
     from ..harness.checkers import check_recovery
 
-    out, batch = handle["out"], handle["batch"]
+    out = _replicated_out(handle["out"])
+    batch = handle["batch"]
     telemetry_spec = handle["telemetry_spec"]
     tl = telemetry_spec is not None
     n_real = handle["n_real"]

@@ -422,16 +422,20 @@ class TrafficState(NamedTuple):
     completed: jnp.ndarray    # () uint32
 
 
-def state_specs(sharded: bool) -> TrafficState:
+def state_specs(sharded: bool, axes="nodes") -> TrafficState:
     """shard_map in/out_specs for a :class:`TrafficState`: client-axis
-    leaves positionally sharded with the node axis, counters
-    replicated (they are reduce_sum-globalized every round)."""
-    r1 = P("nodes") if sharded else P(None)
-    r2 = P("nodes", None) if sharded else P(None, None)
+    leaves positionally sharded with the node axis (``axes`` — the
+    sim's ``engine.node_axes`` result, a tuple on a hierarchical
+    mesh), counters replicated (they are reduce_sum-globalized every
+    round)."""
+    r1 = P(axes) if sharded else P(None)
+    r2 = P(axes, None) if sharded else P(None, None)
     return TrafficState(r1, r2, r2, r2, P(), P(), P())
 
 
 def init_state(spec: TrafficSpec, mesh=None) -> TrafficState:
+    from .engine import node_axes, node_shards
+
     c, k = spec.n_clients, spec.ops_per_client
     ts = TrafficState(
         issued_k=jnp.zeros((c,), jnp.int32),
@@ -441,13 +445,14 @@ def init_state(spec: TrafficSpec, mesh=None) -> TrafficState:
         arrived=jnp.uint32(0), deferred=jnp.uint32(0),
         completed=jnp.uint32(0))
     if mesh is not None:
-        n_sh = int(mesh.shape["nodes"])
+        n_sh = node_shards(mesh)
         if c % n_sh != 0:
             raise ValueError(
                 f"n_clients={c} must shard evenly over the "
                 f"{n_sh}-way node axis")
-        s1 = NamedSharding(mesh, P("nodes"))
-        s2 = NamedSharding(mesh, P("nodes", None))
+        na = node_axes(mesh)
+        s1 = NamedSharding(mesh, P(na))
+        s2 = NamedSharding(mesh, P(na, None))
         ts = ts._replace(
             issued_k=jax.device_put(ts.issued_k, s1),
             issue_round=jax.device_put(ts.issue_round, s2),

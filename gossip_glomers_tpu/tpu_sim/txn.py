@@ -59,7 +59,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import faults, kvstore, traffic
 from .engine import (Collectives, collectives, donate_argnums_for,
-                     fori_rounds, jit_program)
+                     fori_rounds, jit_program, node_axes)
 
 # Host/device split, DECLARED (PR 6): tests/test_txn.py pins it total.
 # The round body itself is the TxnSim._round method plus the nested
@@ -99,9 +99,10 @@ class TxnState(NamedTuple):
     msgs: jnp.ndarray             # () uint32 — charge-at-send ledger
 
 
-def ops_specs() -> TxnOps:
-    """shard_map in_specs for the ops operand (node-sharded)."""
-    node3 = P("nodes", None, None)
+def ops_specs(axes="nodes") -> TxnOps:
+    """shard_map in_specs for the ops operand (node-sharded; ``axes``
+    is the sim's ``engine.node_axes`` result)."""
+    node3 = P(axes, None, None)
     return TxnOps(node3, node3, node3)
 
 
@@ -185,7 +186,8 @@ class TxnSim:
         self._key_at = jnp.asarray(self.layout.key_at)
         self.ops = stage_txn_ops(n_nodes, txns_per_node, ops_per_txn,
                                  n_keys, workload_seed)
-        self._node_spec = P("nodes") if mesh is not None else None
+        self._na = node_axes(mesh)
+        self._node_spec = P(self._na) if mesh is not None else None
         self._run_progs: dict = {}
         self._step = self._build_step()
         self._run_n = self._build_run_n(donate=False)
@@ -197,7 +199,7 @@ class TxnSim:
         def z(shape):
             arr = jnp.zeros(shape, jnp.int32)
             if self.mesh is not None:
-                spec = P("nodes", *([None] * (len(shape) - 1)))
+                spec = P(self._na, *([None] * (len(shape) - 1)))
                 arr = jax.device_put(
                     arr, NamedSharding(self.mesh, spec))
             return arr
@@ -317,8 +319,9 @@ class TxnSim:
 
     def _state_spec(self) -> TxnState:
         node = self._node_spec
-        node2 = P("nodes", None) if self.mesh is not None else None
-        node3 = (P("nodes", None, None) if self.mesh is not None
+        node2 = (P(self._na, None) if self.mesh is not None
+                 else None)
+        node3 = (P(self._na, None, None) if self.mesh is not None
                  else None)
         return TxnState(
             rows=kvstore.rows_spec(self.mesh),
@@ -349,7 +352,7 @@ class TxnSim:
         else:
             prog = jit_program(
                 step, mesh=mesh,
-                in_specs=(self._state_spec(), ops_specs(),
+                in_specs=(self._state_spec(), ops_specs(self._na),
                           traffic.plan_specs()) + fp_specs,
                 out_specs=self._state_spec(), check_vma=False)
         return lambda state: prog(state, *self._operand(), *fp_args)
@@ -373,7 +376,7 @@ class TxnSim:
         else:
             prog = jit_program(
                 run_n, mesh=mesh,
-                in_specs=(self._state_spec(), ops_specs(),
+                in_specs=(self._state_spec(), ops_specs(self._na),
                           traffic.plan_specs(), P()) + fp_specs,
                 out_specs=self._state_spec(), check_vma=False,
                 donate_argnums=dn)
@@ -505,7 +508,7 @@ def audit_contracts():
 
         jitted = jit_program(
             step, mesh=mesh,
-            in_specs=(sim._state_spec(), ops_specs(),
+            in_specs=(sim._state_spec(), ops_specs(sim._na),
                       traffic.plan_specs()) + fp_specs,
             out_specs=sim._state_spec(), check_vma=False)
         return AuditProgram(

@@ -49,8 +49,11 @@ class UniqueIdsSim:
     def init_state(self) -> UniqueIdsState:
         minted = jnp.zeros((self.n_nodes,), jnp.int32)
         if self.mesh is not None:
+            from .engine import node_axes
+
             minted = jax.device_put(
-                minted, NamedSharding(self.mesh, P("nodes")))
+                minted,
+                NamedSharding(self.mesh, P(node_axes(self.mesh))))
         return UniqueIdsState(t=jnp.int32(0), minted=minted)
 
     def _build_step(self):
@@ -75,26 +78,30 @@ class UniqueIdsSim:
 
         from jax import lax
 
-        from .engine import jit_program
+        from .engine import jit_program, node_axes
 
-        node = P("nodes")
+        na = node_axes(self.mesh)
+        node = P(na)
         state_spec = UniqueIdsState(P(), node)
 
         def step(state, counts):
             block = counts.shape[0]
-            row_ids = (lax.axis_index("nodes") * block
+            row_ids = (lax.axis_index(na) * block
                        + jnp.arange(block, dtype=jnp.int32))
             return mint(state, counts, row_ids)
 
         return jit_program(
             step, mesh=self.mesh, in_specs=(state_spec, node),
-            out_specs=(state_spec, P("nodes", None, None)))
+            out_specs=(state_spec, P(na, None, None)))
 
     def step(self, state: UniqueIdsState, counts: np.ndarray
              ) -> tuple[UniqueIdsState, jnp.ndarray]:
         c = jnp.asarray(counts, jnp.int32)
         if self.mesh is not None:
-            c = jax.device_put(c, NamedSharding(self.mesh, P("nodes")))
+            from .engine import node_axes
+
+            c = jax.device_put(
+                c, NamedSharding(self.mesh, P(node_axes(self.mesh))))
         return self._step(state, c)
 
     @staticmethod

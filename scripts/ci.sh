@@ -83,6 +83,14 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 # ids.  (CPU, seconds.)
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/txn_smoke.py || rc=1
+# DCN smoke (PR 15): a REAL 2-process jax.distributed CPU cluster
+# (gloo, 2 virtual devices per process) runs the shared dcn_worker
+# tasks — all three sims stepwise + donated-fused, one certified
+# crash+loss structured broadcast, and the host-loss takeover drill —
+# and the parent pins every digest bit-exact against its own
+# 1-process x 4-device twin.  (CPU, seconds warm / ~2 min cold.)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/dcn_smoke.py || rc=1
 # Program-contract audit (PR 6): every registered driver contract
 # (collective census, donation alias table, host boundary, memory
 # band) on the CPU 8-way virtual mesh, plus the AST determinism lint

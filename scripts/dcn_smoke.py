@@ -1,0 +1,158 @@
+"""CI DCN smoke (PR 15): a REAL 2-process jax.distributed CPU cluster
+— 2 OS processes x 2 virtual devices each, gloo collectives — runs
+the shared ``parallel.dcn_worker`` tasks and this parent pins them
+bit-exact against its own 1-process x 4-device twin:
+
+- ``sims``      all three sims stepwise + donated-fused (the kafka
+                parity leg rides here), seed-replay inside the worker;
+- ``certify``   one certified crash+loss broadcast nemesis on the
+                structured words-major path;
+- ``takeover``  the HOST-loss drill: one DCN host's entire node block
+                crashes for a window, the survivors' flood stalls and
+                re-converges after restart.
+
+Every compared number is a replicated ledger scalar or an on-device
+position-weighted checksum, so rank-vs-rank and cluster-vs-twin
+equality is bit-exactness.  One retry with a fresh coordinator port
+absorbs the rare gloo startup flake.  Exits nonzero on any mismatch
+or failed certification.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gossip_glomers_tpu.parallel.mesh import (  # noqa: E402
+    force_virtual_devices)
+
+# the single-process twin matches the cluster's GLOBAL device count:
+# 2 procs x 2 devices = one 4-way virtual mesh here
+force_virtual_devices(4)
+
+from gossip_glomers_tpu.parallel.dcn_worker import (  # noqa: E402
+    run_tasks)
+from gossip_glomers_tpu.parallel.mesh import pick_mesh  # noqa: E402
+from gossip_glomers_tpu.utils.compile_cache import (  # noqa: E402
+    enable_compile_cache)
+
+TASKS = "sims,certify,takeover"
+N_PROCS, LOCAL_DEVICES = 2, 2
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_cluster(tmp: str, timeout: float = 480.0):
+    last_diag = ""
+    for attempt in range(2):
+        out = os.path.join(tmp, f"out{attempt}.json")
+        env = dict(os.environ)
+        # this parent forced a 4-device split; the workers must see a
+        # clean slate so GG_LOCAL_DEVICES=2 applies
+        env.pop("XLA_FLAGS", None)
+        env.update(JAX_PLATFORMS="cpu",
+                   GG_COORDINATOR=f"127.0.0.1:{_free_port()}",
+                   GG_NUM_PROCS=str(N_PROCS),
+                   GG_LOCAL_DEVICES=str(LOCAL_DEVICES),
+                   GG_DCN_TASKS=TASKS, GG_DCN_OUT=out)
+        procs, logs = [], []
+        for rank in range(N_PROCS):
+            log = open(os.path.join(tmp, f"log{attempt}.{rank}"),
+                       "w+")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "gossip_glomers_tpu.parallel.dcn_worker"],
+                cwd=REPO, env=dict(env, GG_PROC_ID=str(rank)),
+                stdout=log, stderr=subprocess.STDOUT))
+        deadline = time.monotonic() + timeout
+        rcs = []
+        for p in procs:
+            try:
+                rcs.append(p.wait(
+                    timeout=max(1.0, deadline - time.monotonic())))
+            except subprocess.TimeoutExpired:
+                rcs.append(None)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        if all(rc == 0 for rc in rcs):
+            reports = []
+            for rank in range(N_PROCS):
+                with open(f"{out}.{rank}") as fh:
+                    reports.append(json.load(fh))
+            for log in logs:
+                log.close()
+            return reports
+        diag = []
+        for rank, log in enumerate(logs):
+            log.seek(0)
+            diag.append(f"-- rank {rank} rc={rcs[rank]} --\n"
+                        + log.read()[-3000:])
+            log.close()
+        last_diag = "\n".join(diag)
+    print(f"dcn-smoke: cluster failed twice\n{last_diag}",
+          file=sys.stderr)
+    return None
+
+
+def main() -> int:
+    enable_compile_cache()
+    with tempfile.TemporaryDirectory() as tmp:
+        reports = _spawn_cluster(tmp)
+    if reports is None:
+        return 1
+    r0, r1 = reports
+    rc = 0
+    if r0["tasks"] != r1["tasks"]:
+        print("dcn-smoke: FAIL rank 0 and rank 1 reports differ",
+              file=sys.stderr)
+        rc = 1
+    if r0["mesh_shape"] != [N_PROCS, LOCAL_DEVICES]:
+        print(f"dcn-smoke: FAIL mesh shape {r0['mesh_shape']}",
+              file=sys.stderr)
+        rc = 1
+
+    flat = json.loads(json.dumps(
+        run_tasks(TASKS.split(","), pick_mesh())))
+    for task in TASKS.split(","):
+        same = flat[task] == r0["tasks"][task]
+        print(f"dcn-smoke {task:9s} "
+              f"{'parity-ok' if same else 'PARITY-FAIL'}")
+        if not same:
+            print(json.dumps({"cluster": r0["tasks"][task],
+                              "twin": flat[task]}, indent=1,
+                             sort_keys=True)[:4000], file=sys.stderr)
+            rc = 1
+
+    cert = r0["tasks"]["certify"]
+    take = r0["tasks"]["takeover"]
+    if not cert["ok"]:
+        print(f"dcn-smoke: FAIL certify {cert}", file=sys.stderr)
+        rc = 1
+    if not take["converged"]:
+        print(f"dcn-smoke: FAIL takeover {take}", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print("dcn-smoke: 2-proc cluster == 1-proc twin (bit-exact); "
+              f"certified nemesis ok (round "
+              f"{cert['converged_round']}), host-loss takeover "
+              f"converged in {take['rounds']} rounds")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -130,11 +130,146 @@ def test_broadcast_srv_ledger_loss_only_matches_virtual_harness():
         assert got["m"] == reads[i] == list(range(nv)), f"n{i}"
 
 
-def test_broadcast_srv_ledger_stays_off_beyond_loss_only():
-    """Crash windows or a dup stream have no defined reference
-    accounting for the srv ledger — those plans still force it off,
-    loudly, on the gather path AND on the words-major nemesis path
-    (PR 5 enables only the loss-only regime there)."""
+def test_broadcast_srv_ledger_crash_matches_virtual_harness():
+    """The PR-15 crash-cell contract (ROADMAP item-6 remainder, the
+    PR-14 KV decision carried to the broadcast srv ledger): crash
+    windows keep the gather path's reference accounting with
+    charge-at-send semantics — a request to a down node is charged
+    when sent and dies with the process (no reply), a down process
+    SENDS NOTHING (its sync reads don't fire; its frontier died in
+    the amnesia wipe), and the post-recovery anti-entropy wave
+    re-pushes the lost values, RE-CHARGING the repair.
+
+    Calibration scenario: the same 5-node STAR as the loss-only test
+    (exactness argument identical), leaf 2 crashed over rounds [1, 8)
+    — so the round-4 wave charges the center's read INTO the dead
+    process (charged, dropped, unanswered) while leaf 2 charges
+    nothing, and the round-8 wave repairs the amnesia-wiped leaf at
+    full price (read + empty read_ok + nv pushes + nv acks).  Loss
+    coins compose on top (rounds < 6).  The harness twin models the
+    process death with VirtualNetwork.down_fn (a dead process's sends
+    never enter the network — unlike drop_fn losses, which charge at
+    send and die in flight) plus drop_fn over the down window, and
+    the amnesia wipe by clearing the program's volatile set at crash
+    entry; the restart keeps the node's global sync phase, matching
+    the sim's round-synchronous waves."""
+    from gossip_glomers_tpu.models import BroadcastProgram
+    from gossip_glomers_tpu.parallel.topology import (to_padded_neighbors,
+                                                      tree)
+    from gossip_glomers_tpu.tpu_sim import faults as F
+    from gossip_glomers_tpu.tpu_sim.broadcast import BroadcastSim
+    from gossip_glomers_tpu.utils.config import BroadcastConfig
+
+    n, nv = 5, 10
+    CRASHED, C_START, C_END = 2, 1, 8
+
+    def d(plan, t, a, b) -> bool:
+        return bool(F.host_edge_drop(plan, t, np.array([a]),
+                                     np.array([b]))[0])
+
+    # seed search on the host coin mirror, as in the loss-only test:
+    # a round-0 loss must deprive at least one UP leaf, the round-4
+    # wave must repair at least one of them (both direction coins
+    # clean), and no up leaf may hit the one documented sim/reference
+    # divergence shape at wave 4 (in-coin delivers, out-coin drops)
+    spec = None
+    for seed in range(300):
+        cand = F.NemesisSpec(n_nodes=n, seed=seed, loss_rate=0.3,
+                             loss_until=6,
+                             crash=((C_START, C_END, (CRASHED,)),))
+        p = cand.compile()
+        up_leaves = [j for j in range(1, n) if j != CRASHED]
+        deprived = [j for j in up_leaves if d(p, 0, 0, j)]
+        if not deprived:
+            continue
+        if any(not d(p, 4, 0, j) and d(p, 4, j, 0)
+               for j in deprived):
+            continue
+        if not any(not d(p, 4, 0, j) and not d(p, 4, j, 0)
+                   for j in deprived):
+            continue
+        spec = cand
+        break
+    assert spec is not None, "no calibrating seed in range"
+    plan = spec.compile()
+
+    def down(node: int, now: float) -> bool:
+        return node == CRASHED and C_START <= int(round(now)) < C_END
+
+    # -- virtual harness: star, zero latency, coin drops + dead process
+    net = VirtualNetwork(NetConfig(seed=0))
+    cfg = BroadcastConfig(sync_interval=4.0, sync_jitter=0.0)
+    progs = {}
+    for i in range(n):
+        progs[i] = BroadcastProgram(cfg)
+        net.spawn(f"n{i}", progs[i])
+    net.init_cluster()
+    net.set_topology({"n0": [f"n{j}" for j in range(1, n)],
+                      **{f"n{j}": ["n0"] for j in range(1, n)}})
+    ids = {f"n{i}": i for i in range(n)}
+    net.down_fn = (lambda src, now:
+                   src in ids and down(ids[src], now))
+    net.drop_fn = (lambda src, dest, now:
+                   src in ids and dest in ids
+                   and (down(ids[src], now) or down(ids[dest], now)
+                        or d(plan, int(round(now)), ids[src],
+                             ids[dest])))
+    # amnesia at crash entry: volatile state dies with the process
+    net.schedule(float(C_START),
+                 lambda: progs[CRASHED].received.clear())
+    client = net.client("c1")
+    for v in range(nv):
+        client.rpc("n0", {"type": "broadcast", "message": v})
+    net.run_for(0.0)                       # the whole flood at now=0
+
+    # -- sim twin
+    nbrs = to_padded_neighbors(tree(n, branching=n - 1))
+    inject = np.zeros((n, 1), np.uint32)
+    inject[0, 0] = (1 << nv) - 1
+    sim = BroadcastSim(nbrs, n_values=32, sync_every=4,
+                       fault_plan=plan)
+    state = sim.init_state(inject)
+    state = sim.step(state)                # round 0: the flood
+    assert sim.server_msgs(state) == net.ledger.server_to_server
+    assert net.ledger.dropped > 0
+
+    while int(state.t) < 5:                # rounds 1-4: leaf 2 down,
+        state = sim.step(state)            # wave 4 reads it anyway
+    net.run_for(4.5)
+    assert sim.server_msgs(state) == net.ledger.server_to_server
+
+    while int(state.t) < 9:                # rounds 5-8: restart at 8,
+        state = sim.step(state)            # the repair wave re-charges
+    net.run_for(4.0)
+    assert sim.server_msgs(state) == net.ledger.server_to_server
+    # the amnesia repair was real: leaf 2 is whole again
+    assert sim.read(state)[CRASHED] == list(range(nv))
+
+    while int(state.t) < 13:               # quiesced wave 12: the
+        state = sim.step(state)            # restarted leaf reads too
+    net.run_for(4.0)
+    assert sim.server_msgs(state) == net.ledger.server_to_server
+
+    # end state identical on every node
+    reads = sim.read(state)
+    for i in range(n):
+        got = {}
+        client.rpc(f"n{i}", {"type": "read"},
+                   lambda rep: got.update(m=rep.body["messages"]))
+        net.run_for(0.0)
+        assert got["m"] == reads[i] == list(range(nv)), f"n{i}"
+
+
+def test_broadcast_srv_ledger_crash_on_dup_rejects_loudly():
+    """PR 15 closes the ROADMAP item-6 remainder with the PR-14 KV
+    decision: crash windows KEEP the gather path's srv ledger
+    (charge-at-send — a request to a down node is charged and dies
+    with the process, the retry re-charges), a dup stream REJECTS
+    loudly at construction when the ledger is requested (re-delivered
+    sets vs reference msg-id dedup cannot be calibrated — the
+    kvstore.reject_dup_stream stance), and the words-major nemesis
+    path stays loss-only (its coin rows carry no crash liveness
+    decomposition)."""
     import pytest
     from gossip_glomers_tpu.parallel.topology import (grid,
                                                       to_padded_neighbors)
@@ -147,20 +282,35 @@ def test_broadcast_srv_ledger_stays_off_beyond_loss_only():
     dup = F.NemesisSpec(n_nodes=16, seed=0, dup_rate=0.2, dup_until=4)
     loss = F.NemesisSpec(n_nodes=16, seed=0, loss_rate=0.2,
                          loss_until=4)
-    for spec, on in ((crash, False), (dup, False), (loss, True)):
-        for wm in (False, True):
-            kw = (dict(exchange=S.make_exchange("grid", 16),
-                       nemesis=S.make_nemesis("grid", 16, spec))
-                  if wm else {})
-            sim = BroadcastSim(nbrs, n_values=8,
-                               fault_plan=spec.compile(), **kw)
-            state = sim.init_state(np.zeros((16, 1), np.uint32))
-            state = sim.step(state)
-            if on:
-                assert sim.server_msgs(state) >= 0
-            else:
-                with pytest.raises(ValueError, match="loss-only"):
-                    sim.server_msgs(state)
+    # dup + requested ledger: loud at construction, gather AND wm
+    for wm in (False, True):
+        kw = (dict(exchange=S.make_exchange("grid", 16),
+                   nemesis=S.make_nemesis("grid", 16, dup))
+              if wm else {})
+        with pytest.raises(ValueError, match="dup"):
+            BroadcastSim(nbrs, n_values=8, fault_plan=dup.compile(),
+                         **kw)
+        # srv_ledger=False keeps the same construction fine (the msgs
+        # value ledger is the throughput signal there)
+        sim = BroadcastSim(nbrs, n_values=8, srv_ledger=False,
+                           fault_plan=dup.compile(), **kw)
+        state = sim.step(sim.init_state(np.zeros((16, 1), np.uint32)))
+        assert int(state.msgs) >= 0
+    # crash: ledger ON on the gather path, still off on words-major
+    for spec, wm, on in ((crash, False, True), (crash, True, False),
+                         (loss, False, True), (loss, True, True)):
+        kw = (dict(exchange=S.make_exchange("grid", 16),
+                   nemesis=S.make_nemesis("grid", 16, spec))
+              if wm else {})
+        sim = BroadcastSim(nbrs, n_values=8,
+                           fault_plan=spec.compile(), **kw)
+        state = sim.init_state(np.zeros((16, 1), np.uint32))
+        state = sim.step(state)
+        if on:
+            assert sim.server_msgs(state) >= 0
+        else:
+            with pytest.raises(ValueError, match="loss-only"):
+                sim.server_msgs(state)
     # per-direction delays composed into the bundle force it off too
     # (same stance as gather `delays`)
     simd = BroadcastSim(nbrs, n_values=8, fault_plan=loss.compile(),

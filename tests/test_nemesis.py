@@ -270,7 +270,7 @@ def test_broadcast_faulted_fused_matches_stepwise(use_mesh):
     parts = _parts(n)
     sim = BroadcastSim(nbrs, n_values=nv, sync_every=4,
                        fault_plan=SPEC.compile(), parts=parts,
-                       mesh=mesh)
+                       srv_ledger=False, mesh=mesh)
     inject = make_inject(n, nv)
     ref, rounds_ref = sim.run(inject, max_rounds=200)
     fused, rounds_f = sim.run_fused(inject, max_rounds=200)
@@ -552,9 +552,11 @@ def test_dup_delivery_is_absorbed_but_ledger_visible():
     with_dup = F.NemesisSpec(**base, dup_rate=0.3, dup_until=10)
     inject = make_inject(n, nv)
     s1, r1 = BroadcastSim(nbrs, n_values=nv, sync_every=4,
-                          fault_plan=no_dup.compile()).run(inject)
+                          fault_plan=no_dup.compile(),
+                          srv_ledger=False).run(inject)
     sim2 = BroadcastSim(nbrs, n_values=nv, sync_every=4,
-                        fault_plan=with_dup.compile())
+                        fault_plan=with_dup.compile(),
+                        srv_ledger=False)
     s2, r2 = sim2.run(inject)
     assert sim2.converged(s2, sim2.target_bits(inject))
     assert int(s2.msgs) > int(s1.msgs)
@@ -615,7 +617,8 @@ def test_structured_nemesis_matches_gather_all_topologies():
                             parts=parts2,
                             exchange=structured.make_exchange(
                                 topo, n, **kw),
-                            fault_plan=sp.compile(), nemesis=nem)
+                            fault_plan=sp.compile(), nemesis=nem,
+                            srv_ledger=False)
         s2, r2 = fast.run(inject, max_rounds=300)
         assert r1 == r2, (topo, n)
         assert (ref.received_node_major(s1)
@@ -650,7 +653,8 @@ def test_structured_nemesis_with_delays_matches_gather():
                             parts=parts2,
                             exchange=structured.make_exchange(
                                 topo, n, **kw),
-                            fault_plan=spec.compile(), nemesis=nem)
+                            fault_plan=spec.compile(), nemesis=nem,
+                            srv_ledger=False)
         s2, r2 = fast.run(inject, max_rounds=400)
         assert r1 == r2, (topo, dd)
         assert (ref.received_node_major(s1)
@@ -675,7 +679,7 @@ def test_structured_nemesis_sharded_fused_donated_parity():
                            parts=parts,
                            exchange=structured.make_exchange(
                                topo, n, **kw),
-                           fault_plan=spec.compile(),
+                           fault_plan=spec.compile(), srv_ledger=False,
                            nemesis=structured.make_nemesis(
                                topo, n, spec, groups=groups, **kw))
         s1, r1 = ref.run(inject, max_rounds=200)
@@ -690,7 +694,8 @@ def test_structured_nemesis_sharded_fused_donated_parity():
                                parts=parts2, mesh=mesh,
                                exchange=structured.make_exchange(
                                    topo, n, **kw),
-                               fault_plan=spec.compile(), nemesis=nem)
+                               fault_plan=spec.compile(), nemesis=nem,
+                               srv_ledger=False)
             s2, r2 = sim.run(inject, max_rounds=200)
             assert r1 == r2, (topo, shards)
             assert (ref.received_node_major(s1)
@@ -716,7 +721,8 @@ def test_structured_nemesis_sharded_fused_donated_parity():
                             parts=parts3, mesh=mesh2,
                             exchange=structured.make_exchange(
                                 topo, n, **kw),
-                            fault_plan=spec.compile(), nemesis=nem2)
+                            fault_plan=spec.compile(), nemesis=nem2,
+                            srv_ledger=False)
         s5, r5 = sim2.run(inject, max_rounds=200)
         assert r5 == r1 and int(s5.msgs) == int(s1.msgs), topo
         assert (ref.received_node_major(s1)
@@ -761,7 +767,7 @@ def test_structured_nemesis_seed_replay_determinism():
                              dup_rate=0.1, dup_until=12)
         sim = BroadcastSim(nbrs, n_values=nv, sync_every=4,
                            exchange=structured.make_exchange("tree", n),
-                           fault_plan=spec.compile(),
+                           fault_plan=spec.compile(), srv_ledger=False,
                            nemesis=structured.make_nemesis(
                                "tree", n, spec))
         s, r = sim.run(inject, max_rounds=200)
@@ -875,7 +881,8 @@ def test_dup_under_per_edge_delays_is_ledger_visible_only():
                           delays=delays,
                           fault_plan=no_dup.compile()).run(inject)
     sim2 = BroadcastSim(nbrs, n_values=nv, sync_every=4, delays=delays,
-                        fault_plan=with_dup.compile())
+                        fault_plan=with_dup.compile(),
+                        srv_ledger=False)
     s2, r2 = sim2.run(inject)
     assert r1 == r2
     assert (np.asarray(s1.received) == np.asarray(s2.received)).all()
@@ -918,7 +925,8 @@ def test_checkpoint_mid_fault_window_resumes_bit_exact(tmp_path):
 
     def fresh():
         return BroadcastSim(nbrs, n_values=nv, sync_every=4,
-                            fault_plan=SPEC.compile())
+                            fault_plan=SPEC.compile(),
+                            srv_ledger=False)
 
     # uninterrupted faulted run
     sim = fresh()
@@ -940,7 +948,8 @@ def test_checkpoint_mid_fault_window_resumes_bit_exact(tmp_path):
     spec_back = checkpoint.fault_spec_from_meta(meta)
     assert spec_back == SPEC and meta["round"] == 5
     sim_b = BroadcastSim(nbrs, n_values=nv, sync_every=4,
-                         fault_plan=spec_back.compile())
+                         fault_plan=spec_back.compile(),
+                         srv_ledger=False)
     for _ in range(14 - 5):
         restored = sim_b.step(restored)
     for f in ("received", "frontier", "t", "msgs"):

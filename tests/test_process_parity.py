@@ -348,7 +348,23 @@ def test_sync_waves_process_vs_virtual_vs_analytic():
     snap_v, r24_v = _sync_wave_scenario_virtual()
     assert r24_v == list(range(11))          # the hole was repaired
     assert snap_v == SYNC_WAVE_EXPECT
-    snap_p, r24_p = _sync_wave_scenario_process()
+    # the process scenario's wall-clock preconditions ("scenario
+    # precondition: ... machine too loaded") are environmental, not
+    # correctness claims — 25 interpreter spawns can exceed the wave
+    # budget on a saturated single-core CI box.  Retry those; any
+    # other failure is real and stays fatal.
+    last = None
+    for _ in range(3):
+        try:
+            snap_p, r24_p = _sync_wave_scenario_process()
+            break
+        except AssertionError as e:
+            if "scenario precondition" not in str(e):
+                raise
+            last = e
+    else:
+        pytest.skip(f"machine too loaded for the 25-process "
+                    f"wall-clock scenario: {last}")
     assert r24_p == list(range(11))
     assert snap_p == snap_v == SYNC_WAVE_EXPECT
 
