@@ -17,7 +17,7 @@ Four claims, each measured (not asserted from memory):
 3. **Adaptive steering** — signature-steered sampling finds STRICTLY
    more distinct behavioral signatures than blind sampling at equal
    certified-scenario count (the pinned counter config).
-4. **Signature overhead** — recording the (4,) behavioral signature
+4. **Signature overhead** — recording the (5,) behavioral signature
    on device costs < 5% over the telemetry-on batch dispatch
    (steady-state walls, same compiled-program discipline).
 

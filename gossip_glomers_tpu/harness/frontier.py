@@ -11,7 +11,7 @@ name grid coordinates), and writes a one-file flight bundle for every
 failing cell (harness/observe.py, ``kind="serving"`` — the bundle
 replays to the same SLO failure from its JSON alone).
 
-The coverage observatory rides the same dispatch: each cell's (4,)
+The coverage observatory rides the same dispatch: each cell's (5,)
 behavioral signature (stall-round bucket, progress-depth bucket,
 backpressure class, recovery bucket — computed ON DEVICE from the
 telemetry ring, tpu_sim/scenario.py ``signature_eval``) lands in a
@@ -49,11 +49,11 @@ HOST_SIDE = (
     "_fault_level_spec", "_chunk_cells", "_cell_bundle")
 
 SIG_FIELDS = ("stall_bucket", "depth_bucket", "bp_class",
-              "recovery_bucket")
+              "recovery_bucket", "churn_bucket")
 
 
 def signature_key(sig) -> tuple:
-    """Canonical hashable form of one (4,) behavioral signature."""
+    """Canonical hashable form of one (5,) behavioral signature."""
     arr = np.asarray(sig).reshape(-1)
     if arr.shape[0] != len(SIG_FIELDS):
         raise ValueError(
@@ -64,7 +64,7 @@ def signature_key(sig) -> tuple:
 
 class CoverageMap:
     """Host-side behavioral coverage over signature space: dedupes
-    the (4,) signatures a campaign produced, remembers the first cell
+    the (5,) signatures a campaign produced, remembers the first cell
     that exhibited each distinct behavior, and tracks per-AXIS-cell
     behavior counts (axis = the sampled fault-grid cell a scenario
     came from) — the adaptive fuzzer's steering signal.  Pure dict

@@ -5,9 +5,10 @@ repros for every failure.
 The fuzzer closes ROADMAP item 2 end to end:
 
 1. **sample** — :func:`sample_scenarios` draws scenario cells from a
-   SEEDED generator over the five fault axes (crash windows, loss
-   rate, dup rate, partition windows, per-edge delays — the last two
-   broadcast-only), each cell a JSON-able
+   SEEDED generator over the six fault axes (crash windows, loss
+   rate, dup rate, partition windows, per-edge delays — those two
+   broadcast-only — and membership churn: joins, leaves, and
+   resize-shaped block churn, PR 17), each cell a JSON-able
    :class:`~..tpu_sim.scenario.Scenario`;
 2. **dispatch** — :func:`fuzz_run` packs them into
    :class:`~..tpu_sim.scenario.ScenarioBatch`es and certifies each
@@ -56,7 +57,8 @@ from ..tpu_sim.faults import NemesisSpec, random_spec
 # tests/test_scenario.py pins the split TOTAL.
 TRACED_EVALUATORS: tuple = ()
 HOST_SIDE = (
-    "_sample_partition", "sample_scenarios", "planted_failure",
+    "_sample_partition", "_sample_membership", "sample_scenarios",
+    "planted_failure",
     "_canon_lost", "failure_signature", "scenario_weight",
     "run_sequential", "_shrink_moves", "_components",
     "shrink_scenario", "_pow2", "_axis_key", "fuzz_run",
@@ -96,7 +98,11 @@ def _axis_key(sc: "SC.Scenario") -> tuple:
             0 if sc.delays is None
             else max(v for row in sc.delays for v in row),
             min(starts) // 2 if starts else -1,
-            sum(len(ns) for _s, _e, ns in spec.crash))
+            sum(len(ns) for _s, _e, ns in spec.crash),
+            # membership churn shape (PR 17): joined/left node counts
+            # — the steering axis behind the signature's churn bucket
+            sum(len(ns) for _r, ns in spec.join),
+            sum(len(ns) for _r, ns in spec.leave))
 
 
 # -- sampling ------------------------------------------------------------
@@ -114,19 +120,83 @@ def _sample_partition(rng, n_nodes: int, horizon: int) -> dict:
             "group": [group.astype(int).tolist()]}
 
 
+def _sample_membership(rng, spec: NemesisSpec,
+                       horizon: int) -> NemesisSpec:
+    """Draw this cell's membership churn (PR 17): scattered joins and
+    leaves on non-crash rows, or a resize-shaped BLOCK (a contiguous
+    row block joining or leaving at one round — the in-place form of
+    an elastic grow/shrink, often crossing an active crash window).
+    Roughly a third of cells stay churn-free so the no-membership
+    fast path keeps getting fuzzed too.
+
+    Leaves land ``n_nodes + 2`` rounds past the spec's fault clear: a
+    leave is permanent, so the workload's anti-entropy must have
+    replicated the row's uniquely-held acked state first — the fuzz
+    grid measures recovery under churn, not the guaranteed
+    ack-before-replication loss (the same convention as the counter
+    crash-window shift above; tests plant early leaves deliberately
+    to watch the checker name the loss)."""
+    n = spec.n_nodes
+    crash_rows = {i for _s, _e, ns in spec.crash for i in ns}
+    free = [i for i in range(n) if i not in crash_rows]
+    shape = rng.random()
+    base_clear = spec.clear_round
+    leave_at = base_clear + n + 2 + int(rng.integers(0, 3))
+    join: tuple = ()
+    leave: tuple = ()
+    if shape < 0.2 and len(free) >= 2:
+        # scattered churn: 1-2 joiners early, 0-1 leaver late
+        k = int(rng.integers(1, 3))
+        rows = [int(i) for i in rng.choice(free, size=min(k + 1,
+                                                          len(free)),
+                                           replace=False)]
+        jr = int(rng.integers(1, max(2, 3 * horizon // 4) + 1))
+        join = ((jr, tuple(sorted(rows[:k]))),)
+        if len(rows) > k and rng.random() < 0.5:
+            leave = ((leave_at, (rows[k],)),)
+    elif shape < 0.4 and len(free) >= 4:
+        # resize-shaped block churn: a contiguous block of the padded
+        # axis joins (grow) or leaves (shrink) at ONE round — the
+        # crash windows the generator placed keep running across it
+        blk = int(rng.integers(2, max(3, len(free) // 2) + 1))
+        rows = tuple(sorted(free))[-blk:]
+        if rng.random() < 0.5:
+            jr = int(rng.integers(1, max(2, 3 * horizon // 4) + 1))
+            join = ((jr, rows),)
+        else:
+            leave = ((leave_at, rows),)
+    else:
+        return spec
+    meta = spec.to_meta()
+    meta["join"] = [[r, list(ns)] for r, ns in join]
+    meta["leave"] = [[r, list(ns)] for r, ns in leave]
+    return NemesisSpec.from_meta(meta)
+
+
 def sample_scenarios(workload: str, n_scenarios: int, *,
                      n_nodes: int, seed: int, horizon: int,
                      nbrs_shape=None, delay_axis: bool = False,
-                     partition_axis: bool = True) -> list:
+                     partition_axis: bool = True,
+                     membership_axis: bool = False) -> list:
     """Seeded scenario cells over the fault-space grid.  Scenario
     ``i``'s spec seed is ``seed * 100003 + i`` — distinct seeds,
     bit-replayable.  ``delay_axis`` samples per-edge delays over
     ``DELAY_CLASSES`` for EVERY cell (batches must be homogeneous in
     the delay dimension — the delays-on round carries a history
     ring); ``nbrs_shape`` is the (N, D) adjacency shape the delay
-    matrix must match."""
+    matrix must match; ``membership_axis`` additionally draws join /
+    leave / resize-shaped block churn per cell
+    (:func:`_sample_membership` — stateful workloads only: the txn
+    runner has no membership-aware liveness gate yet and rejects
+    membership-bearing plans loudly)."""
     if delay_axis and nbrs_shape is None:
         raise ValueError("delay_axis sampling needs nbrs_shape")
+    if membership_axis and workload == "txn":
+        raise ValueError(
+            "membership churn is not wired for the txn workload: its "
+            "wound-or-die CAS rows re-home on resize and the runner "
+            "has no membership-aware liveness gate — fuzz txn at "
+            "fixed membership")
     out = []
     for i in range(n_scenarios):
         cell_seed = seed * 100003 + i
@@ -161,6 +231,10 @@ def sample_scenarios(workload: str, n_scenarios: int, *,
             if spec.dup_rate:
                 meta["dup_until"] += shift
             spec = NemesisSpec.from_meta(meta)
+        if membership_axis:
+            # after the counter shift: the leave margin is computed
+            # from the (shifted) fault clear round
+            spec = _sample_membership(rng, spec, horizon)
         parts = None
         delays = None
         if workload == "broadcast":
@@ -253,6 +327,10 @@ def scenario_weight(sc: SC.Scenario) -> int:
         w += len(sc.parts["starts"])
     if sc.delays is not None:
         w += int(sum(1 for row in sc.delays for v in row if v != 1))
+    for _r, nodes in spec.join:
+        w += 2 + len(nodes)
+    for _r, nodes in spec.leave:
+        w += 2 + len(nodes)
     return w
 
 
@@ -360,6 +438,24 @@ def _shrink_moves(sc: SC.Scenario):
             m2 = dict(meta)
             m2[rate_key] = meta[rate_key] / 2
             yield f"halve {rate_key}", with_spec(m2)
+    # drop whole membership events (PR 17) — a node left join-only or
+    # leave-only stays a valid spec (a founding node may leave; a
+    # joined node may stay forever)
+    for key in ("join", "leave"):
+        for i in range(len(meta[key])):
+            m = dict(meta)
+            m[key] = [e for j, e in enumerate(meta[key]) if j != i]
+            yield f"drop {key} event {i}", with_spec(m)
+    # halve resize-shaped block deltas: keep the event, shed half its
+    # rows — the membership mirror of the crash-window node drops
+    for key in ("join", "leave"):
+        for i, (r, nodes) in enumerate(meta[key]):
+            if len(nodes) <= 1:
+                continue
+            m = dict(meta)
+            m[key] = [list(e) for e in meta[key]]
+            m[key][i] = [r, list(nodes)[:max(1, len(nodes) // 2)]]
+            yield f"halve {key} event {i} block", with_spec(m)
     # drop partition windows
     if sc.parts is not None:
         n_w = len(sc.parts["starts"])
@@ -505,6 +601,7 @@ def fuzz_run(workload: str = "broadcast", n_scenarios: int = 256, *,
              horizon: int = 8, max_recovery_rounds: int = 32,
              seed: int = 0, mesh=None, runner_kw: dict | None = None,
              delay_axis: str = "alternate",
+             membership_axis: bool = False,
              plant_failure: bool = False,
              shrink: bool = True, max_shrinks: int | None = None,
              observe_dir: str | None = None,
@@ -522,9 +619,13 @@ def fuzz_run(workload: str = "broadcast", n_scenarios: int = 256, *,
 
     ``delay_axis`` (broadcast): ``"alternate"`` — every other batch
     samples per-edge delays (batches are homogeneous in the delay
-    dimension); ``"on"`` / ``"off"`` force it.  ``plant_failure``
-    prepends :func:`planted_failure` (a provably failing cell) —
-    the CI smoke's end-to-end shrink probe.
+    dimension); ``"on"`` / ``"off"`` force it.  ``membership_axis``
+    (PR 17) draws join/leave/resize-block churn per cell; with
+    ``adapt=True`` the signature's fifth field (the churn bucket)
+    steers the budget toward axis cells still producing novel churn
+    behaviors.  ``plant_failure`` prepends :func:`planted_failure`
+    (a provably failing cell) — the CI smoke's end-to-end shrink
+    probe.
 
     PR 13 knobs (all default OFF — the PR-10 behavior is pinned):
 
@@ -536,7 +637,7 @@ def fuzz_run(workload: str = "broadcast", n_scenarios: int = 256, *,
     - ``pipeline``: depth-2 async dispatch — batch ``i+1`` is staged
       and enqueued while the host certifies batch ``i``'s results
       (verdicts pinned identical to the sync path);
-    - ``signatures``: record each scenario's on-device (4,)
+    - ``signatures``: record each scenario's on-device (5,)
       behavioral signature and fold the campaign into a
       :class:`~.frontier.CoverageMap` (``result["coverage"]``);
     - ``adapt``: coverage-steered sampling (implies ``signatures``;
@@ -602,7 +703,8 @@ def fuzz_run(workload: str = "broadcast", n_scenarios: int = 256, *,
             cells = sample_scenarios(
                 workload, counts[b], n_nodes=n_nodes,
                 seed=seed * 1000 + b, horizon=horizon,
-                nbrs_shape=nbrs_shape, delay_axis=delays_flags[b])
+                nbrs_shape=nbrs_shape, delay_axis=delays_flags[b],
+                membership_axis=membership_axis)
             if plant_failure and b == 0:
                 cells = _plant(cells, delays_flags[b])
             batches[b] = _mk_batch(cells)
@@ -694,7 +796,8 @@ def fuzz_run(workload: str = "broadcast", n_scenarios: int = 256, *,
                 workload, counts[b] * max(1, adapt_oversample),
                 n_nodes=n_nodes, seed=seed * 1000 + b,
                 horizon=horizon, nbrs_shape=nbrs_shape,
-                delay_axis=delays_flags[b])
+                delay_axis=delays_flags[b],
+                membership_axis=membership_axis)
             axes = [_axis_key(sc) for sc in cands]
             # greedy: highest coverage novelty first, discounting
             # axis cells already taken THIS batch (ties break on

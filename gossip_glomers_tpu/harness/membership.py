@@ -1,0 +1,418 @@
+"""Certified elastic-resize campaigns (PR 17): run a workload at
+capacity N, checkpoint mid-flight (the fault spec rides the meta —
+tpu_sim/checkpoint.py), restore into a LARGER or SMALLER padded node
+axis (tpu_sim/membership.py), continue to convergence, and certify the
+whole trajectory with :func:`~.checkers.check_recovery` — zero lost
+acked writes across the resize boundary, bounded recovery after it.
+
+The certification is anchored by the **straight-through twin**: the
+resize boundary, re-expressed as an ordinary membership event at FIXED
+capacity.  For a grow the twin runs the continuation spec
+(:func:`~..tpu_sim.membership.resize_spec` — rows ``[N, N')`` join at
+the boundary round) at N' from round 0; for a shrink the twin runs the
+ORIGINAL spec at N straight through (the dropped rows are already
+non-members at the boundary, so they simply never come back).  For
+capacity-independent dynamics the checkpoint-restore run and its twin
+are **bit-exact** on the first min(N, N') rows at every round — pinned
+here at the resized run's final round:
+
+- **broadcast** on the ``full`` topology only: every per-edge fault
+  coin hashes the global ``(t, src, dst)`` ids
+  (:func:`~..tpu_sim.faults.edge_drop`), and the full topology is the
+  one whose edge SET between surviving rows does not depend on the
+  padded capacity (a grid re-wires its rows when N changes — the twin
+  would diverge for topology reasons, not resize bugs).
+- **counter**: all cross-row coupling goes through the shared KV cell,
+  and non-member rows never contend for it.
+- **kafka** is certified-only (no bit-exact twin): op staging draws
+  ``rng.random(n)`` per round, so the host rng stream itself depends
+  on the padded capacity.  The continuation phase stages fresh ops
+  under ``workload_seed + 1`` with values offset by 1_000_000 —
+  globally unique across the boundary, so the zero-lost-acked-writes
+  check spans both phases.
+
+Re-homing: when ``kv_keys`` is set the campaign also emits the
+deterministic moved-key diff of the PR-14 stateless-hash KV routing
+(:func:`~..tpu_sim.membership.rehomed_keys`, host) and verifies it
+against the device twin (:func:`~..tpu_sim.membership.rehomed_mask`)
+plus an :func:`~..tpu_sim.membership.apply_rehoming` carry roundtrip —
+a mismatch fails the campaign.
+
+Pure host campaign driving, same as harness/nemesis.py — the declared
+traced tuple is empty (lint contract, tests/test_membership.py).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+from ..parallel.topology import full, to_padded_neighbors
+from ..tpu_sim import checkpoint, kvstore
+from ..tpu_sim import membership as M
+from ..tpu_sim.broadcast import BroadcastSim, BroadcastState, make_inject
+from ..tpu_sim.counter import CounterSim, CounterState
+from ..tpu_sim.faults import NemesisSpec
+from ..tpu_sim.kafka import KafkaSim, KafkaState
+from .checkers import check_recovery
+from .nemesis import stage_kafka_ops
+
+TRACED_EVALUATORS = ()
+HOST_SIDE = ("run_resize_campaign", "_certify", "_rehoming_details",
+             "_resize_broadcast", "_resize_counter", "_resize_kafka")
+
+
+def _certify(*, clear: int, converged_round, max_recovery_rounds: int,
+             lost, msgs_at_clear: int, msgs_at_converged: int) -> tuple:
+    return check_recovery(
+        clear_round=clear, converged_round=converged_round,
+        max_recovery_rounds=max_recovery_rounds, lost_writes=lost,
+        msgs_at_clear=msgs_at_clear,
+        msgs_at_converged=msgs_at_converged)
+
+
+def _rehoming_details(n_keys: int, n_from: int, n_to: int) -> dict:
+    """Emit + verify the resize's moved-key diff: host routing twin vs
+    device-observed mask, then an apply_rehoming carry roundtrip (every
+    key's (value, version) register survives at its new home)."""
+    moved_host = M.rehomed_keys(n_keys, n_from, n_to)
+    moved_dev = np.nonzero(np.asarray(
+        M.rehomed_mask(n_keys, n_from, n_to)))[0]
+    diff_match = bool(np.array_equal(moved_host, moved_dev))
+    lo = kvstore.make_layout(n_keys, n_from)
+    ln = kvstore.make_layout(n_keys, n_to)
+    vals = np.zeros((n_from, lo.cap), np.int32)
+    vers = np.zeros((n_from, lo.cap), np.int32)
+    keys = np.arange(n_keys)
+    vals[lo.owner, lo.slot] = keys * 3 + 1
+    vers[lo.owner, lo.slot] = keys % 7
+    import jax.numpy as jnp
+    rows2 = M.apply_rehoming(
+        kvstore.KVRows(jnp.asarray(vals), jnp.asarray(vers)), lo, ln)
+    nv = np.asarray(rows2.vals)
+    nr = np.asarray(rows2.vers)
+    carry_ok = bool(
+        np.array_equal(nv[ln.owner, ln.slot], keys * 3 + 1)
+        and np.array_equal(nr[ln.owner, ln.slot], keys % 7))
+    return {"n_keys": n_keys, "n_moved": int(moved_host.size),
+            "moved_keys": [int(k) for k in moved_host],
+            "diff_match": diff_match, "carry_ok": carry_ok,
+            "ok": diff_match and carry_ok}
+
+
+def run_resize_campaign(workload: str, spec: NemesisSpec, n_to: int,
+                        resize_round: int, *,
+                        checkpoint_dir: str | None = None,
+                        n_values: int | None = None,
+                        sync_every: int = 4,
+                        topology: str = "full",
+                        deltas: np.ndarray | None = None,
+                        mode: str = "cas", poll_every: int = 2,
+                        n_keys: int = 4, capacity: int = 64,
+                        max_sends: int = 2, resync_every: int = 4,
+                        workload_seed: int = 0, send_prob: float = 0.7,
+                        max_recovery_rounds: int = 96,
+                        twin: bool = True,
+                        kv_keys: int | None = None) -> dict:
+    """One certified elastic resize: ``spec`` at ``spec.n_nodes``
+    through round ``resize_round``, checkpoint, restore at ``n_to``
+    (grow or shrink — :func:`~..tpu_sim.membership.restore_resized`
+    validates shrink safety and builds the continuation spec), run to
+    convergence, certify zero lost acked writes — and for broadcast /
+    counter pin the restored run bit-exact against its
+    straight-through twin at the final round (``twin=False`` skips the
+    twin, e.g. when the campaign composes faults the twin would double
+    the cost of).  ``kv_keys`` additionally emits + verifies the KV
+    re-homing diff (module docstring).  Returns the certification
+    details dict (``ok`` ANDs every verdict)."""
+    runners = {"broadcast": _resize_broadcast,
+               "counter": _resize_counter,
+               "kafka": _resize_kafka}
+    if workload not in runners:
+        raise ValueError(
+            f"resize campaigns support {sorted(runners)}; {workload!r} "
+            "is not wired: the txn workload's wound-or-die CAS rows "
+            "re-home on resize (the device KV registers move nodes) "
+            "and its runner has no membership-aware liveness gate yet "
+            "— run txn churn at fixed capacity")
+    # validate EARLY (shrink safety, capacity sanity) so a doomed
+    # campaign fails before any device work
+    spec2 = M.resize_spec(spec, n_to, resize_round)
+    clear = max(spec2.clear_round, spec.clear_round, resize_round)
+    tmp = None
+    if checkpoint_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="gg_resize_")
+        checkpoint_dir = tmp.name
+    try:
+        path = os.path.join(
+            checkpoint_dir,
+            f"resize_{workload}_{spec.n_nodes}to{n_to}.npz")
+        kw = dict(n_values=n_values, sync_every=sync_every,
+                  topology=topology, deltas=deltas, mode=mode,
+                  poll_every=poll_every, n_keys=n_keys,
+                  capacity=capacity, max_sends=max_sends,
+                  resync_every=resync_every,
+                  workload_seed=workload_seed, send_prob=send_prob)
+        ok, details = runners[workload](
+            spec, n_to, resize_round, clear, path,
+            max_recovery_rounds=max_recovery_rounds, twin=twin, **kw)
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+    if kv_keys is not None:
+        rh = _rehoming_details(kv_keys, spec.n_nodes, n_to)
+        details["rehoming"] = rh
+        ok = ok and rh["ok"]
+    details.update(workload=workload, n_from=spec.n_nodes, n_to=n_to,
+                   resize_round=resize_round, spec=spec.to_meta(),
+                   continuation_spec=spec2.to_meta())
+    return {"ok": ok, **details}
+
+
+def _resize_broadcast(spec, n_to, resize_round, clear, path, *,
+                      max_recovery_rounds, twin, n_values, sync_every,
+                      topology, **_unused):
+    if topology != "full":
+        raise ValueError(
+            f"broadcast resize campaigns run on topology 'full' only, "
+            f"got {topology!r}: every full-topology edge coin hashes "
+            "the global (t, src, dst) ids, so the edge set between "
+            "surviving rows is capacity-independent — a grid/tree "
+            "re-wires its rows when N changes and the straight-"
+            "through twin would diverge for topology reasons")
+    n = spec.n_nodes
+    nv = n_values if n_values is not None else 2 * n
+    inject = make_inject(n, nv)
+    # acked where INJECTED: founding-masked, at the ORIGINAL capacity
+    # — the target is NEVER re-derived at n_to
+    inject = np.where(spec.host_members(0)[:, None], inject,
+                      0).astype(inject.dtype)
+    sim_a = BroadcastSim(to_padded_neighbors(full(n)), n_values=nv,
+                         sync_every=sync_every,
+                         fault_plan=spec.compile(), srv_ledger=False)
+    target = np.asarray(sim_a.target_bits(inject))
+    state, _tgt = sim_a.stage(inject)
+    if resize_round > 0:
+        state = sim_a.run_staged_fixed(state, resize_round)
+    checkpoint.save(path, state, meta={"workload": "broadcast",
+                                       "n_values": nv},
+                    fault_spec=spec)
+    state, spec2, _meta = M.restore_resized(path, BroadcastState, n_to)
+    sim_b = BroadcastSim(to_padded_neighbors(full(n_to)), n_values=nv,
+                         sync_every=sync_every,
+                         fault_plan=spec2.compile(), srv_ledger=False)
+    if clear > resize_round:
+        state = sim_b.run_staged_fixed(state, clear - resize_round)
+    msgs_at_clear = int(state.msgs)
+    members_c = spec2.host_members(clear)
+
+    def conv(s) -> bool:
+        rec_now = sim_b.received_node_major(s)
+        return bool(np.all((rec_now == target[None, :])
+                           | ~members_c[:, None]))
+
+    converged_round = clear if conv(state) else None
+    while converged_round is None \
+            and int(state.t) < clear + max_recovery_rounds:
+        state = sim_b.step(state)
+        if conv(state):
+            converged_round = int(state.t)
+    rec = sim_b.received_node_major(state)
+    anywhere = np.bitwise_or.reduce(
+        np.where(members_c[:, None], rec, 0), axis=0)
+    lost = [v for v in range(nv)
+            if ((target[v // 32] >> (v % 32)) & 1)
+            and not (anywhere[v // 32] >> (v % 32)) & 1]
+    ok, details = _certify(
+        clear=clear, converged_round=converged_round,
+        max_recovery_rounds=max_recovery_rounds, lost=lost,
+        msgs_at_clear=msgs_at_clear,
+        msgs_at_converged=int(state.msgs))
+    details.update(n_values=nv, topology=topology)
+    if twin:
+        t_total = int(state.t)
+        grow = n_to > n
+        n_tw = n_to if grow else n
+        spec_tw = spec2 if grow else spec
+        inj_tw = inject
+        if grow:
+            inj_tw = np.concatenate(
+                [inject, np.zeros((n_to - n,) + inject.shape[1:],
+                                  inject.dtype)], axis=0)
+        sim_tw = BroadcastSim(to_padded_neighbors(full(n_tw)),
+                              n_values=nv, sync_every=sync_every,
+                              fault_plan=spec_tw.compile(),
+                              srv_ledger=False)
+        st_tw, _ = sim_tw.stage(inj_tw)
+        if t_total > 0:
+            st_tw = sim_tw.run_staged_fixed(st_tw, t_total)
+        rec_tw = sim_tw.received_node_major(st_tw)
+        m = n_to  # grow: full resized axis; shrink: surviving rows
+        match = (bool(np.array_equal(rec[:m], rec_tw[:m]))
+                 and bool(np.array_equal(
+                     np.asarray(state.frontier)[:m],
+                     np.asarray(st_tw.frontier)[:m])))
+        details["twin"] = {"rows_compared": m, "round": t_total,
+                           "shape": "grow" if grow else "shrink",
+                           "bit_exact": match}
+        ok = ok and match
+    return ok, details
+
+
+def _resize_counter(spec, n_to, resize_round, clear, path, *,
+                    max_recovery_rounds, twin, deltas, mode,
+                    poll_every, **_unused):
+    n = spec.n_nodes
+    if deltas is None:
+        deltas = np.arange(1, n + 1, dtype=np.int32)
+    # acked where STAGED: founding-masked at the original capacity;
+    # the acked sum is a CONSTANT across the boundary
+    deltas = np.where(spec.host_members(0), deltas,
+                      0).astype(np.asarray(deltas).dtype)
+    acked_sum = int(np.sum(deltas))
+    sim_a = CounterSim(n, mode=mode, poll_every=poll_every,
+                       fault_plan=spec.compile())
+    state = sim_a.add(sim_a.init_state(), deltas)
+    if resize_round > 0:
+        state = sim_a.run_fused(state, resize_round)
+    checkpoint.save(path, state, meta={"workload": "counter"},
+                    fault_spec=spec)
+    state, spec2, _meta = M.restore_resized(path, CounterState, n_to)
+    sim_b = CounterSim(n_to, mode=mode, poll_every=poll_every,
+                       fault_plan=spec2.compile())
+    if clear > resize_round:
+        state = sim_b.run_fused(state, clear - resize_round)
+    msgs_at_clear = int(state.msgs)
+    members_c = spec2.host_members(clear)
+
+    def conv(s) -> bool:
+        if int(np.sum(np.asarray(s.pending))) != 0:
+            return False  # non-member residue = a real undrained delta
+        reads_ok = np.asarray(sim_b.reads(s)) == sim_b.kv_value(s)
+        return bool(np.all(reads_ok | ~members_c))
+
+    converged_round = clear if conv(state) else None
+    while converged_round is None \
+            and int(state.t) < clear + max_recovery_rounds:
+        state = sim_b.step(state)
+        if conv(state):
+            converged_round = int(state.t)
+    shortfall = acked_sum - sim_b.kv_value(state) \
+        - int(np.sum(np.asarray(state.pending)))
+    lost = ([{"lost_sum": shortfall}] if shortfall != 0 else [])
+    ok, details = _certify(
+        clear=clear, converged_round=converged_round,
+        max_recovery_rounds=max_recovery_rounds, lost=lost,
+        msgs_at_clear=msgs_at_clear,
+        msgs_at_converged=int(state.msgs))
+    details.update(mode=mode, acked_sum=acked_sum,
+                   kv=sim_b.kv_value(state))
+    if twin:
+        t_total = int(state.t)
+        grow = n_to > n
+        n_tw = n_to if grow else n
+        spec_tw = spec2 if grow else spec
+        d_tw = deltas
+        if grow:
+            d_tw = np.concatenate(
+                [deltas, np.zeros(n_to - n, deltas.dtype)])
+        sim_tw = CounterSim(n_tw, mode=mode, poll_every=poll_every,
+                            fault_plan=spec_tw.compile())
+        st_tw = sim_tw.add(sim_tw.init_state(), d_tw)
+        if t_total > 0:
+            st_tw = sim_tw.run_fused(st_tw, t_total)
+        m = n_to
+        match = (bool(np.array_equal(np.asarray(state.pending)[:m],
+                                     np.asarray(st_tw.pending)[:m]))
+                 and bool(np.array_equal(
+                     np.asarray(state.cached)[:m],
+                     np.asarray(st_tw.cached)[:m]))
+                 and sim_b.kv_value(state) == sim_tw.kv_value(st_tw))
+        details["twin"] = {"rows_compared": m, "round": t_total,
+                           "shape": "grow" if grow else "shrink",
+                           "bit_exact": match}
+        ok = ok and match
+    return ok, details
+
+
+def _resize_kafka(spec, n_to, resize_round, clear, path, *,
+                  max_recovery_rounds, twin, n_keys, capacity,
+                  max_sends, resync_every, workload_seed, send_prob,
+                  **_unused):
+    n = spec.n_nodes
+    quiesce_a = (resync_every + 2) if spec.has_membership else 0
+    sks, svs, crs = stage_kafka_ops(
+        spec, resize_round, n_keys=n_keys, max_sends=max_sends,
+        workload_seed=workload_seed, send_prob=send_prob,
+        quiesce=quiesce_a)
+    sim_a = KafkaSim(n, n_keys, capacity=capacity,
+                     max_sends=max_sends, fault_plan=spec.compile(),
+                     resync_every=resync_every)
+    state = sim_a.init_state()
+    if resize_round > 0:
+        state = sim_a.run_fused(state, sks, svs, crs)
+    n_alloc_a = int((np.asarray(state.log_vals) >= 0).sum())
+    checkpoint.save(path, state, meta={"workload": "kafka",
+                                       "n_keys": n_keys},
+                    fault_spec=spec)
+    state, spec2, _meta = M.restore_resized(path, KafkaState, n_to)
+    sim_b = KafkaSim(n_to, n_keys, capacity=capacity,
+                     max_sends=max_sends, fault_plan=spec2.compile(),
+                     resync_every=resync_every)
+    # continuation ops: fresh rng stream (workload_seed + 1 — the
+    # capacity-dependent phase-A stream cannot be extended across the
+    # boundary), staged over ABSOLUTE rounds with spec2's liveness and
+    # sliced to the continuation window; values offset so acked slots
+    # stay globally unique across the boundary
+    quiesce_b = resync_every + 2  # spec2 always has membership
+    sks2, svs2, crs2 = stage_kafka_ops(
+        spec2, clear, n_keys=n_keys, max_sends=max_sends,
+        workload_seed=workload_seed + 1, send_prob=send_prob,
+        quiesce=quiesce_b)
+    sks2 = sks2[resize_round:]
+    svs2 = np.where(sks2 >= 0, svs2[resize_round:] + 1_000_000,
+                    svs2[resize_round:])
+    crs2 = crs2[resize_round:]
+    if sks2.shape[0] > 0:
+        state = sim_b.run_fused(state, sks2, svs2, crs2)
+    msgs_at_clear = int(state.msgs)
+    members_c = spec2.host_members(clear)
+
+    def conv(s) -> bool:
+        pres = np.asarray(s.present)
+        ref = int(np.argmax(members_c))
+        return bool(((pres == pres[ref:ref + 1])
+                     | ~members_c[:, None, None]).all())
+
+    converged_round = clear if conv(state) else None
+    while converged_round is None \
+            and int(state.t) < clear + max_recovery_rounds:
+        state = sim_b.step(state)
+        if conv(state):
+            converged_round = int(state.t)
+    pres = sim_b.present_bool(state)
+    allocated = np.asarray(state.log_vals) >= 0
+    anywhere = pres[members_c].any(axis=0)
+    lost = [(int(k), int(c) + 1)
+            for k, c in zip(*np.nonzero(allocated & ~anywhere))]
+    kv_val = np.asarray(state.kv_val)
+    lc = np.asarray(state.local_committed)
+    over = lc > np.where(kv_val > 0, kv_val, 0)[None, :]
+    lost += [{"committed_over_cell": (int(i), int(k))}
+             for i, k in zip(*np.nonzero(over))]
+    ok, details = _certify(
+        clear=clear, converged_round=converged_round,
+        max_recovery_rounds=max_recovery_rounds, lost=lost,
+        msgs_at_clear=msgs_at_clear,
+        msgs_at_converged=int(state.msgs))
+    details.update(n_keys=n_keys,
+                   n_allocated=int(allocated.sum()),
+                   n_allocated_pre_resize=n_alloc_a,
+                   twin={"bit_exact": None,
+                         "reason": "kafka is certified-only: op "
+                                   "staging draws rng.random(n) per "
+                                   "round, so the host rng stream "
+                                   "depends on the padded capacity"})
+    return ok, details

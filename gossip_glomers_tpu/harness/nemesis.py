@@ -253,7 +253,12 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
             max_recovery_rounds=max_recovery_rounds, sim_kw=sim_kw,
             telemetry=telemetry, observe_dir=observe_dir)
     if structured == "auto":
-        structured = (S.faulted_path_pick((nv + 31) // 32)
+        # membership events ride the gather path (the words-major
+        # mask decomposition has no per-row join/leave columns yet —
+        # structured.make_nemesis rejects them loudly); auto resolves
+        # away from it instead of tripping that rejection
+        structured = (False if spec.has_membership else
+                      S.faulted_path_pick((nv + 31) // 32)
                       == "structured")
     kw = {}
     if structured:
@@ -278,8 +283,17 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                        fault_plan=spec.compile(), srv_ledger=False,
                        mesh=mesh, **kw)
     inject = make_inject(n, nv)
+    if spec.has_membership:
+        # a value is acked where it is INJECTED: pre-join rows stage
+        # nothing (they are not members at round 0), so their
+        # round-robin values are never offered and the target shrinks
+        # accordingly — identical to the batch dispatcher's
+        # founding-masked staging
+        inject = np.where(spec.host_members(0)[:, None], inject,
+                          0).astype(inject.dtype)
     target = sim.target_bits(inject)
     clear = spec.clear_round
+    members_c = spec.host_members(clear)
     tel_spec = observe.telemetry_setup(
         telemetry, "broadcast", clear + max_recovery_rounds)
     tel = (sim.telemetry_state(tel_spec) if tel_spec is not None
@@ -302,7 +316,18 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                                  donate=True, prov=prov,
                                  prov_spec=prov_spec), tel, prov)
     msgs_at_clear = int(state.msgs)
-    converged_round = clear if sim.converged(state, target) else None
+
+    def conv_b(s) -> bool:
+        if not spec.has_membership:
+            return bool(sim.converged(s, target))
+        # only MEMBER rows must (or can) hold the target — a left
+        # row's wipe is permanent, a pre-join row held nothing (the
+        # host twin of broadcast._batch_converged's member mask)
+        rec_now = sim.received_node_major(s)
+        return bool(np.all((rec_now == np.asarray(target)[None, :])
+                           | ~members_c[:, None]))
+
+    converged_round = clear if conv_b(state) else None
     while converged_round is None \
             and int(state.t) < clear + max_recovery_rounds:
         if not obs_on:
@@ -311,12 +336,15 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
             state, tel, prov = _unpack_obs(
                 sim.run_observed(state, tel, tel_spec, 1, prov=prov,
                                  prov_spec=prov_spec), tel, prov)
-        if sim.converged(state, target):
+        if conv_b(state):
             converged_round = int(state.t)
     rec = sim.received_node_major(state)
-    anywhere = np.bitwise_or.reduce(rec, axis=0)
+    anywhere = np.bitwise_or.reduce(
+        np.where(members_c[:, None], rec, 0), axis=0)
+    target_np = np.asarray(target)
     lost = [v for v in range(nv)
-            if not (anywhere[v // 32] >> (v % 32)) & 1]
+            if ((target_np[v // 32] >> (v % 32)) & 1)
+            and not (anywhere[v // 32] >> (v % 32)) & 1]
     ok, details = check_recovery(
         clear_round=clear, converged_round=converged_round,
         max_recovery_rounds=max_recovery_rounds, lost_writes=lost,
@@ -386,12 +414,19 @@ def run_counter_nemesis(spec: NemesisSpec, *,
     n = spec.n_nodes
     if deltas is None:
         deltas = np.arange(1, n + 1, dtype=np.int32)
+    if spec.has_membership:
+        # deltas are acked where they are STAGED: pre-join rows stage
+        # nothing, so the acked sum is the founding rows' deltas —
+        # identical to the batch dispatcher's founding-masked staging
+        deltas = np.where(spec.host_members(0), deltas,
+                          0).astype(np.asarray(deltas).dtype)
     acked_sum = int(np.sum(deltas))
     sim = CounterSim(n, mode=mode, poll_every=poll_every,
                      fault_plan=spec.compile(),
                      union_block=union_block, mesh=mesh)
     state = sim.add(sim.init_state(), deltas)
     clear = spec.clear_round
+    members_c = spec.host_members(clear)
     tel_spec = observe.telemetry_setup(
         telemetry, "counter", clear + max_recovery_rounds)
     tel = (sim.telemetry_state(tel_spec) if tel_spec is not None
@@ -411,8 +446,14 @@ def run_counter_nemesis(spec: NemesisSpec, *,
     msgs_at_clear = int(state.msgs)
 
     def converged(s) -> bool:
-        return (int(np.sum(np.asarray(s.pending))) == 0
-                and bool(np.all(sim.reads(s) == sim.kv_value(s))))
+        if int(np.sum(np.asarray(s.pending))) != 0:
+            return False
+        reads_ok = np.asarray(sim.reads(s)) == sim.kv_value(s)
+        # only MEMBER rows must re-poll to the KV value (the host
+        # twin of counter._batch_converged's member mask); pending
+        # stays summed over ALL rows — non-member residue would be a
+        # real undrained delta
+        return bool(np.all(reads_ok | ~members_c))
 
     converged_round = clear if converged(state) else None
     while converged_round is None \
@@ -455,7 +496,7 @@ def run_counter_nemesis(spec: NemesisSpec, *,
 def stage_kafka_ops(spec: NemesisSpec, rounds: int, *, n_keys: int,
                     max_sends: int, send_prob: float = 0.7,
                     commit_prob: float = 0.2, workload_seed: int = 0,
-                    commits: bool = True,
+                    commits: bool = True, quiesce: int = 0,
                     ) -> "tuple[np.ndarray, np.ndarray, np.ndarray | None]":
     """Seeded (R, N, S) send batches + (R, N, K) commit requests for a
     nemesis campaign: ops are staged only at nodes that are UP that
@@ -463,15 +504,25 @@ def stage_kafka_ops(spec: NemesisSpec, rounds: int, *, n_keys: int,
     globally unique.  ``commits=False`` returns ``crs=None`` and
     stages the sends VECTORIZED — the large-N campaigns (the PR-5
     65k-node blocked-union row) skip both the O(R·N·K) commit-request
-    host array and the per-node python loop."""
+    host array and the per-node python loop.
+
+    ``quiesce`` (PR 17): a LEAVING node stops taking sends ``quiesce``
+    rounds before its leave round (graceful decommission — the drain
+    margin that lets the periodic resync replicate its last appends
+    before the row dies; the membership runners pass
+    ``resync_every + 2``).  With ``quiesce=0`` an append acked just
+    before the leave is provably lost — the checker names it.  The
+    rng call order does not depend on ``quiesce`` in the vectorized
+    path, so batch and sequential stagings stay bit-identical."""
     rng = np.random.default_rng(workload_seed)
     n, s = spec.n_nodes, max_sends
+    lr = spec._membership_rows()[1].astype(np.int64)
     sks = np.full((rounds, n, s), -1, np.int32)
     svs = np.zeros((rounds, n, s), np.int32)
     if not commits:
         vid = 0
         for t in range(rounds):
-            up = spec.host_up(t)
+            up = spec.host_up(t) & (t < lr - quiesce)
             send = (rng.random(n) < send_prob) & up
             k = rng.integers(0, n_keys, n).astype(np.int32)
             sks[t, :, 0] = np.where(send, k, -1)
@@ -482,7 +533,7 @@ def stage_kafka_ops(spec: NemesisSpec, rounds: int, *, n_keys: int,
     crs = np.full((rounds, n, n_keys), -1, np.int32)
     vid = 0
     for t in range(rounds):
-        up = spec.host_up(t)
+        up = spec.host_up(t) & (t < lr - quiesce)
         for i in range(n):
             if not up[i]:
                 continue
@@ -556,10 +607,15 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
             telemetry=telemetry, observe_dir=observe_dir)
     n = spec.n_nodes
     clear = max(spec.clear_round, rounds or 0)
+    members_c = spec.host_members(clear)
+    # leaving nodes drain for a resync period before they go — the
+    # same quiesce the batch dispatcher derives, so both stage the
+    # identical campaign (see stage_kafka_ops)
+    quiesce = (resync_every + 2) if spec.has_membership else 0
     sks, svs, crs = stage_kafka_ops(
         spec, clear, n_keys=n_keys, max_sends=max_sends,
         workload_seed=workload_seed, commits=commits,
-        send_prob=send_prob)
+        send_prob=send_prob, quiesce=quiesce)
     sim = KafkaSim(n, n_keys, capacity=capacity, max_sends=max_sends,
                    fault_plan=spec.compile(), resync_every=resync_every,
                    resync_mode=resync_mode, repl_fast=repl_fast,
@@ -585,7 +641,14 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
 
     def converged(s) -> bool:
         pres = np.asarray(s.present)
-        return bool((pres == pres[:1]).all())
+        if not spec.has_membership:
+            return bool((pres == pres[:1]).all())
+        # compare MEMBER rows against the first member (row 0 may
+        # have left) — the host twin of kafka._batch_converged's
+        # member mask
+        ref = int(np.argmax(members_c))
+        return bool(((pres == pres[ref:ref + 1])
+                     | ~members_c[:, None, None]).all())
 
     def step1(s, tl, pv):
         if tl is not None or pv is not None:
@@ -615,7 +678,7 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
 
     pres = sim.present_bool(state)
     allocated = np.asarray(state.log_vals) >= 0        # (K, C)
-    anywhere = pres.any(axis=0)
+    anywhere = pres[members_c].any(axis=0)
     lost = [(int(k), int(c) + 1)
             for k, c in zip(*np.nonzero(allocated & ~anywhere))]
     kv_val = np.asarray(state.kv_val)

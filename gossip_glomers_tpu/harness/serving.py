@@ -193,6 +193,14 @@ def run_serving(kind: str, tspec: "traffic.TrafficSpec", *,
     gets the flight-recorder repro bundle on any failure."""
     from . import observe
     from ..tpu_sim import telemetry as TM
+    if nemesis is not None and nemesis.has_membership:
+        raise ValueError(
+            "serving runs do not support membership events yet: the "
+            "open-loop traffic tracker has no join/leave-aware intake "
+            "gating, so a membership-bearing nemesis would issue ops "
+            "to non-member rows — run join/leave campaigns on the "
+            "closed-loop nemesis runners (harness.nemesis) or the "
+            "scenario batch path instead")
     if sim is None:
         sim, state = make_serving_sim(kind, tspec, nemesis=nemesis,
                                       mesh=mesh, **(sim_kw or {}))

@@ -451,12 +451,13 @@ def default_registry() -> list[ProgramContract]:
     stamp-carrying rows, PR 9; kvstore the sharded-rows CAS drivers
     and txn the wound-or-die transaction rounds, PR 14; dcn the
     hierarchical ICI x DCN re-audits with the host-crossing gather
-    gate, PR 15)."""
+    gate, PR 15; membership the census and resized-carry rows,
+    PR 17)."""
     from . import (broadcast, counter, dcn, kafka, kvstore,
-                   provenance, scenario, telemetry, txn)
+                   membership, provenance, scenario, telemetry, txn)
     out: list[ProgramContract] = []
     for mod in (broadcast, counter, kafka, telemetry, provenance,
-                scenario, kvstore, txn, dcn):
+                scenario, kvstore, txn, dcn, membership):
         out.extend(mod.audit_contracts())
     names = [c.name for c in out]
     if len(set(names)) != len(names):
@@ -549,6 +550,15 @@ def _fuzz_roots() -> str:
                             for n in fuzz.TRACED_EVALUATORS) + ")$")
 
 
+def _membership_roots() -> str:
+    # membership.py declares its split the same way (PR 17; totality
+    # pinned by tests/test_membership.py)
+    from . import membership
+    return ("^(" + "|".join(re.escape(n)
+                            for n in membership.TRACED_EVALUATORS)
+            + ")$")
+
+
 def _kvstore_roots() -> str:
     # kvstore.py declares its split the same way (PR 14; totality
     # pinned by tests/test_kvstore.py)
@@ -578,6 +588,16 @@ def _harness_txn_roots() -> str:
     return ("^(" + "|".join(re.escape(n)
                             for n in harness_txn.TRACED_EVALUATORS)
             + ")$")
+
+
+def _harness_membership_roots() -> str:
+    # harness/membership.py is PURE HOST campaign driving (PR 17) —
+    # same empty-traced-tuple contract as harness/fuzz.py; totality
+    # pinned by tests/test_membership.py.
+    from ..harness import membership as harness_membership
+    return ("^(" + "|".join(
+        re.escape(n)
+        for n in harness_membership.TRACED_EVALUATORS) + ")$")
 
 
 def _frontier_roots() -> str:
@@ -610,8 +630,10 @@ _TRACED_ROOTS: dict[str, str] = {
     "tpu_sim/provenance.py": _provenance_roots(),
     "tpu_sim/scenario.py": _scenario_roots(),
     "tpu_sim/kvstore.py": _kvstore_roots(),
+    "tpu_sim/membership.py": _membership_roots(),
     "tpu_sim/txn.py": _txn_roots(),
     "harness/txn.py": _harness_txn_roots(),
+    "harness/membership.py": _harness_membership_roots(),
     "harness/fuzz.py": _fuzz_roots(),
     "harness/frontier.py": _frontier_roots(),
     "tpu_sim/engine.py":

@@ -2998,11 +2998,17 @@ def _build_batch_round(nbrs, nbr_mask, *, sync_every: int,
     return rnd
 
 
-def _batch_converged(state: BroadcastState, target) -> jnp.ndarray:
+def _batch_converged(state: BroadcastState, target,
+                     member=None) -> jnp.ndarray:
     """() bool, traced — one scenario's convergence predicate (every
     node holds every target bit; node-major layout, the batch drivers
-    run the gather path)."""
-    return jnp.all(state.received == target[None, :])
+    run the gather path).  ``member`` ((N,) bool, PR 17) restricts the
+    check to MEMBER rows — a left row holds nothing and a pre-join
+    row held nothing, neither can (or must) converge."""
+    ok = state.received == target[None, :]
+    if member is None:
+        return jnp.all(ok)
+    return jnp.all(ok | ~member[:, None])
 
 
 # -- program contracts (tpu_sim/audit.py registry) -----------------------

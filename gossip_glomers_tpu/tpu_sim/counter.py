@@ -1003,12 +1003,19 @@ def _build_batch_round(sim: "CounterSim"):
     return rnd
 
 
-def _batch_converged(state: CounterState) -> jnp.ndarray:
+def _batch_converged(state: CounterState, member=None) -> jnp.ndarray:
     """() bool, traced — one scenario's convergence predicate: pending
     fully drained AND every node's cached read equals the KV (the
-    traced twin of run_counter_nemesis's host check)."""
-    return ((jnp.sum(state.pending) == 0)
-            & jnp.all(state.cached == state.kv))
+    traced twin of run_counter_nemesis's host check).  ``member``
+    ((N,) bool, PR 17) restricts the cached-read check to MEMBER rows
+    (a left row's wiped cache can never re-poll); pending stays
+    summed over ALL rows — a non-member row's pending is structurally
+    zero (join rows enter empty, leave rows are wiped), so any
+    residue is a real undrained delta."""
+    cached_ok = state.cached == state.kv
+    if member is not None:
+        cached_ok = cached_ok | ~member
+    return (jnp.sum(state.pending) == 0) & jnp.all(cached_ok)
 
 
 # -- program contracts (tpu_sim/audit.py registry) -----------------------

@@ -24,6 +24,18 @@ module closes the rest of the Maelstrom fault model the same way
   re-delivers every value its source ever flooded (the source's full
   ``received`` set) — the at-least-once duplicate stream that gossip
   dedup and CRDT merges must absorb.
+- **membership events** (PR 17): node *join* (a padded row enters
+  EMPTY at its join round — before it, the row is not a member at
+  all: no sends, no receives, no KV reach, and unlike a
+  restart-with-amnesia it was never up to begin with) and *permanent
+  leave* (liveness goes down at the leave round and STAYS down —
+  distinct from a crash window, which ends).  Membership compiles to
+  two (N,) per-row round columns (``join_round``/``leave_round``
+  with founding/never sentinels), folded into :func:`node_up` so
+  every existing liveness gate in every sim inherits the events with
+  zero call-site changes; :func:`amnesia` additionally fires at join
+  entry, so the join row is structurally wiped empty by the same sim
+  wipe calls that serve crash-restart.
 
 Everything compiles to a :class:`FaultPlan` of tiny arrays/scalars that
 rides through the fused drivers as ONE traced operand (never donated,
@@ -56,13 +68,14 @@ from .engine import windows_fold
 # the test fails, so the lint can never silently skip new traced
 # code here.
 TRACED_EVALUATORS = (
-    "node_up", "amnesia", "_mix32", "_edge_hash", "edge_drop",
-    "edge_dup", "coin_block", "kv_drop", "wm_up_cols", "wm_live_rows",
-    "wm_live_del", "wm_srv_rows")
+    "node_up", "amnesia", "member_at", "plan_churn", "_mix32",
+    "_edge_hash", "edge_drop", "edge_dup", "coin_block", "kv_drop",
+    "wm_up_cols", "wm_live_rows", "wm_live_del", "wm_srv_rows")
 HOST_SIDE = (
     "plan_specs", "wm_specs", "_rate_to_num", "random_spec",
-    "crash_down_rows", "_mix32_np", "host_node_up", "host_edge_drop",
-    "host_kv_ok", "pad_plan", "batch_plans")
+    "crash_down_rows", "_mix32_np", "host_node_up", "host_member_at",
+    "host_edge_drop", "host_kv_ok", "pad_plan", "batch_plans",
+    "_plan_window_shapes")
 
 # distinct stream salts: loss and dup draw independent coins from the
 # same (seed, t, src, dst) counter
@@ -71,6 +84,13 @@ _SALT_DUP = 0x85EBCA6B
 # the KV services are not a node row; their "edge" hashes use this as
 # the dst so node<->service loss draws its own stream
 KV_DST = 0x7FFFFFFF
+
+# membership sentinels: a FOUNDING row "joined" at int32 min (member
+# from before round 0), a row that never leaves "leaves" at int32 max.
+# With these defaults the membership fold in node_up is an all-true
+# mask — a membership-free plan evaluates bit-identically to PR 16.
+JOIN_FOUNDING = -(2**31)
+LEAVE_NEVER = 2**31 - 1
 
 
 class FaultPlan(NamedTuple):
@@ -89,12 +109,15 @@ class FaultPlan(NamedTuple):
     dup_num: jnp.ndarray   # () uint32 — dup iff hash < dup_num
     dup_until: jnp.ndarray   # () int32
     seed: jnp.ndarray      # () uint32 — the replay key
+    join_round: jnp.ndarray   # (N,) int32 — member from this round on
+    leave_round: jnp.ndarray  # (N,) int32 — member strictly before this
 
 
 def plan_specs() -> FaultPlan:
     """shard_map in_specs for a :class:`FaultPlan` operand: every leaf
     replicated (the masks are evaluated per shard on global ids)."""
-    return FaultPlan(P(), P(), P(None, None), P(), P(), P(), P(), P())
+    return FaultPlan(P(), P(), P(None, None), P(), P(), P(), P(), P(),
+                     P(None), P(None))
 
 
 def _rate_to_num(rate: float) -> np.uint32:
@@ -114,6 +137,17 @@ class NemesisSpec:
     default to the last crash-window end (so a pure-loss spec must set
     them explicitly).  ``clear_round`` is the first round with no fault
     active — the recovery certifier's t=0.
+
+    ``join``/``leave`` (PR 17): membership events as
+    ``((round, (node ids,)), ...)``.  A join row is NOT a member
+    before its round (it holds no state, sends nothing, stages
+    nothing) and enters EMPTY at it; a leave row is a member strictly
+    before its round and then gone for good.  Rounds must be >= 1
+    (round-0 members are the FOUNDING set), each node may join at
+    most once and leave at most once, and a node that does both must
+    leave after it joins.  A membership event is a fault event:
+    ``clear_round`` covers it, so recovery certification starts after
+    the last join/leave has landed.
     """
 
     n_nodes: int
@@ -123,6 +157,8 @@ class NemesisSpec:
     loss_until: int | None = None
     dup_rate: float = 0.0
     dup_until: int | None = None
+    join: tuple = field(default_factory=tuple)    # ((round, (i,..)),)
+    leave: tuple = field(default_factory=tuple)   # ((round, (i,..)),)
 
     def _until(self, explicit: int | None, rate: float) -> int:
         if explicit is not None:
@@ -140,10 +176,17 @@ class NemesisSpec:
     def clear_round(self) -> int:
         """First round at which every fault has cleared."""
         ends = [int(e) for _s, e, _ns in self.crash]
-        return max([0] + ends + [self._until(self.loss_until,
-                                             self.loss_rate),
-                                 self._until(self.dup_until,
-                                             self.dup_rate)])
+        mem = [int(r) for r, _ns in self.join + self.leave]
+        return max([0] + ends + mem
+                   + [self._until(self.loss_until, self.loss_rate),
+                      self._until(self.dup_until, self.dup_rate)])
+
+    @property
+    def has_membership(self) -> bool:
+        """True when the spec carries any join/leave event — the gate
+        the reject-loudly satellites and the membership-aware batch
+        dispatchers branch on."""
+        return bool(self.join or self.leave)
 
     def __post_init__(self) -> None:
         norm = []
@@ -157,18 +200,62 @@ class NemesisSpec:
                     raise ValueError(f"crash node {i} out of range")
             norm.append((int(start), int(end), nodes))
         object.__setattr__(self, "crash", tuple(norm))
+        for name in ("join", "leave"):
+            events, seen = [], set()
+            for r, nodes in getattr(self, name):
+                nodes = tuple(sorted(int(i) for i in nodes))
+                if int(r) < 1:
+                    raise ValueError(
+                        f"{name} round {r} must be >= 1 (round-0 "
+                        "members are the founding set)")
+                for i in nodes:
+                    if not 0 <= i < self.n_nodes:
+                        raise ValueError(
+                            f"{name} node {i} out of range")
+                    if i in seen:
+                        raise ValueError(
+                            f"node {i} appears in more than one "
+                            f"{name} event")
+                    seen.add(i)
+                events.append((int(r), nodes))
+            object.__setattr__(self, name, tuple(events))
+        jr, lr = self._membership_rows()
+        bad = np.nonzero(lr <= jr)[0]
+        if bad.size:
+            raise ValueError(
+                f"node {int(bad[0])} leaves at {int(lr[bad[0]])} but "
+                f"only joins at {int(jr[bad[0]])}")
         _rate_to_num(self.loss_rate)
         _rate_to_num(self.dup_rate)
         # validate that every active rate has a derivable horizon
         self._until(self.loss_until, self.loss_rate)
         self._until(self.dup_until, self.dup_rate)
 
+    def _membership_rows(self) -> tuple:
+        """(join_round, leave_round) (N,) int32 columns with the
+        founding/never sentinels — the compiled membership leaves."""
+        jr = np.full(self.n_nodes, JOIN_FOUNDING, np.int32)
+        lr = np.full(self.n_nodes, LEAVE_NEVER, np.int32)
+        for r, nodes in self.join:
+            jr[list(nodes)] = r
+        for r, nodes in self.leave:
+            lr[list(nodes)] = r
+        return jr, lr
+
     # -- host mirrors ----------------------------------------------------
+
+    def host_members(self, t: int) -> np.ndarray:
+        """(N,) bool — which rows are MEMBERS at round ``t`` (joined
+        at or before, not yet left).  Crash windows do not affect
+        membership: a crashed member is still a member."""
+        jr, lr = self._membership_rows()
+        return (jr <= t) & (t < lr)
 
     def host_up(self, t: int) -> np.ndarray:
         """(N,) bool — which nodes are up at round ``t`` (the host twin
-        of :func:`node_up`, for staging ops away from dead nodes)."""
-        up = np.ones(self.n_nodes, bool)
+        of :func:`node_up`, for staging ops away from dead nodes).
+        Membership folds in: a non-member row is never up."""
+        up = self.host_members(t)
         for start, end, nodes in self.crash:
             if start <= t < end:
                 up[list(nodes)] = False
@@ -184,6 +271,7 @@ class NemesisSpec:
         for w, (start, end, nodes) in enumerate(self.crash):
             starts[w], ends[w] = start, end
             down[w, list(nodes)] = True
+        jr, lr = self._membership_rows()
         return FaultPlan(
             starts=jnp.asarray(starts), ends=jnp.asarray(ends),
             down=jnp.asarray(down),
@@ -193,7 +281,8 @@ class NemesisSpec:
             dup_num=jnp.uint32(_rate_to_num(self.dup_rate)),
             dup_until=jnp.int32(self._until(self.dup_until,
                                             self.dup_rate)),
-            seed=jnp.uint32(self.seed & 0xFFFFFFFF))
+            seed=jnp.uint32(self.seed & 0xFFFFFFFF),
+            join_round=jnp.asarray(jr), leave_round=jnp.asarray(lr))
 
     # -- checkpoint meta -------------------------------------------------
 
@@ -206,7 +295,9 @@ class NemesisSpec:
                 "loss_until": self._until(self.loss_until,
                                           self.loss_rate),
                 "dup_rate": self.dup_rate,
-                "dup_until": self._until(self.dup_until, self.dup_rate)}
+                "dup_until": self._until(self.dup_until, self.dup_rate),
+                "join": [[r, list(ns)] for r, ns in self.join],
+                "leave": [[r, list(ns)] for r, ns in self.leave]}
 
     @staticmethod
     def from_meta(meta: dict) -> "NemesisSpec":
@@ -217,7 +308,11 @@ class NemesisSpec:
             loss_rate=float(meta.get("loss_rate", 0.0)),
             loss_until=meta.get("loss_until"),
             dup_rate=float(meta.get("dup_rate", 0.0)),
-            dup_until=meta.get("dup_until"))
+            dup_until=meta.get("dup_until"),
+            join=tuple((int(r), tuple(ns))
+                       for r, ns in meta.get("join", ())),
+            leave=tuple((int(r), tuple(ns))
+                        for r, ns in meta.get("leave", ())))
 
 
 def random_spec(n_nodes: int, *, seed: int, horizon: int,
@@ -275,18 +370,43 @@ def random_spec(n_nodes: int, *, seed: int, horizon: int,
 # (one compiled shape); rates/seeds stack into (S,) scalars.
 
 
-def pad_plan(plan: FaultPlan, n_windows: int) -> FaultPlan:
+def _plan_window_shapes(plan: FaultPlan, where: str = "plan") -> int:
+    """Validate the crash-window axis is coherent across the three
+    window leaves (starts/ends/down) and return its length.  Names
+    ``where`` in the error so a batch failure points at the offending
+    spec instead of surfacing as a raw JAX stacking error."""
+    c = int(plan.starts.shape[0])
+    if plan.starts.ndim != 1 or plan.ends.ndim != 1 \
+            or plan.down.ndim != 2:
+        raise ValueError(
+            f"{where}: window leaves must be starts (C,), ends (C,), "
+            f"down (C, N); got starts {tuple(plan.starts.shape)}, "
+            f"ends {tuple(plan.ends.shape)}, "
+            f"down {tuple(plan.down.shape)}")
+    if int(plan.ends.shape[0]) != c or int(plan.down.shape[0]) != c:
+        raise ValueError(
+            f"{where}: window axes disagree — starts has {c} windows, "
+            f"ends {int(plan.ends.shape[0])}, "
+            f"down {int(plan.down.shape[0])}")
+    return c
+
+
+def pad_plan(plan: FaultPlan, n_windows: int, *,
+             where: str = "plan") -> FaultPlan:
     """Pad a compiled plan's crash-window axis to ``n_windows`` with
     never-active ``[0, 0)`` windows (see above).  Evaluation is
-    bit-identical — the pad windows fold as inactive at every round."""
-    c = int(plan.starts.shape[0])
+    bit-identical — the pad windows fold as inactive at every round.
+    ``where`` names the plan (e.g. its batch index) in shape
+    errors."""
+    c = _plan_window_shapes(plan, where)
     if c > n_windows:
         raise ValueError(
-            f"plan has {c} crash windows, cannot pad to {n_windows}")
+            f"{where} has {c} crash windows, cannot pad to "
+            f"{n_windows}")
     if c == n_windows:
         return plan
     pad = n_windows - c
-    n = int(plan.down.shape[1]) if plan.down.ndim == 2 else 0
+    n = int(plan.down.shape[1])
     return plan._replace(
         starts=jnp.concatenate(
             [plan.starts, jnp.zeros((pad,), jnp.int32)]),
@@ -321,7 +441,18 @@ def batch_plans(specs, n_windows: int | None = None) -> FaultPlan:
                 f"n_windows={n_windows} < the batch's widest crash-"
                 f"window count {c_max}")
         c_max = n_windows
-    plans = [pad_plan(sp.compile(), c_max) for sp in specs]
+    plans = [pad_plan(sp.compile(), c_max, where=f"spec {i}")
+             for i, sp in enumerate(specs)]
+    ref = plans[0]
+    for i, p in enumerate(plans[1:], start=1):
+        for name in FaultPlan._fields:
+            got = tuple(getattr(p, name).shape)
+            want = tuple(getattr(ref, name).shape)
+            if got != want:
+                raise ValueError(
+                    f"batch_plans: spec {i} leaf {name!r} has shape "
+                    f"{got}, but spec 0 has {want} — the batch does "
+                    "not share one compiled shape")
     return FaultPlan(*(jnp.stack([p[i] for p in plans])
                        for i in range(len(FaultPlan._fields))))
 
@@ -329,14 +460,39 @@ def batch_plans(specs, n_windows: int | None = None) -> FaultPlan:
 # -- device-side mask evaluation ----------------------------------------
 
 
+def member_at(plan: FaultPlan, t, ids: jnp.ndarray) -> jnp.ndarray:
+    """bool, shaped like ``ids`` — which of the (GLOBAL) node ids are
+    MEMBERS at round ``t``: joined at or before ``t`` and not yet
+    left.  Crash windows do not affect membership — a crashed member
+    is still a member (it will restart); a left row never is."""
+    t32 = jnp.asarray(t).astype(jnp.int32)
+    idx = jnp.asarray(ids).astype(jnp.int32)
+    return ((t32 >= plan.join_round[idx])
+            & (t32 < plan.leave_round[idx]))
+
+
+def plan_churn(plan: FaultPlan) -> jnp.ndarray:
+    """() int32 — how many membership events the plan carries (join
+    rows + leave rows): the behavioral signature's churn input
+    (scenario.signature_eval's fifth field), evaluated from the plan
+    leaves the run already holds — zero extra operands."""
+    joins = jnp.sum(plan.join_round != jnp.int32(JOIN_FOUNDING))
+    leaves = jnp.sum(plan.leave_round != jnp.int32(LEAVE_NEVER))
+    return (joins + leaves).astype(jnp.int32)
+
+
 def node_up(plan: FaultPlan, t, ids: jnp.ndarray) -> jnp.ndarray:
     """bool, shaped like ``ids`` — which of the (GLOBAL) node ids are
     up at round ``t``.  Same windows-as-data evaluation as the
-    partition masks (broadcast._edge_live, counter._reach)."""
-    return windows_fold(
+    partition masks (broadcast._edge_live, counter._reach); the
+    membership fold rides on top — a non-member row (pre-join or
+    post-leave) is never up, so every existing liveness gate in the
+    sims inherits join/leave with no call-site change."""
+    up = windows_fold(
         plan.starts, plan.ends, t,
         lambda w, active, up: up & ~(active & plan.down[w][ids]),
-        jnp.ones(ids.shape, bool))
+        jnp.ones(jnp.asarray(ids).shape, bool))
+    return up & member_at(plan, t, ids)
 
 
 def amnesia(plan: FaultPlan, t, ids: jnp.ndarray) -> jnp.ndarray:
@@ -345,8 +501,18 @@ def amnesia(plan: FaultPlan, t, ids: jnp.ndarray) -> jnp.ndarray:
     state dies WITH the process, so the sims wipe it at crash entry;
     the rows stay empty while down (every edge to/from them is masked)
     and the node restarts empty when its window ends, recovering only
-    via anti-entropy."""
-    return ~node_up(plan, t, ids) & node_up(plan, t - 1, ids)
+    via anti-entropy.
+
+    A JOINING row also fires here (at exactly its join round): the
+    same wipe call sites that serve crash-restart guarantee the row
+    ENTERS EMPTY — structurally, not by convention.  The difference
+    from restart-with-amnesia is in the liveness history, not the
+    wipe: a joiner was never up before (``node_up`` is False for its
+    whole pre-join past), a restarted node was."""
+    crash = ~node_up(plan, t, ids) & node_up(plan, t - 1, ids)
+    t32 = jnp.asarray(t).astype(jnp.int32)
+    idx = jnp.asarray(ids).astype(jnp.int32)
+    return crash | (t32 == plan.join_round[idx])
 
 
 def _mix32(x: jnp.ndarray) -> jnp.ndarray:
@@ -566,16 +732,25 @@ def _mix32_np(x: np.ndarray) -> np.ndarray:
     return x
 
 
+def host_member_at(plan: FaultPlan, t: int) -> np.ndarray:
+    """(N,) bool — numpy twin of :func:`member_at` over a COMPILED
+    plan (bit-identical membership fold for host-side staging and the
+    checkers' member-masked evidence)."""
+    jr = np.asarray(plan.join_round)
+    lr = np.asarray(plan.leave_round)
+    return (jr <= t) & (t < lr)
+
+
 def host_node_up(plan: FaultPlan, t: int) -> np.ndarray:
     """(N,) bool — numpy twin of :func:`node_up` over a COMPILED plan
     (drivers that only hold the plan, e.g. ``KafkaSim.alloc_offsets``,
     mirror the round's gate without a device round-trip)."""
-    up = np.ones(np.asarray(plan.down).shape[1], bool)
+    up = host_member_at(plan, t)
     starts, ends = np.asarray(plan.starts), np.asarray(plan.ends)
     down = np.asarray(plan.down)
     for w in range(starts.shape[0]):
         if starts[w] <= t < ends[w]:
-            up &= ~down[w]
+            up = up & ~down[w]
     return up
 
 

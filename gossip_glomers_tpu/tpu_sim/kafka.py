@@ -1659,11 +1659,18 @@ def _build_batch_round(sim: "KafkaSim"):
     return rnd
 
 
-def _batch_converged(state: KafkaState) -> jnp.ndarray:
+def _batch_converged(state: KafkaState, member=None) -> jnp.ndarray:
     """() bool, traced — one scenario's convergence predicate: every
     node's presence bitset identical (the traced twin of
-    run_kafka_nemesis's host check)."""
-    return jnp.all(state.present == state.present[:1])
+    run_kafka_nemesis's host check).  ``member`` ((N,) bool, PR 17)
+    compares MEMBER rows against the first member's row instead of
+    row 0 (row 0 may have left) and exempts non-members — a left
+    row's wiped presence can never resync."""
+    if member is None:
+        return jnp.all(state.present == state.present[:1])
+    ref = jnp.argmax(member).astype(jnp.int32)
+    ok = state.present == state.present[ref][None]
+    return jnp.all(ok | ~member[:, None, None])
 
 
 # -- program contracts (tpu_sim/audit.py registry) -----------------------

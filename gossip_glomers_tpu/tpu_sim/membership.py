@@ -1,0 +1,382 @@
+"""Elastic resharding: resize a run's padded node axis at a
+checkpoint boundary (PR 17).
+
+Membership events (join/leave) compile to two ``(N,)`` columns of the
+:class:`~.faults.FaultPlan` and fold into every liveness gate
+(tpu_sim/faults.py ``node_up``/``member_at``).  That makes a *resize*
+expressible two ways, and this module owns the bridge between them:
+
+- **In-place, at fixed capacity**: a grow is a block of padded rows
+  JOINING at the resize round (they enter empty and catch up through
+  the workload's own anti-entropy); a shrink is a block of rows
+  LEAVING (they drain, then their liveness goes down and stays down).
+  This form batches — a scenario-sharded campaign runs grow/shrink
+  cells next to crash/loss cells in ONE compiled program, because the
+  padded capacity never changes shape.
+- **Across a checkpoint boundary, at a NEW capacity**:
+  :func:`restore_resized` reloads a mid-run checkpoint
+  (tpu_sim/checkpoint.py — the fault spec rides the meta) into a
+  LARGER or SMALLER padded node axis: grown rows enter as empty
+  padded rows that join at the boundary round; shrunk-away rows must
+  already be non-members (validated loudly — :func:`resize_spec`
+  names any still-member row).  The continuation spec it returns is
+  the SAME spec the in-place form would run at the new capacity from
+  round 0, which is why the two forms are bit-exact twins for
+  capacity-independent dynamics (full-topology broadcast, the
+  counter's shared-KV path) — harness/membership.py pins it.
+
+Re-homing (the PR-14 stateless-hash KV routing under resize): key
+ownership is a pure function of ``(key, n_nodes, seed)``, so a resize
+moves exactly the keys whose hash changes home — :func:`rehomed_keys`
+(host) and :func:`rehomed_mask` (device) compute that diff
+independently and must agree bit-for-bit; :func:`apply_rehoming`
+carries the KV registers across the boundary and the moved-key set it
+implies is verified against both.
+
+Host/device split, DECLARED (the PR-6 faults.py pattern).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import checkpoint, faults, kvstore
+
+TRACED_EVALUATORS = ("member_census", "rehomed_mask")
+HOST_SIDE = ("resize_spec", "resize_state", "restore_resized",
+             "rehomed_keys", "apply_rehoming", "audit_contracts")
+
+# Which leaves of each sim state carry the padded NODE axis (and on
+# which dimension) — the only leaves a resize reshapes.  Everything
+# else (round counters, message ledgers, the shared KV scalar, the
+# (K, C) kafka log) is capacity-independent and carries over as-is.
+_NODE_AXIS: dict[str, dict[str, int]] = {
+    "BroadcastState": {"received": 0, "frontier": 0},
+    "CounterState": {"pending": 0, "cached": 0},
+    "KafkaState": {"present": 0, "local_committed": 0,
+                   "origin_bits": 0},
+}
+# leaves a resize refuses to carry (loudly): the delay-ring history is
+# a sliding window of PAST node-axis payloads — replaying it at a new
+# capacity would fabricate deliveries that never happened; device KV
+# rows move homes entirely (apply_rehoming), not by pad/truncate.
+_REJECT_LEAVES: dict[str, dict[str, str]] = {
+    "BroadcastState": {
+        "history": "the per-edge delay ring holds PAST payload blocks "
+                   "at the old capacity — resize campaigns run "
+                   "1-hop (delays=None)"},
+    "CounterState": {
+        "rows": "device KV rows re-home by hash, not by pad/truncate "
+                "— carry them with membership.apply_rehoming"},
+    "KafkaState": {
+        "rows": "device KV rows re-home by hash, not by pad/truncate "
+                "— carry them with membership.apply_rehoming"},
+}
+
+
+# -- traced evaluators ---------------------------------------------------
+
+
+def member_census(plan, t, row_ids: jnp.ndarray,
+                  reduce_sum) -> jnp.ndarray:
+    """() int32 — how many rows are members at round ``t``: each shard
+    folds :func:`~.faults.member_at` over its LOCAL global ids, then
+    ONE psum globalizes the count — all-reduce only, never a gather
+    (the ``membership/sharded-census-run`` contract pins the HLO)."""
+    m = faults.member_at(plan, t, row_ids)
+    return reduce_sum(jnp.sum(m.astype(jnp.int32)))
+
+
+def rehomed_mask(n_keys: int, n_from: int, n_to: int,
+                 seed=0) -> jnp.ndarray:
+    """(K,) bool ON DEVICE — which keys change owner across a
+    ``n_from -> n_to`` resize, straight from the stateless routing
+    hash (:func:`~.kvstore.owner_of`).  The device-observed moved-key
+    set; tests pin it equal to the host twin :func:`rehomed_keys`."""
+    keys = jnp.arange(n_keys, dtype=jnp.int32)
+    return (kvstore.owner_of(keys, n_from, seed)
+            != kvstore.owner_of(keys, n_to, seed))
+
+
+# -- the resize boundary -------------------------------------------------
+
+
+def resize_spec(spec: "faults.NemesisSpec", n_to: int,
+                resize_round: int) -> "faults.NemesisSpec":
+    """The continuation spec at the NEW padded capacity — and equally
+    the straight-through twin's spec (run it from round 0 at ``n_to``
+    and the resize boundary becomes an ordinary membership event).
+
+    Grow: rows ``[n, n_to)`` JOIN at ``resize_round`` (they enter
+    empty — :func:`~.faults.amnesia` fires at the join round, wiping
+    the already-empty padded rows, so restore-then-continue and
+    straight-through agree structurally).  Shrink: every dropped row
+    must already be a non-member at the boundary — a still-member row
+    is named loudly (schedule its leave before the resize, or the
+    resize would destroy live state the certifier could never see
+    again).  Crash windows and membership events on dropped rows are
+    filtered out; loss/dup horizons are materialized explicitly so the
+    filtered window list cannot silently change them."""
+    from dataclasses import replace
+
+    n = spec.n_nodes
+    if resize_round < 1:
+        raise ValueError(
+            f"resize_round must be >= 1, got {resize_round} (round-0 "
+            "members are the founding set; a boundary needs a past)")
+    if n_to == n:
+        raise ValueError(f"resize to the same capacity ({n})")
+    if n_to > n:
+        joined = tuple(range(n, n_to))
+        return replace(
+            spec, n_nodes=n_to,
+            join=spec.join + ((resize_round, joined),),
+            loss_until=spec._until(spec.loss_until, spec.loss_rate),
+            dup_until=spec._until(spec.dup_until, spec.dup_rate))
+    members = spec.host_members(resize_round)
+    alive = np.nonzero(members[n_to:])[0] + n_to
+    if alive.size:
+        raise ValueError(
+            f"cannot shrink {n} -> {n_to} at round {resize_round}: "
+            f"rows {alive.tolist()} are still members — schedule "
+            "their leave before the boundary (a leave drains; a "
+            "truncation would destroy live acked state)")
+
+    def keep(events):
+        out = []
+        for first, ns in events:
+            ns = tuple(i for i in ns if i < n_to)
+            if ns:
+                out.append((first, ns))
+        return tuple(out)
+
+    crash = []
+    for s, e, ns in spec.crash:
+        ns = tuple(i for i in ns if i < n_to)
+        if ns:
+            crash.append((s, e, ns))
+    return faults.NemesisSpec(
+        n_nodes=n_to, seed=spec.seed, crash=tuple(crash),
+        loss_rate=spec.loss_rate,
+        loss_until=spec._until(spec.loss_until, spec.loss_rate),
+        dup_rate=spec.dup_rate,
+        dup_until=spec._until(spec.dup_until, spec.dup_rate),
+        join=keep(spec.join), leave=keep(spec.leave))
+
+
+def resize_state(state, n_to: int):
+    """Map one sim state's padded node axis to ``n_to``: declared
+    node-axis leaves (``_NODE_AXIS``) pad with EMPTY rows (grow) or
+    truncate (shrink); every other leaf carries over untouched.
+    Leaves that cannot be resized meaningfully are rejected loudly
+    with the reason (``_REJECT_LEAVES``).  This is pure reshaping —
+    the SAFETY of a shrink (no live member rows dropped) is
+    :func:`resize_spec`'s validation, which :func:`restore_resized`
+    always runs first."""
+    cls = type(state).__name__
+    axes = _NODE_AXIS.get(cls)
+    if axes is None:
+        raise ValueError(
+            f"no node-axis resize map for {cls}: supported states "
+            f"are {sorted(_NODE_AXIS)}")
+    for fname, why in _REJECT_LEAVES.get(cls, {}).items():
+        if getattr(state, fname, None) is not None:
+            raise ValueError(
+                f"{cls}.{fname} cannot cross a resize boundary: {why}")
+    n_from = None
+    repl = {}
+    for fname, ax in axes.items():
+        leaf = getattr(state, fname, None)
+        if leaf is None:
+            continue
+        arr = np.asarray(leaf)
+        if n_from is None:
+            n_from = int(arr.shape[ax])
+        elif int(arr.shape[ax]) != n_from:
+            raise ValueError(
+                f"{cls}.{fname} has node axis {arr.shape[ax]}, "
+                f"expected {n_from} — state leaves disagree on the "
+                "padded capacity")
+        if n_to > n_from:
+            pad_shape = list(arr.shape)
+            pad_shape[ax] = n_to - n_from
+            arr = np.concatenate(
+                [arr, np.zeros(pad_shape, arr.dtype)], axis=ax)
+        elif n_to < n_from:
+            arr = np.take(arr, np.arange(n_to), axis=ax)
+        repl[fname] = jnp.asarray(arr)
+    if n_from is None:
+        raise ValueError(f"{cls} has no node-axis leaves to resize")
+    return state._replace(**repl)
+
+
+def restore_resized(path: str, state_cls: type, n_to: int):
+    """Reload a mid-run checkpoint into a resized padded node axis.
+
+    Returns ``(state, spec, meta)``: the state with its node-axis
+    leaves padded/truncated to ``n_to`` (:func:`resize_state`), and
+    the continuation :class:`~.faults.NemesisSpec` at the new
+    capacity (:func:`resize_spec` — the boundary round is the
+    checkpointed ``state.t``, and shrink safety is validated there
+    BEFORE any row is dropped).  The checkpoint must carry its fault
+    spec in the meta (``checkpoint.save(..., fault_spec=spec)``) —
+    that spec is what re-derives liveness and membership at the new
+    capacity; without it the resize has no membership ground truth
+    and is refused."""
+    state, meta = checkpoint.restore(path, state_cls)
+    spec = checkpoint.fault_spec_from_meta(meta)
+    if spec is None:
+        raise ValueError(
+            "checkpoint carries no fault_spec in its meta: an elastic "
+            "resize re-derives liveness and membership at the new "
+            "capacity from the spec — pass fault_spec= to "
+            "checkpoint.save at the boundary")
+    boundary = int(np.asarray(state.t))
+    spec2 = resize_spec(spec, n_to, boundary)
+    return resize_state(state, n_to), spec2, meta
+
+
+# -- KV re-homing --------------------------------------------------------
+
+
+def rehomed_keys(n_keys: int, n_from: int, n_to: int, *,
+                 seed: int = 0) -> np.ndarray:
+    """(M,) int32 HOST twin of :func:`rehomed_mask`: the sorted key
+    ids whose owner changes across the resize, from the same stateless
+    routing hash (:func:`~.kvstore.host_owner_of`).  Deterministic in
+    ``(n_keys, n_from, n_to, seed)`` — the emitted diff a resize
+    campaign verifies the device-observed moved-key set against."""
+    keys = np.arange(n_keys, dtype=np.int32)
+    moved = (kvstore.host_owner_of(keys, n_from, seed)
+             != kvstore.host_owner_of(keys, n_to, seed))
+    return keys[moved]
+
+
+def apply_rehoming(rows: "kvstore.KVRows", old: "kvstore.KVLayout",
+                   new: "kvstore.KVLayout") -> "kvstore.KVRows":
+    """Carry the device KV registers across a resize: read every
+    key's (value, version) at its OLD home row, write it at its NEW
+    home row.  A host-side boundary op — the resize itself is a host
+    checkpoint boundary — whose moved-key set is exactly
+    :func:`rehomed_keys`; unmoved keys land back in their old slot
+    rank bit-for-bit."""
+    if old.n_keys != new.n_keys:
+        raise ValueError(
+            f"layouts disagree on the key space: {old.n_keys} vs "
+            f"{new.n_keys}")
+    if old.seed != new.seed:
+        raise ValueError(
+            f"layouts disagree on the routing seed: {old.seed} vs "
+            f"{new.seed} — re-homing is the CAPACITY diff only")
+    vals = np.asarray(rows.vals)
+    vers = np.asarray(rows.vers)
+    kv = vals[old.owner, old.slot]
+    kr = vers[old.owner, old.slot]
+    nv = np.zeros((new.n_nodes, new.cap), np.int32)
+    nr = np.zeros((new.n_nodes, new.cap), np.int32)
+    nv[new.owner, new.slot] = kv
+    nr[new.owner, new.slot] = kr
+    return kvstore.KVRows(vals=jnp.asarray(nv), vers=jnp.asarray(nr))
+
+
+# -- program contracts ---------------------------------------------------
+
+
+def audit_contracts():
+    """The membership layer's :class:`~.audit.ProgramContract` rows:
+    the sharded member census (all-reduce only — no row gather ever
+    learns who is a member) and the donated membership-run carry at a
+    RESIZED capacity (grown rows ride as padded members-to-be;
+    donation + analytic memory band over the resized state)."""
+    from .audit import AuditProgram, ProgramContract
+    from .engine import (analytic_peak_bytes, collectives, fori_rounds,
+                        jit_program, node_axes)
+
+    def sharded_census_run(mesh):
+        n = 64
+        spec = faults.NemesisSpec(
+            n_nodes=n, seed=5, crash=((2, 6, (1, 2)),),
+            join=((3, tuple(range(n - 8, n))),),
+            leave=((5, (0, 4)),))
+        plan = spec.compile()
+
+        def run(plan, t, rows):
+            coll = collectives(rows.shape[0], mesh)
+            return member_census(plan, t, coll.row_ids,
+                                 coll.reduce_sum)
+
+        prog = jit_program(
+            run, mesh=mesh,
+            in_specs=(faults.plan_specs(), P(),
+                      P(node_axes(mesh))),
+            out_specs=P())
+        args = (plan, jnp.int32(4), jnp.zeros((n,), jnp.int32))
+        return AuditProgram(prog, args)
+
+    def membership_run_donated(mesh):
+        del mesh
+        n, w, rounds = 4096, 64, 16
+        # a grow-shaped membership run AT the resized capacity: the
+        # top quarter of the padded axis joins mid-run (the resize
+        # boundary as an in-place membership event), two founding
+        # rows leave late
+        spec = faults.NemesisSpec(
+            n_nodes=n, seed=7, crash=((3, 6, (5, 6, 7)),),
+            join=((4, tuple(range(3 * n // 4, n))),),
+            leave=((10, (0, 1)),))
+        plan = spec.compile()
+        ids = jnp.arange(n, dtype=jnp.int32)
+
+        def run(st, plan, n_rounds):
+            def body(carry, plan):
+                bits, t = carry
+                member = faults.member_at(plan, t, ids)
+                up = faults.node_up(plan, t, ids)
+                wipe = faults.amnesia(plan, t, ids)
+                bits = jnp.where(wipe[:, None], jnp.uint32(0), bits)
+                anywhere = jnp.bitwise_or.reduce(
+                    jnp.where(member[:, None], bits, jnp.uint32(0)),
+                    axis=0)
+                bits = jnp.where(up[:, None], bits | anywhere[None, :],
+                                 bits)
+                return bits, t + 1
+
+            return fori_rounds(body, (st, jnp.int32(0)), n_rounds,
+                               operand=plan)
+
+        prog = jit_program(run, donate_argnums=(0,))
+        state_bytes = n * w * 4
+        analytic = analytic_peak_bytes(state_bytes=state_bytes,
+                                       donated=True)
+        st0 = jnp.ones((n, w), jnp.uint32)
+        return AuditProgram(prog, (st0, plan, jnp.int32(rounds)),
+                            donated_bytes=state_bytes,
+                            analytic_peak_bytes=analytic[
+                                "peak_live_bytes"])
+
+    return [
+        ProgramContract(
+            name="membership/sharded-census-run",
+            build=sharded_census_run,
+            collectives={"all-reduce": None},
+            notes="per-shard member_at fold over local global ids + "
+                  "ONE psum: the membership columns are replicated "
+                  "plan leaves, so no collective ever gathers rows "
+                  "to learn who is a member — all-reduce only, NO "
+                  "all-gather"),
+        ProgramContract(
+            name="membership/membership-run-donated",
+            build=membership_run_donated,
+            collectives={},
+            donation=True,
+            mem_lo=0.2, mem_hi=4.0,
+            needs_mesh=False,
+            notes="donated fori membership run AT the resized padded "
+                  "capacity: grown rows join mid-run (amnesia wipes "
+                  "them empty at entry), leavers drop out of the "
+                  "member fold; the (N', W) carry aliases in place — "
+                  "compiled peak within band of 1x state + fold "
+                  "temps"),
+    ]

@@ -239,14 +239,15 @@ def stack_pytrees(trees):
 
 def stage_kafka_batch(batch: ScenarioBatch, rounds: int, *,
                       n_keys: int, max_sends: int,
-                      send_prob: float) -> tuple:
+                      send_prob: float, quiesce: int = 0) -> tuple:
     """(S, R, N, Smax) send batches for a kafka scenario batch —
     per scenario EXACTLY the vectorized commit-free staging of
     harness.nemesis.stage_kafka_ops (same rng call order, so the
     sequential runner replays the identical campaign), padded with -1
     no-op rounds from the scenario's own clear round to the common
     horizon ``rounds`` (a padded round stages nothing — the same
-    empty batch the sequential recovery loop drives)."""
+    empty batch the sequential recovery loop drives).  ``quiesce``
+    is the leaving-node drain margin (PR 17) — forwarded verbatim."""
     from ..harness.nemesis import stage_kafka_ops
 
     sks_all, svs_all = [], []
@@ -256,7 +257,7 @@ def stage_kafka_batch(batch: ScenarioBatch, rounds: int, *,
         sks, svs, _crs = stage_kafka_ops(
             sc.spec, r_s, n_keys=n_keys, max_sends=max_sends,
             send_prob=send_prob, workload_seed=sc.workload_seed,
-            commits=False)
+            commits=False, quiesce=quiesce)
         if r_s < rounds:
             pad = rounds - r_s
             n = sc.spec.n_nodes
@@ -454,11 +455,13 @@ def _sig_setup(telemetry_spec, r_total: int, extra_series=()):
 
 
 def signature_eval(tel, conv_round, clear, bp_class,
-                   msgs_col: int, progress_col: int) -> jnp.ndarray:
-    """One scenario's (4,) int32 behavioral signature (traced; vmapped
+                   msgs_col: int, progress_col: int,
+                   churn=0) -> jnp.ndarray:
+    """One scenario's (5,) int32 behavioral signature (traced; vmapped
     by the batch programs next to the certify/serving drivers):
 
-    ``[stall_bucket, depth_bucket, bp_class, recovery_bucket]``
+    ``[stall_bucket, depth_bucket, bp_class, recovery_bucket,
+    churn_bucket]``
 
     - stall: log2 bucket of the FIRST pre-convergence round whose msgs
       ledger went quiet (``telemetry.ring_stall_round`` — the
@@ -469,7 +472,11 @@ def signature_eval(tel, conv_round, clear, bp_class,
     - bp_class: the caller's dominant backpressure class (a small
       workload-specific int — see the dispatchers);
     - recovery: log2 bucket of ``conv_round - clear`` (127 = never
-      converged within bound — its own coverage cell).
+      converged within bound — its own coverage cell);
+    - churn (PR 17): log2 bucket of the membership event count the
+      scenario's plan carries (``faults.plan_churn`` — joins +
+      leaves; 0 for a membership-free plan), so the adaptive fuzzer's
+      coverage map separates churn shapes.
 
     Everything reads the ring + scalars the run already carries: ZERO
     extra collectives, ZERO host callbacks."""
@@ -482,9 +489,11 @@ def signature_eval(tel, conv_round, clear, bp_class,
         cr >= 0,
         telemetry.log2_bucket(jnp.maximum(cr - clear, 0)),
         jnp.int32(127))
+    churn_b = telemetry.log2_bucket(jnp.asarray(churn, jnp.int32))
     return jnp.stack([telemetry.log2_bucket(stall),
                       telemetry.log2_bucket(depth),
-                      jnp.asarray(bp_class, jnp.int32), rec_b])
+                      jnp.asarray(bp_class, jnp.int32), rec_b,
+                      churn_b])
 
 
 def _dispatch_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
@@ -498,7 +507,7 @@ def _dispatch_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
     means the device executes while the host moves on (the pipelined
     fuzzer overlaps collect(i) with dispatch(i+1)).
 
-    ``signatures`` (PR 13) appends the per-scenario (4,) behavioral
+    ``signatures`` (PR 13) appends the per-scenario (5,) behavioral
     signature (:func:`signature_eval`; requires ``telemetry_spec``
     with an unwrapped ring).  ``n_windows`` pads every FaultPlan to a
     fixed crash-window count and ``min_rounds`` floors the trip count
@@ -519,6 +528,7 @@ def _dispatch_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
     scs = batch.scenarios
     s_count = len(scs)
     dup_on = any(sc.spec.dup_rate > 0 for sc in scs)
+    has_mem = any(sc.spec.has_membership for sc in scs)
     has_delays = any(sc.delays is not None for sc in scs)
     if has_delays:
         dmats = []
@@ -546,20 +556,27 @@ def _dispatch_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
     r_total = max(max_clear + batch.max_recovery_rounds,
                   int(min_rounds))
 
+    # values are acked where they are INJECTED: a non-founding row
+    # (pre-join, PR 17) stages nothing, so its round-robin values are
+    # simply never offered in that scenario and its target shrinks
+    # accordingly (membership-free scenarios: founding = everyone,
+    # bit-identical to the unmasked staging)
+    founding = np.stack([sc.spec.host_members(0) for sc in scs])
     inject = B.make_inject(n, nv)
-    target = jnp.asarray(np.bitwise_or.reduce(
-        inject.astype(np.uint32), axis=0))
-    targets = jnp.broadcast_to(target, (s_count,) + target.shape)
+    injs_np = np.where(founding[:, :, None],
+                       inject.astype(np.uint32)[None], np.uint32(0))
+    targets_np = np.bitwise_or.reduce(injs_np, axis=1)   # (S, W)
+    targets = jnp.asarray(targets_np)
 
-    def one_state():
-        rec = jnp.asarray(inject.astype(np.uint32))
+    def one_state(i):
+        rec = jnp.asarray(injs_np[i])
         hist = (jnp.zeros((ring, n, B.num_words(nv)), jnp.uint32)
                 if has_delays else None)
         return B.BroadcastState(received=rec, frontier=jnp.copy(rec),
                                 t=jnp.int32(0), msgs=jnp.uint32(0),
                                 history=hist, srv_msgs=None)
 
-    states = stack_pytrees([one_state() for _ in range(s_count)])
+    states = stack_pytrees([one_state(i) for i in range(s_count)])
     rnd = B._build_batch_round(nbrs, nbr_mask, sync_every=sync_every,
                                dup_on=dup_on, delay_set=delay_set)
     tl = telemetry_spec is not None
@@ -570,7 +587,7 @@ def _dispatch_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
         ms_col, pg_col = _sig_setup(telemetry_spec, r_total)
         kn_col = telemetry_spec.names.index("known_bits")
 
-    def sig_of(res, clear):
+    def sig_of(res, clear, churn):
         if not signatures:
             return res
         st, cr, mc, tlf = res
@@ -581,33 +598,38 @@ def _dispatch_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
         bp = telemetry.log2_bucket(
             jnp.maximum(jnp.int32(n * nv) - known, 0))
         return st, cr, mc, tlf, signature_eval(tlf, cr, clear, bp,
-                                               ms_col, pg_col)
+                                               ms_col, pg_col, churn)
+
+    def conv_of(plan, clear, target):
+        member = (faults.member_at(plan, clear, jnp.arange(n))
+                  if has_mem else None)
+        return lambda st: B._batch_converged(st, target, member)
 
     if has_delays:
         def one(state, plan, parts, delays, clear, target, *tel_a):
             step1 = lambda st, i: rnd(st, plan, parts,  # noqa: E731
                                       delays)
-            conv = lambda st: B._batch_converged(st,   # noqa: E731
-                                                 target)
+            conv = conv_of(plan, clear, target)
             row = ((lambda s0, s1: sim._tel_series(
                 s0, s1, plan, lambda x: x)) if tl else None)
             return sig_of(certify_loop(
                 step1, conv, state, clear,
                 batch.max_recovery_rounds, r_total,
-                tel_a[0] if tl else None, row, tel_mask), clear)
+                tel_a[0] if tl else None, row, tel_mask), clear,
+                faults.plan_churn(plan))
 
         args = [states, plans, parts_b, delays_b, clears, targets]
     else:
         def one(state, plan, parts, clear, target, *tel_a):
             step1 = lambda st, i: rnd(st, plan, parts)  # noqa: E731
-            conv = lambda st: B._batch_converged(st,   # noqa: E731
-                                                 target)
+            conv = conv_of(plan, clear, target)
             row = ((lambda s0, s1: sim._tel_series(
                 s0, s1, plan, lambda x: x)) if tl else None)
             return sig_of(certify_loop(
                 step1, conv, state, clear,
                 batch.max_recovery_rounds, r_total,
-                tel_a[0] if tl else None, row, tel_mask), clear)
+                tel_a[0] if tl else None, row, tel_mask), clear,
+                faults.plan_churn(plan))
 
         args = [states, plans, parts_b, clears, targets]
     dn = (0,) + ((len(args),) if tl else ())
@@ -621,11 +643,12 @@ def _dispatch_broadcast_batch(batch: ScenarioBatch, *, mesh=None,
         key=(n, nv, topology, sync_every, s_count, r_total, dup_on,
              delay_set, int(plans.starts.shape[1]),
              int(parts_b.starts.shape[1]), telemetry_spec,
-             signatures))
+             signatures, has_mem))
     out = prog(*args)
     return {"out": out, "batch": batch,
             "telemetry_spec": telemetry_spec, "signatures": signatures,
-            "n": n, "nv": nv, "topology": topology}
+            "n": n, "nv": nv, "topology": topology,
+            "targets_np": targets_np}
 
 
 def _collect_broadcast_batch(handle: dict) -> dict:
@@ -639,10 +662,20 @@ def _collect_broadcast_batch(handle: dict) -> dict:
     tl = telemetry_spec is not None
     final, conv_round, msgs_clear = out[0], out[1], out[2]
     rec = np.asarray(final.received)                  # (S, N, W)
-    anywhere = np.bitwise_or.reduce(rec, axis=1)      # (S, W)
+    # evidence is member-scoped (PR 17): a value survives iff some
+    # row that is STILL A MEMBER at the scenario's clear round holds
+    # it, and only values actually acked (present in the scenario's
+    # founding-masked target) can be lost.  Membership-free scenarios
+    # reduce to the original all-rows / all-values check.
+    members = np.stack([sc.spec.host_members(sc.spec.clear_round)
+                        for sc in batch.scenarios])   # (S, N)
+    targets_np = handle["targets_np"]                 # (S, W)
+    anywhere = np.bitwise_or.reduce(
+        np.where(members[:, :, None], rec, 0), axis=1)  # (S, W)
     lost_lists = [
         [v for v in range(nv)
-         if not (anywhere[i, v // 32] >> (v % 32)) & 1]
+         if ((targets_np[i, v // 32] >> (v % 32)) & 1)
+         and not (anywhere[i, v // 32] >> (v % 32)) & 1]
         for i in range(s_count)]
     res = _verdict_rows(batch, conv_round, msgs_clear,
                         np.asarray(final.msgs), lost_lists)
@@ -693,8 +726,16 @@ def _dispatch_counter_batch(batch: ScenarioBatch, *, mesh=None,
     poll_every = int(kw.get("poll_every", 2))
     scs = batch.scenarios
     s_count = len(scs)
+    has_mem = any(sc.spec.has_membership for sc in scs)
     sim = CT.CounterSim(n, mode=mode, poll_every=poll_every)
     deltas = np.arange(1, n + 1, dtype=np.int32)
+    # deltas are acked where they are STAGED: a non-founding row
+    # (pre-join, PR 17) stages nothing, so each scenario's acked sum
+    # is its founding rows' deltas (membership-free: everyone)
+    founding = np.stack([sc.spec.host_members(0) for sc in scs])
+    deltas_s = np.where(founding, deltas[None],
+                        0).astype(np.int32)           # (S, N)
+    ackeds_np = deltas_s.sum(axis=1)                  # (S,)
     acked_sum = int(deltas.sum())
 
     plans = faults.batch_plans([sc.spec for sc in scs], n_windows)
@@ -703,12 +744,12 @@ def _dispatch_counter_batch(batch: ScenarioBatch, *, mesh=None,
     r_total = max(int(np.max(np.asarray(clears)))
                   + batch.max_recovery_rounds, int(min_rounds))
 
-    def one_state():
+    def one_state(i):
         st = sim.init_state()
         return st._replace(pending=st.pending
-                           + jnp.asarray(deltas))
+                           + jnp.asarray(deltas_s[i]))
 
-    states = stack_pytrees([one_state() for _ in range(s_count)])
+    states = stack_pytrees([one_state(i) for i in range(s_count)])
     rnd = CT._build_batch_round(sim)
     tl = telemetry_spec is not None
     tel_mask = telemetry_spec.static_mask if tl else None
@@ -719,7 +760,7 @@ def _dispatch_counter_batch(batch: ScenarioBatch, *, mesh=None,
                                     extra_series=("pending_total",))
         pd_col = telemetry_spec.names.index("pending_total")
 
-    def sig_of(res, clear):
+    def sig_of(res, clear, acked, churn):
         if not signatures:
             return res
         st, cr, mc, tlf = res
@@ -729,20 +770,32 @@ def _dispatch_counter_batch(batch: ScenarioBatch, *, mesh=None,
         kv_t = tlf.ring[last, pg_col].astype(jnp.int32)
         pend = tlf.ring[last, pd_col].astype(jnp.int32)
         bp = telemetry.log2_bucket(
-            jnp.maximum(jnp.int32(acked_sum) - kv_t - pend, 0))
+            jnp.maximum(acked - kv_t - pend, 0))
         return st, cr, mc, tlf, signature_eval(tlf, cr, clear, bp,
-                                               ms_col, pg_col)
+                                               ms_col, pg_col, churn)
 
-    def one(state, plan, clear, *tel_a):
+    def one(state, plan, clear, *rest):
+        if has_mem:
+            acked, *tel_a = rest
+            member = faults.member_at(plan, clear, jnp.arange(n))
+        else:
+            tel_a = rest
+            acked = jnp.int32(acked_sum)
+            member = None
         step1 = lambda st, i: rnd(st, plan)            # noqa: E731
+        conv = lambda st: CT._batch_converged(st,      # noqa: E731
+                                              member)
         row = ((lambda s0, s1: sim._tel_series(
             s0, s1, coll, sim.kv_sched, plan)) if tl else None)
         return sig_of(certify_loop(
-            step1, CT._batch_converged, state, clear,
+            step1, conv, state, clear,
             batch.max_recovery_rounds, r_total,
-            tel_a[0] if tl else None, row, tel_mask), clear)
+            tel_a[0] if tl else None, row, tel_mask), clear, acked,
+            faults.plan_churn(plan))
 
     args = [states, plans, clears]
+    if has_mem:
+        args.append(jnp.asarray(ackeds_np, jnp.int32))
     dn = (0,) + ((len(args),) if tl else ())
     if tl:
         args.append(stack_pytrees(
@@ -752,11 +805,13 @@ def _dispatch_counter_batch(batch: ScenarioBatch, *, mesh=None,
     prog = _build_batch_program(
         "counter", one, args, mesh, dn,
         key=(n, mode, poll_every, s_count, r_total,
-             int(plans.starts.shape[1]), telemetry_spec, signatures))
+             int(plans.starts.shape[1]), telemetry_spec, signatures,
+             has_mem))
     out = prog(*args)
     return {"out": out, "batch": batch,
             "telemetry_spec": telemetry_spec, "signatures": signatures,
-            "n": n, "mode": mode, "acked_sum": acked_sum}
+            "n": n, "mode": mode, "acked_sum": acked_sum,
+            "ackeds_np": ackeds_np}
 
 
 def _collect_counter_batch(handle: dict) -> dict:
@@ -765,19 +820,19 @@ def _collect_counter_batch(handle: dict) -> dict:
     batch = handle["batch"]
     telemetry_spec = handle["telemetry_spec"]
     n, mode = handle["n"], handle["mode"]
-    acked_sum = handle["acked_sum"]
+    ackeds = handle["ackeds_np"]
     s_count = len(batch.scenarios)
     tl = telemetry_spec is not None
     final, conv_round, msgs_clear = out[0], out[1], out[2]
     kv = np.asarray(final.kv)
     pend = np.asarray(final.pending).sum(axis=1)
-    shortfall = acked_sum - kv - pend
+    shortfall = ackeds - kv - pend
     lost_lists = [([{"lost_sum": int(shortfall[i])}]
                    if shortfall[i] != 0 else [])
                   for i in range(s_count)]
     res = _verdict_rows(batch, conv_round, msgs_clear,
                         np.asarray(final.msgs), lost_lists,
-                        extra=[{"acked_sum": acked_sum,
+                        extra=[{"acked_sum": int(ackeds[i]),
                                 "kv": int(kv[i])}
                                for i in range(s_count)])
     res.update(n_nodes=n, mode=mode, final=final)
@@ -824,6 +879,7 @@ def _dispatch_kafka_batch(batch: ScenarioBatch, *, mesh=None,
     send_prob = float(kw.get("send_prob", 0.7))
     scs = batch.scenarios
     s_count = len(scs)
+    has_mem = any(sc.spec.has_membership for sc in scs)
     sim = KF.KafkaSim(n, n_keys, capacity=capacity,
                       max_sends=max_sends, resync_every=resync_every)
 
@@ -835,9 +891,15 @@ def _dispatch_kafka_batch(batch: ScenarioBatch, *, mesh=None,
     max_clear = int(clears_np.max())
     r_total = max(max_clear + batch.max_recovery_rounds,
                   int(min_rounds))
+    # a LEAVING node drains before it goes (PR 17): no sends staged
+    # at it within a resync period of its leave round, so every slot
+    # it acked has replicated before its presence row dies — the
+    # graceful-decommission contract the zero-lost-writes certificate
+    # rests on (same quiesce in the sequential runner: bit-parity)
+    quiesce = (resync_every + 2) if has_mem else 0
     sks, svs = stage_kafka_batch(batch, r_total, n_keys=n_keys,
                                  max_sends=max_sends,
-                                 send_prob=send_prob)
+                                 send_prob=send_prob, quiesce=quiesce)
 
     states = stack_pytrees([sim.init_state()
                             for _ in range(s_count)])
@@ -852,7 +914,7 @@ def _dispatch_kafka_batch(batch: ScenarioBatch, *, mesh=None,
                                     extra_series=("alloc_total",))
         al_col = telemetry_spec.names.index("alloc_total")
 
-    def sig_of(res, clear):
+    def sig_of(res, clear, churn):
         if not signatures:
             return res
         st, cr, mc, tlf = res
@@ -863,7 +925,7 @@ def _dispatch_kafka_batch(batch: ScenarioBatch, *, mesh=None,
         pres = tlf.ring[last, pg_col].astype(jnp.int32)
         bp = telemetry.log2_bucket(jnp.maximum(alloc - pres, 0))
         return st, cr, mc, tlf, signature_eval(tlf, cr, clear, bp,
-                                               ms_col, pg_col)
+                                               ms_col, pg_col, churn)
 
     def one(state, plan, sk_r, sv_r, clear, *tel_a):
         def step1(st, i):
@@ -873,12 +935,17 @@ def _dispatch_kafka_batch(batch: ScenarioBatch, *, mesh=None,
                                           keepdims=False)
             return rnd(st, plan, sk, sv)
 
+        member = (faults.member_at(plan, clear, jnp.arange(n))
+                  if has_mem else None)
+        conv = lambda st: KF._batch_converged(st,      # noqa: E731
+                                              member)
         row = ((lambda s0, s1: sim._tel_series(
             s0, s1, coll, plan, full_scan)) if tl else None)
         return sig_of(certify_loop(
-            step1, KF._batch_converged, state, clear,
+            step1, conv, state, clear,
             batch.max_recovery_rounds, r_total,
-            tel_a[0] if tl else None, row, tel_mask), clear)
+            tel_a[0] if tl else None, row, tel_mask), clear,
+            faults.plan_churn(plan))
 
     args = [states, plans, sks, svs, clears]
     dn = (0,) + ((len(args),) if tl else ())
@@ -891,7 +958,7 @@ def _dispatch_kafka_batch(batch: ScenarioBatch, *, mesh=None,
         "kafka", one, args, mesh, dn,
         key=(n, n_keys, capacity, max_sends, resync_every, s_count,
              r_total, int(plans.starts.shape[1]), telemetry_spec,
-             signatures))
+             signatures, has_mem))
     out = prog(*args)
     return {"out": out, "batch": batch,
             "telemetry_spec": telemetry_spec, "signatures": signatures,
@@ -976,12 +1043,20 @@ def _dispatch_txn_batch(batch: ScenarioBatch, *, mesh=None,
             "the txn workload's observability record is the "
             "per-transaction stamp pair riding TxnState — telemetry "
             "rings / behavioral signatures are not wired for it")
-    for sc in batch.scenarios:
+    for i, sc in enumerate(batch.scenarios):
         if sc.spec.dup_rate:
             raise ValueError(
                 "txn scenarios cannot carry dup streams "
                 "(kvstore.reject_dup_stream: a re-applied CAS would "
                 "double-commit)")
+        if sc.spec.has_membership:
+            raise ValueError(
+                f"txn scenario {i} carries membership events "
+                "(join/leave), which the txn workload does not "
+                "support yet: the wound-or-die commit path and the "
+                "per-transaction stamp ledger assume a fixed client "
+                "roster — run membership churn on the "
+                "broadcast/counter/kafka workloads instead")
     kw = batch.runner_kw
     n = batch.n_nodes
     n_keys = int(kw.get("n_keys", 8))
@@ -1366,6 +1441,15 @@ def _serving_common(batch: ServingBatch, n_windows, n_burst,
     n = batch.n_nodes
     specs = [c.spec if c.spec is not None
              else faults.NemesisSpec(n_nodes=n) for c in cells]
+    for i, sp in enumerate(specs):
+        if sp.has_membership:
+            raise ValueError(
+                f"serving cell {i} carries membership events "
+                "(join/leave), which the serving batch path does not "
+                "support yet: the open-loop traffic tracker has no "
+                "join/leave-aware intake gating — run membership "
+                "churn on the closed-loop scenario batches "
+                "(dispatch_scenario_batch) instead")
     plans = faults.batch_plans(specs, n_windows)
     clears_np = np.array([c.clear_round for c in cells], np.int32)
     r_total = max(int(clears_np.max()) + batch.max_recovery_rounds,
@@ -1679,7 +1763,7 @@ def run_serving_batch(batch: ServingBatch, *, mesh=None,
     latency, sustained throughput, backpressure counts, and
     ``check_recovery`` verdicts, BIT-EXACT against sequential
     ``run_serving`` rows (tests/test_frontier.py pins single-device
-    and 8-way mesh).  ``signatures`` appends the per-cell (4,)
+    and 8-way mesh).  ``signatures`` appends the per-cell (5,)
     behavioral signature (requires a telemetry ring covering the
     horizon; pass ``telemetry_spec=True`` for the default);
     ``n_windows``/``n_burst``/``min_rounds`` are the shape-bucket

@@ -1942,6 +1942,14 @@ def make_nemesis(topology: str, n: int, spec: "faults.NemesisSpec",
     if spec.n_nodes != n:
         raise ValueError(f"spec is for {spec.n_nodes} nodes, "
                          f"topology has {n}")
+    if spec.has_membership:
+        raise ValueError(
+            "the words-major structured path does not support "
+            "membership events yet: the per-direction mask "
+            "decomposition (down_pair/down_cols) has no per-row "
+            "join/leave columns, so a membership-bearing plan would "
+            "silently mis-simulate — run join/leave campaigns on the "
+            "gather path (structured=False)")
     pairs = nemesis_dir_pairs(topology, n, **kw)
     if pairs is None:
         return None

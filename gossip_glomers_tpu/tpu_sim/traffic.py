@@ -41,6 +41,10 @@ design:
 exactly once — *issued* (acked and tracked) or *deferred* (client got
 backpressure: home node down, per-node intake saturated, op-slot
 capacity exhausted, or — kafka — the allocation itself failed).
+PR 17 adds the *resizing* backpressure class (:func:`resizing_defer` +
+the ``deferred_resizing`` sub-counter): arrivals that land while an
+elastic-resharding checkpoint-restore is in flight are deferred with
+the cause named, never dropped.
 Conservation ``arrived == issued + deferred`` and ``issued ==
 completed + in_flight`` holds at every round and is pinned by
 tests/test_traffic.py; an op that can never complete (an acked write
@@ -76,7 +80,8 @@ from .engine import _env_int, scan_blocks, windows_fold
 # code can never silently dodge the lint.
 TRACED_EVALUATORS = (
     "arrive", "_arrival_num", "_client_hash", "local_node_cols",
-    "intake_rank", "issue", "record_aux", "done_scan", "tel_series")
+    "intake_rank", "issue", "record_aux", "done_scan",
+    "resizing_defer", "tel_series")
 HOST_SIDE = (
     "plan_specs", "state_specs", "init_state", "client_nodes",
     "host_arrivals", "traffic_block", "latency_summary",
@@ -420,6 +425,12 @@ class TrafficState(NamedTuple):
     arrived: jnp.ndarray      # () uint32
     deferred: jnp.ndarray     # () uint32 — backpressured arrivals
     completed: jnp.ndarray    # () uint32
+    # () uint32 — the resize-boundary sub-class of ``deferred``
+    # (PR 17): arrivals backpressured because an elastic resharding
+    # checkpoint-restore is in flight.  Always <= deferred — the
+    # conservation identity ``arrived == issued + deferred`` is
+    # UNCHANGED; this counter just names the cause loudly.
+    deferred_resizing: jnp.ndarray
 
 
 def state_specs(sharded: bool, axes="nodes") -> TrafficState:
@@ -430,7 +441,7 @@ def state_specs(sharded: bool, axes="nodes") -> TrafficState:
     round)."""
     r1 = P(axes) if sharded else P(None)
     r2 = P(axes, None) if sharded else P(None, None)
-    return TrafficState(r1, r2, r2, r2, P(), P(), P())
+    return TrafficState(r1, r2, r2, r2, P(), P(), P(), P())
 
 
 def init_state(spec: TrafficSpec, mesh=None) -> TrafficState:
@@ -443,7 +454,7 @@ def init_state(spec: TrafficSpec, mesh=None) -> TrafficState:
         done_round=jnp.full((c, k), -1, jnp.int32),
         op_aux=jnp.full((c, k), -1, jnp.int32),
         arrived=jnp.uint32(0), deferred=jnp.uint32(0),
-        completed=jnp.uint32(0))
+        completed=jnp.uint32(0), deferred_resizing=jnp.uint32(0))
     if mesh is not None:
         n_sh = node_shards(mesh)
         if c % n_sh != 0:
@@ -528,6 +539,29 @@ def done_scan(ts: TrafficState, bit_fn: Callable, t_done,
                            rows, block)
     return ts._replace(done_round=dr,
                        completed=ts.completed + reduce_sum(comp))
+
+
+def resizing_defer(ts: TrafficState, arr: jnp.ndarray,
+                   reduce_sum: Callable) -> tuple:
+    """Backpressure an ENTIRE round of arrivals with the explicit
+    ``resizing`` class — the elastic-resharding intake gate (PR 17):
+    while a checkpoint-restore resize is in flight no op can be issued
+    (the padded node axis itself is changing shape, so there is no
+    stable home node to ack from), so every arrival this round is
+    deferred loudly — counted in BOTH ``deferred`` (the conservation
+    identity ``arrived == issued + deferred`` is unchanged) and the
+    ``deferred_resizing`` sub-class — and NEVER dropped: the client
+    simply re-offers after the boundary.  Returns ``(ts', ok)`` with
+    ``ok`` the all-False issued mask (the drop-in shape of
+    :func:`issue`'s ``ok``, so resize rounds slot into the same driver
+    scaffolding)."""
+    n = reduce_sum(jnp.sum(jnp.asarray(arr).astype(jnp.uint32),
+                           dtype=jnp.uint32))
+    ts = ts._replace(
+        arrived=ts.arrived + n,
+        deferred=ts.deferred + n,
+        deferred_resizing=ts.deferred_resizing + n)
+    return ts, jnp.zeros(jnp.asarray(arr).shape, bool)
 
 
 def tel_series(ts: TrafficState, reduce_sum: Callable) -> tuple:
@@ -635,8 +669,10 @@ def latency_summary(ts: TrafficState) -> dict:
     arrived, deferred = int(ts.arrived), int(ts.deferred)
     return {
         "arrived": arrived, "issued": issued, "deferred": deferred,
+        "deferred_resizing": int(ts.deferred_resizing),
         "completed": completed, "in_flight": issued - completed,
         "conserved": (arrived == issued + deferred
+                      and int(ts.deferred_resizing) <= deferred
                       and completed == int(ts.completed)),
         "lat_p50": (float(np.percentile(lat, 50)) if completed
                     else None),

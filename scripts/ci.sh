@@ -91,6 +91,18 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 # 1-process x 4-device twin.  (CPU, seconds warm / ~2 min cold.)
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/dcn_smoke.py || rc=1
+# Membership smoke (PR 17): one certified join+leave churn campaign
+# per sim (joiners catch up empty, leavers drain first), one
+# certified elastic RESIZE per sim (checkpoint-restore into a
+# larger/smaller padded node axis, crash windows crossing the
+# boundary, broadcast/counter pinned bit-exact vs their straight-
+# through twins, KV re-homing diff verified against the host routing
+# twin), a planted drain-margin-free leave that MUST fail naming the
+# lost delta shortfall with a bundle that replays to the same
+# verdict, and a coverage-steered membership-churn fuzz slice whose
+# signature churn buckets must populate.  (CPU, seconds.)
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python scripts/membership_smoke.py || rc=1
 # Program-contract audit (PR 6): every registered driver contract
 # (collective census, donation alias table, host boundary, memory
 # band) on the CPU 8-way virtual mesh, plus the AST determinism lint

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""CI membership smoke (PR 17): dynamic membership end to end on CPU,
+seconds — the budget-safe slice the tier-1 gate runs on every push:
+
+1. one certified join+leave churn campaign per stateful sim
+   (``run_*_nemesis``): joiners enter empty and catch up through the
+   workload's own anti-entropy, leavers drain first (the fuzzer's
+   drain-margin convention) — bounded recovery, zero lost acked
+   writes;
+2. one certified elastic RESIZE campaign per stateful sim
+   (``harness.membership.run_resize_campaign``): checkpoint at the
+   boundary (the fault spec rides the meta), restore into a
+   larger/smaller padded node axis, certify across the boundary —
+   broadcast/counter pinned bit-exact against their straight-through
+   twins, the broadcast grow also verifying the KV re-homing diff
+   against the host routing twin;
+3. planted-failure probe: a counter leave WITHOUT the drain margin
+   MUST fail naming the lost delta shortfall, and its flight bundle
+   must replay to the same verdict from its JSON alone
+   (first-divergence None — a checker that cannot fail certifies
+   nothing);
+4. a membership-churn fuzz slice with coverage-steered sampling
+   (``fuzz_run(membership_axis=True, adapt=True)``): the behavioral
+   signature's churn bucket must populate the coverage map with
+   distinct churn cells.
+
+Exits nonzero on any failure.  Output dir: ``GG_OBSERVE_DIR``
+(default ``artifacts/membership_smoke``).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from gossip_glomers_tpu.parallel.mesh import force_virtual_devices  # noqa: E402
+
+force_virtual_devices(8)
+
+import jax                                                    # noqa: E402
+import numpy as np                                            # noqa: E402
+from jax.sharding import Mesh                                 # noqa: E402
+
+from gossip_glomers_tpu.harness import fuzz as FZ             # noqa: E402
+from gossip_glomers_tpu.harness import membership as HM       # noqa: E402
+from gossip_glomers_tpu.harness import nemesis as NM          # noqa: E402
+from gossip_glomers_tpu.harness import observe                # noqa: E402
+from gossip_glomers_tpu.tpu_sim import telemetry as TM        # noqa: E402
+from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec     # noqa: E402
+
+
+def main() -> int:
+    out = pathlib.Path(os.environ.get("GG_OBSERVE_DIR",
+                                      "artifacts/membership_smoke"))
+    out.mkdir(parents=True, exist_ok=True)
+    failed = []
+    report = {}
+
+    # 1. certified join+leave churn at fixed capacity, per sim.  The
+    # leave rounds carry the drain margin (clear + n + 2): a leave is
+    # permanent, so anti-entropy must replicate the row's uniquely
+    # held acked state first.
+    n = 12
+    churn = {
+        "broadcast": NemesisSpec(
+            n_nodes=n, seed=3, crash=((2, 6, (1, 2)),),
+            join=((4, (9, 10, 11)),), leave=((20, (5,)),)),
+        "counter": NemesisSpec(
+            n_nodes=n, seed=5, crash=((4, 8, (1,)),),
+            join=((6, (10, 11)),), leave=((22, (5,)),)),
+        "kafka": NemesisSpec(
+            n_nodes=n, seed=7, crash=((2, 6, (1,)),),
+            join=((4, (10, 11)),), leave=((20, (5,)),)),
+    }
+    runners = {
+        "broadcast": lambda sp: NM.run_broadcast_nemesis(
+            sp, n_values=24, max_recovery_rounds=48),
+        "counter": lambda sp: NM.run_counter_nemesis(
+            sp, max_recovery_rounds=48),
+        "kafka": lambda sp: NM.run_kafka_nemesis(
+            sp, n_keys=4, max_recovery_rounds=48),
+    }
+    for wl, sp in churn.items():
+        res = runners[wl](sp)
+        print(f"membership-smoke churn-{wl:9s} "
+              f"{'ok' if res['ok'] else 'FAIL'}  "
+              f"converged={res['converged_round']} "
+              f"recovery={res['recovery_rounds']} "
+              f"lost={res['lost_writes']}")
+        report[f"churn_{wl}"] = {
+            "ok": bool(res["ok"]), "spec": sp.to_meta(),
+            "converged_round": res["converged_round"],
+            "recovery_rounds": res["recovery_rounds"],
+            "lost_writes": res["lost_writes"]}
+        if not res["ok"]:
+            failed.append((f"churn-{wl}", res["lost_writes"]))
+
+    # 2. certified elastic resize per sim: broadcast grows 8 -> 12
+    # with the re-homing diff verified, counter shrinks 12 -> 8,
+    # kafka grows 8 -> 12 (certified-only — module docstring); every
+    # campaign's crash window CROSSES the resize boundary.
+    resizes = {
+        "broadcast": dict(
+            spec=NemesisSpec(n_nodes=8, seed=3,
+                             crash=((4, 9, (1, 2)),)),
+            n_to=12, resize_round=6, kv_keys=128),
+        "counter": dict(
+            spec=NemesisSpec(n_nodes=12, seed=5,
+                             crash=((16, 21, (1,)),),
+                             leave=((16, (8, 9, 10, 11)),)),
+            n_to=8, resize_round=18),
+        "kafka": dict(
+            spec=NemesisSpec(n_nodes=8, seed=7,
+                             crash=((4, 9, (1, 2)),)),
+            n_to=12, resize_round=6),
+    }
+    for wl, kw in resizes.items():
+        sp = kw.pop("spec")
+        res = HM.run_resize_campaign(
+            wl, sp, kw.pop("n_to"), kw.pop("resize_round"),
+            max_recovery_rounds=48, **kw)
+        twin = res["twin"]["bit_exact"]
+        rh = res.get("rehoming")
+        print(f"membership-smoke resize-{wl:8s} "
+              f"{'ok' if res['ok'] else 'FAIL'}  "
+              f"{res['n_from']}->{res['n_to']}@{res['resize_round']} "
+              f"twin={twin} "
+              f"rehomed={rh['n_moved'] if rh else '-'}")
+        report[f"resize_{wl}"] = {
+            k: res[k] for k in
+            ("ok", "n_from", "n_to", "resize_round",
+             "converged_round", "recovery_rounds", "lost_writes")}
+        report[f"resize_{wl}"]["twin_bit_exact"] = twin
+        if rh:
+            report[f"resize_{wl}"]["rehoming"] = {
+                "n_moved": rh["n_moved"], "ok": rh["ok"]}
+        if not res["ok"]:
+            failed.append((f"resize-{wl}", res["lost_writes"]))
+
+    # 3. planted failure: a counter leave WITHOUT the drain margin
+    # loses the leavers' acked unflushed deltas — must fail naming
+    # the shortfall, and the flight bundle must replay to the same
+    # verdict from its JSON alone
+    bad_spec = NemesisSpec(n_nodes=12, seed=5, crash=((4, 9, (1,)),),
+                           leave=((3, (8, 9, 10, 11)),))
+    tel = TM.TelemetrySpec("counter",
+                           rounds=bad_spec.clear_round + 48)
+    bad = NM.run_counter_nemesis(bad_spec, max_recovery_rounds=48,
+                                 telemetry=tel,
+                                 observe_dir=str(out))
+    named = (not bad["ok"] and bad["lost_writes"]
+             and "flight_bundle" in bad)
+    faithful = False
+    if named:
+        replay = observe.replay_bundle(bad["flight_bundle"])
+        faithful = (not replay["ok"]
+                    and replay["first_divergence_round"] is None
+                    and replay["lost_writes"] == bad["lost_writes"])
+    print(f"membership-smoke planted-leave "
+          f"{'ok' if named and faithful else 'FAIL'}  "
+          f"lost={bad['lost_writes']} replay_faithful={faithful}")
+    report["planted_leave"] = {
+        "spec": bad_spec.to_meta(), "named": bool(named),
+        "lost_writes": bad["lost_writes"],
+        "replay_faithful": bool(faithful)}
+    if not (named and faithful):
+        failed.append(("planted-leave", bad.get("lost_writes")))
+
+    # 4. membership-churn fuzz slice with coverage-steered sampling:
+    # the signature's churn bucket must separate churn shapes in the
+    # coverage map
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("nodes",))
+    fz = FZ.fuzz_run("broadcast", 32, n_nodes=12, batch_size=16,
+                     horizon=6, max_recovery_rounds=32, seed=8,
+                     mesh=mesh, delay_axis="off",
+                     membership_axis=True, adapt=True, shrink=False,
+                     observe_dir=str(out))
+    churn_buckets = {r["signature"][4]
+                     for r in fz["rows"] if "signature" in r}
+    print(f"membership-smoke fuzz-32      "
+          f"{'ok' if fz['n_failing'] == 0 else 'FAIL'}  "
+          f"certified={fz['n_certified_ok']}/{fz['n_scenarios']} "
+          f"churn_buckets={sorted(churn_buckets)}")
+    report["fuzz"] = {
+        "n_scenarios": fz["n_scenarios"],
+        "n_certified_ok": fz["n_certified_ok"],
+        "n_failing": fz["n_failing"],
+        "churn_buckets": sorted(int(b) for b in churn_buckets)}
+    if fz["n_failing"] or len(churn_buckets) < 2:
+        failed.append(("fuzz", fz["failing"] or churn_buckets))
+
+    observe.write_json_atomic(str(out / "membership_report.json"),
+                              report)
+    if failed:
+        print(f"membership-smoke FAILED: {failed}")
+        return 1
+    print("membership-smoke all ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

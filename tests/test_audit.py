@@ -257,12 +257,15 @@ def test_default_registry_is_well_formed():
                      "kafka/sharded-step-union-nem-materialized",
                      "kafka/sharded-step-matmul-oracle",
                      "kvstore/sharded-cas-step",
-                     "txn/sharded-step"):
+                     "txn/sharded-step",
+                     "membership/sharded-census-run",
+                     "membership/membership-run-donated"):
         assert expected in names, names
     # at least one donation + memory contract per stateful sim
     donating = [c for c in contracts if c.donation]
     assert {c.name.split("/")[0] for c in donating} == {
-        "broadcast", "counter", "kafka", "kvstore", "txn"}
+        "broadcast", "counter", "kafka", "kvstore", "txn",
+        "membership"}
     for c in donating:
         assert c.mem_hi is not None
 

@@ -439,7 +439,8 @@ def test_kafka_union_footprint_formula_pinned():
                    fault_plan=spec.compile(), union_block=b)
     fp = sim.union_footprint()
     state = n * k * 1 * 4 + k * cap * 4 + k * 4 + n * k * 4
-    plan = 4 + 4 + n * 1 + 4 + 4 + 4 + 4 + 4   # FaultPlan leaves
+    plan = (4 + 4 + n * 1 + 4 + 4 + 4 + 4 + 4   # FaultPlan leaves
+            + n * 4 + n * 4)   # PR 17 join_round/leave_round columns
     assert fp["block"] == b
     assert fp["coin_slab_bytes"] == b * n * s * 4
     assert fp["deliver_carry_bytes"] == n * k * 1 * 4

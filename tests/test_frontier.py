@@ -313,7 +313,7 @@ def test_fuzz_shape_buckets_and_pipeline_pin_verdicts():
         for k in ("ok", "spec", "parts", "delays",
                   "converged_round", "n_lost"):
             assert a.get(k) == b.get(k), k
-        assert len(b["signature"]) == 4
+        assert len(b["signature"]) == 5
     assert buck["n_program_shapes"] <= base["n_program_shapes"]
     assert buck["shape_knobs"]["pad_to"] == 4
     assert buck["coverage"]["n_seen"] == len(buck["rows"])
@@ -339,9 +339,9 @@ def test_fuzz_adapt_is_deterministic_and_guarded():
 def test_coverage_map_roundtrip_and_novelty():
     cm = FR.CoverageMap()
     assert cm.novelty((1, 0.1)) == 2.0
-    assert cm.add([1, 2, 0, 3], axis=(1, 0.1), meta={"cell": 0})
-    assert not cm.add([1, 2, 0, 3], axis=(1, 0.1))
-    assert cm.add([2, 2, 1, 3], axis=(2, 0.0))
+    assert cm.add([1, 2, 0, 3, 0], axis=(1, 0.1), meta={"cell": 0})
+    assert not cm.add([1, 2, 0, 3, 0], axis=(1, 0.1))
+    assert cm.add([2, 2, 1, 3, 0], axis=(2, 0.0))
     assert cm.n_distinct == 2 and cm.n_seen == 3
     assert cm.axis_behaviors((1, 0.1)) == 1
     assert cm.axis_samples((1, 0.1)) == 2

@@ -1,0 +1,578 @@
+"""Dynamic membership as certified faults (PR 17:
+tpu_sim/membership.py + harness/membership.py + the faults.py
+join/leave columns): membership-free plans are bit-for-bit no-ops,
+device member/liveness gates match their host twins, elastic resize
+campaigns (checkpoint-restore into a larger/smaller padded node axis)
+certify zero lost acked writes and pin bit-exact against their
+straight-through twins for grow AND shrink with crash windows crossing
+the boundary, KV re-homing diffs agree host-vs-device, the 64-cell
+membership-churn fuzz batch runs as ONE compiled program with
+sequential parity, membership-bearing plans are rejected loudly on
+every unsupported path, and the traced/host split totality keeps the
+PR-6 determinism lint covering both new modules.
+"""
+
+import ast as ast_mod
+import collections
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from gossip_glomers_tpu.harness import fuzz as FZ
+from gossip_glomers_tpu.harness import membership as HM
+from gossip_glomers_tpu.harness import serving as SV
+from gossip_glomers_tpu.parallel.topology import full, to_padded_neighbors
+from gossip_glomers_tpu.tpu_sim import audit, checkpoint, kvstore
+from gossip_glomers_tpu.tpu_sim import faults as F
+from gossip_glomers_tpu.tpu_sim import membership as M
+from gossip_glomers_tpu.tpu_sim import scenario as SC
+from gossip_glomers_tpu.tpu_sim import structured
+from gossip_glomers_tpu.tpu_sim import telemetry as TM
+from gossip_glomers_tpu.tpu_sim import traffic as T
+from gossip_glomers_tpu.tpu_sim.broadcast import (BroadcastSim,
+                                                  BroadcastState,
+                                                  make_inject)
+from gossip_glomers_tpu.tpu_sim.counter import CounterState
+from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec
+
+
+def mesh_1d():
+    return Mesh(np.array(jax.devices()).reshape(8), ("nodes",))
+
+
+# -- membership columns: no-op default, device-vs-host gates -------------
+
+
+def test_membership_free_plan_is_noop():
+    spec = NemesisSpec(n_nodes=8, seed=1, crash=((2, 4, (1,)),))
+    assert not spec.has_membership
+    plan = spec.compile()
+    assert (np.asarray(plan.join_round) == F.JOIN_FOUNDING).all()
+    assert (np.asarray(plan.leave_round) == F.LEAVE_NEVER).all()
+    ids = np.arange(8)
+    for t in range(8):
+        assert np.asarray(F.member_at(plan, t, ids)).all()
+    assert int(F.plan_churn(plan)) == 0
+    # to_meta/from_meta roundtrip keeps the plan membership-free
+    spec2 = NemesisSpec.from_meta(spec.to_meta())
+    assert not spec2.has_membership
+
+
+def test_member_gates_match_host_twins():
+    spec = NemesisSpec(n_nodes=8, seed=2, crash=((2, 5, (1, 6)),),
+                       join=((3, (6, 7)),), leave=((5, (0,)),))
+    assert spec.has_membership
+    plan = spec.compile()
+    ids = np.arange(8)
+    for t in range(10):
+        host_m = spec.host_members(t)
+        dev_m = np.asarray(F.member_at(plan, t, ids))
+        assert (host_m == dev_m).all(), t
+        host_u = spec.host_up(t)
+        dev_u = np.asarray(F.node_up(plan, t, ids))
+        assert (host_u == dev_u).all(), t
+        # a non-member is never up; a crashed member is still a member
+        assert not (dev_u & ~dev_m).any()
+        census = int(M.member_census(plan, t, jnp.asarray(ids),
+                                     lambda x: x))
+        assert census == int(host_m.sum()), t
+    # 2 join rows + 1 leave row
+    assert int(F.plan_churn(plan)) == 3
+
+
+# -- resize_spec: the continuation / straight-through-twin spec ----------
+
+
+def test_resize_spec_grow():
+    spec = NemesisSpec(n_nodes=8, seed=3, crash=((4, 9, (1, 2)),))
+    sp2 = M.resize_spec(spec, 12, 6)
+    assert sp2.n_nodes == 12
+    assert sp2.join[-1] == (6, (8, 9, 10, 11))
+    # grown rows are non-members before the boundary, members after
+    assert not sp2.host_members(5)[8:].any()
+    assert sp2.host_members(6)[8:].all()
+    # founding rows unaffected
+    assert sp2.host_members(0)[:8].all()
+
+
+def test_resize_spec_shrink_filters_and_validates():
+    spec = NemesisSpec(n_nodes=12, seed=5,
+                       crash=((4, 9, (1, 10)),),
+                       leave=((3, (8, 9, 10, 11)),))
+    sp2 = M.resize_spec(spec, 8, 6)
+    assert sp2.n_nodes == 8
+    # the crash window kept only its surviving rows; the leave event
+    # on dropped rows vanished entirely
+    assert sp2.crash == ((4, 9, (1,)),)
+    assert sp2.leave == ()
+    # a still-member dropped row is named loudly
+    live = NemesisSpec(n_nodes=12, seed=5, crash=((4, 9, (1,)),))
+    with pytest.raises(ValueError, match=r"rows \[8, 9, 10, 11\] are "
+                                         "still members"):
+        M.resize_spec(live, 8, 6)
+    with pytest.raises(ValueError, match="resize_round must be >= 1"):
+        M.resize_spec(spec, 8, 0)
+    with pytest.raises(ValueError, match="same capacity"):
+        M.resize_spec(spec, 12, 6)
+
+
+# -- resize_state: node-axis reshaping + loud refusals -------------------
+
+
+def test_resize_state_pads_and_truncates():
+    n, nv = 8, 16
+    sim = BroadcastSim(to_padded_neighbors(full(n)), n_values=nv)
+    state, _ = sim.stage(make_inject(n, nv))
+    grown = M.resize_state(state, 12)
+    assert np.asarray(grown.received).shape[0] == 12
+    assert (np.asarray(grown.received)[8:] == 0).all()
+    assert np.array_equal(np.asarray(grown.received)[:8],
+                          np.asarray(state.received))
+    assert np.asarray(grown.frontier).shape[0] == 12
+    # capacity-independent leaves carry over untouched
+    assert int(grown.t) == int(state.t)
+    assert int(grown.msgs) == int(state.msgs)
+    shrunk = M.resize_state(state, 6)
+    assert np.array_equal(np.asarray(shrunk.received),
+                          np.asarray(state.received)[:6])
+
+
+def test_resize_state_rejections_are_loud():
+    n, nv = 8, 16
+    nbrs = to_padded_neighbors(full(n))
+    sim = BroadcastSim(nbrs, n_values=nv,
+                       delays=np.full(nbrs.shape, 2, np.int32))
+    state, _ = sim.stage(make_inject(n, nv))
+    assert state.history is not None
+    with pytest.raises(ValueError, match="delay ring"):
+        M.resize_state(state, 12)
+    st = CounterState(
+        pending=jnp.zeros((n,), jnp.int32),
+        cached=jnp.zeros((n,), jnp.int32),
+        kv=jnp.int32(0), t=jnp.int32(0), msgs=jnp.uint32(0),
+        rows=kvstore.KVRows(jnp.zeros((n, 2), jnp.int32),
+                            jnp.zeros((n, 2), jnp.int32)))
+    with pytest.raises(ValueError, match="apply_rehoming"):
+        M.resize_state(st, 12)
+    Foo = collections.namedtuple("FooState", ["x"])
+    with pytest.raises(ValueError, match="no node-axis resize map"):
+        M.resize_state(Foo(x=jnp.zeros((4,))), 8)
+
+
+# -- restore_resized: the checkpoint boundary ----------------------------
+
+
+def test_restore_resized_requires_fault_spec_and_resizes():
+    n, nv = 8, 16
+    spec = NemesisSpec(n_nodes=n, seed=3, crash=((4, 9, (1, 2)),))
+    sim = BroadcastSim(to_padded_neighbors(full(n)), n_values=nv,
+                       fault_plan=spec.compile())
+    state, _ = sim.stage(make_inject(n, nv))
+    state = sim.run_staged_fixed(state, 5)
+    with tempfile.TemporaryDirectory() as d:
+        bare = os.path.join(d, "bare.npz")
+        checkpoint.save(bare, state, meta={"workload": "broadcast"})
+        with pytest.raises(ValueError, match="no fault_spec"):
+            M.restore_resized(bare, BroadcastState, 12)
+        ck = os.path.join(d, "ck.npz")
+        checkpoint.save(ck, state, meta={"workload": "broadcast"},
+                        fault_spec=spec)
+        st2, sp2, meta = M.restore_resized(ck, BroadcastState, 12)
+    assert np.asarray(st2.received).shape[0] == 12
+    assert sp2.n_nodes == 12
+    # the boundary round is the checkpointed t
+    assert sp2.join[-1] == (5, (8, 9, 10, 11))
+    assert meta["workload"] == "broadcast"
+
+
+# -- KV re-homing: host twin == device mask, carry roundtrip -------------
+
+
+def test_rehoming_diff_is_deterministic_and_device_matched():
+    for n_from, n_to in ((8, 12), (12, 8), (8, 16)):
+        moved = M.rehomed_keys(256, n_from, n_to)
+        again = M.rehomed_keys(256, n_from, n_to)
+        assert np.array_equal(moved, again)
+        mask = np.asarray(M.rehomed_mask(256, n_from, n_to))
+        assert np.array_equal(moved, np.nonzero(mask)[0])
+        # a moved key really changes owner; an unmoved key keeps it
+        keys = np.arange(256, dtype=np.int32)
+        ow_a = kvstore.host_owner_of(keys, n_from)
+        ow_b = kvstore.host_owner_of(keys, n_to)
+        assert (ow_a[moved] != ow_b[moved]).all()
+        unmoved = np.setdiff1d(keys, moved)
+        assert (ow_a[unmoved] == ow_b[unmoved]).all()
+    # identity resize moves nothing
+    assert M.rehomed_keys(256, 8, 8).size == 0
+
+
+def test_apply_rehoming_carries_every_register():
+    n_keys = 64
+    lo = kvstore.make_layout(n_keys, 8)
+    ln = kvstore.make_layout(n_keys, 12)
+    keys = np.arange(n_keys)
+    vals = np.zeros((8, lo.cap), np.int32)
+    vers = np.zeros((8, lo.cap), np.int32)
+    vals[lo.owner, lo.slot] = keys * 5 + 2
+    vers[lo.owner, lo.slot] = keys % 3
+    rows2 = M.apply_rehoming(
+        kvstore.KVRows(jnp.asarray(vals), jnp.asarray(vers)), lo, ln)
+    assert np.array_equal(
+        np.asarray(rows2.vals)[ln.owner, ln.slot], keys * 5 + 2)
+    assert np.array_equal(
+        np.asarray(rows2.vers)[ln.owner, ln.slot], keys % 3)
+    with pytest.raises(ValueError, match="key space"):
+        M.apply_rehoming(
+            kvstore.KVRows(jnp.asarray(vals), jnp.asarray(vers)),
+            lo, kvstore.make_layout(32, 12))
+    with pytest.raises(ValueError, match="routing seed"):
+        M.apply_rehoming(
+            kvstore.KVRows(jnp.asarray(vals), jnp.asarray(vers)),
+            lo, kvstore.make_layout(n_keys, 12, seed=1))
+
+
+# -- certified resize campaigns (checkpoint-restore across capacities) ---
+
+
+def test_broadcast_resize_campaign_grow_bit_exact():
+    """Grow 8 -> 12 at round 6 with a crash window [4, 9) CROSSING the
+    resize boundary: certified (zero lost acked writes), restored run
+    bit-exact vs the straight-through twin at the final round, and the
+    KV re-homing diff verified host-vs-device."""
+    spec = NemesisSpec(n_nodes=8, seed=3, crash=((4, 9, (1, 2)),))
+    res = HM.run_resize_campaign("broadcast", spec, 12, 6,
+                                 kv_keys=128, max_recovery_rounds=48)
+    assert res["ok"], res
+    assert res["lost_writes"] == []
+    assert res["twin"]["bit_exact"] is True
+    assert res["twin"]["shape"] == "grow"
+    assert res["twin"]["rows_compared"] == 12
+    assert res["rehoming"]["ok"]
+    assert res["rehoming"]["diff_match"]
+    assert res["rehoming"]["carry_ok"]
+    assert res["rehoming"]["n_moved"] > 0
+    assert res["continuation_spec"]["n_nodes"] == 12
+
+
+def test_broadcast_resize_campaign_shrink_bit_exact():
+    """Shrink 12 -> 8 at round 6 (rows 8-11 leave at 3, crash window
+    [4, 9) crossing the boundary): certified with the ORIGINAL spec as
+    the straight-through twin."""
+    spec = NemesisSpec(n_nodes=12, seed=5, crash=((4, 9, (1,)),),
+                       leave=((3, (8, 9, 10, 11)),))
+    res = HM.run_resize_campaign("broadcast", spec, 8, 6,
+                                 kv_keys=128, max_recovery_rounds=48)
+    assert res["ok"], res
+    assert res["lost_writes"] == []
+    assert res["twin"]["bit_exact"] is True
+    assert res["twin"]["shape"] == "shrink"
+    assert res["twin"]["rows_compared"] == 8
+    assert res["rehoming"]["ok"]
+
+
+def test_counter_resize_campaigns_bit_exact():
+    """Counter grow and shrink with crash windows crossing the
+    boundary — the specs leave the CAS drain margin (~n rounds: the
+    shared-KV flush drains one contender per round), mirroring the
+    fuzzer's counter crash-shift convention."""
+    grow = NemesisSpec(n_nodes=8, seed=3, crash=((10, 15, (1, 2)),))
+    res = HM.run_resize_campaign("counter", grow, 12, 12,
+                                 max_recovery_rounds=48)
+    assert res["ok"], res
+    assert res["twin"]["bit_exact"] is True
+    assert res["kv"] == res["acked_sum"]
+    shrink = NemesisSpec(n_nodes=12, seed=5, crash=((16, 21, (1,)),),
+                         leave=((16, (8, 9, 10, 11)),))
+    res = HM.run_resize_campaign("counter", shrink, 8, 18,
+                                 max_recovery_rounds=48)
+    assert res["ok"], res
+    assert res["twin"]["bit_exact"] is True
+    assert res["twin"]["shape"] == "shrink"
+
+
+def test_counter_early_leave_names_the_lost_acked_writes():
+    """A leave WITHOUT the drain margin provably loses acked unflushed
+    deltas — the certifier must name the shortfall, not hide it."""
+    spec = NemesisSpec(n_nodes=12, seed=5, crash=((4, 9, (1,)),),
+                       leave=((3, (8, 9, 10, 11)),))
+    res = HM.run_resize_campaign("counter", spec, 8, 6, twin=False,
+                                 max_recovery_rounds=48)
+    assert not res["ok"]
+    assert res["lost_writes"], res
+    assert "lost_sum" in res["lost_writes"][0]
+    assert res["lost_writes"][0]["lost_sum"] > 0
+
+
+def test_kafka_resize_campaigns_certified():
+    """Kafka is certified-only (the host op-staging rng stream depends
+    on the padded capacity — no bit-exact twin): zero lost allocated
+    slots across the boundary, allocations continue at the new
+    capacity, twin verdict carries the named reason."""
+    grow = NemesisSpec(n_nodes=8, seed=7, crash=((4, 9, (1, 2)),))
+    res = HM.run_resize_campaign("kafka", grow, 12, 6,
+                                 max_recovery_rounds=48)
+    assert res["ok"], res
+    assert res["lost_writes"] == []
+    assert res["twin"]["bit_exact"] is None
+    assert "certified-only" in res["twin"]["reason"]
+    assert res["n_allocated"] >= res["n_allocated_pre_resize"] > 0
+    shrink = NemesisSpec(n_nodes=12, seed=9, crash=((4, 9, (1,)),),
+                         leave=((3, (8, 9, 10, 11)),))
+    res = HM.run_resize_campaign("kafka", shrink, 8, 6,
+                                 max_recovery_rounds=48)
+    assert res["ok"], res
+
+
+def test_resize_campaign_rejections_are_loud():
+    spec = NemesisSpec(n_nodes=8, seed=1)
+    with pytest.raises(ValueError, match="txn"):
+        HM.run_resize_campaign("txn", spec, 12, 4)
+    with pytest.raises(ValueError, match="topology 'full' only"):
+        HM.run_resize_campaign("broadcast", spec, 12, 4,
+                               topology="grid")
+
+
+# -- the 64-cell membership-churn batch (ISSUE acceptance) ---------------
+
+
+def test_membership_churn_batch_64_one_program_with_parity():
+    """64 fuzzed membership-churn scenarios (joins, leaves, and
+    resize-shaped blocks composed with crash windows and loss) in ONE
+    compiled scenario-sharded dispatch: every cell certified, the
+    hand-built grow-block and shrink-block cells cross an ACTIVE crash
+    window, a subset (including both) replays bit-exact through the
+    sequential nemesis runner, and the behavioral signature's fifth
+    field buckets the plan's membership churn.
+
+    The sampler composes churn with crash windows, loss, and
+    partitions, so a batch can also contain the pre-existing lossy
+    class (an origin crashing before its values replicate across
+    lossy/partitioned edges) — those failures must be churn-FREE
+    cells, loudly named with lost-writes evidence, and reproduced
+    bit-exact by the sequential runner: membership churn itself never
+    costs an acked write."""
+    n, horizon = 12, 6
+    cells = FZ.sample_scenarios("broadcast", 62, n_nodes=n, seed=6,
+                                horizon=horizon, membership_axis=True)
+    # the resize boundary in its in-place form, crossing a live
+    # crash window: a grow block joining mid-window, a shrink block
+    # leaving mid-window
+    grow_block = SC.Scenario(spec=NemesisSpec(
+        n_nodes=n, seed=7001, crash=((2, 6, (1, 2)),),
+        join=((4, (9, 10, 11)),)))
+    shrink_block = SC.Scenario(spec=NemesisSpec(
+        n_nodes=n, seed=7003, crash=((3, 7, (2,)),),
+        leave=((5, (9, 10, 11)),)))
+    cells = cells + [grow_block, shrink_block]
+    assert len(cells) == 64
+    churn = [sum(len(ns) for _r, ns in sc.spec.join)
+             + sum(len(ns) for _r, ns in sc.spec.leave)
+             for sc in cells]
+    assert sum(1 for c in churn if c > 0) >= 16
+
+    kw = {"n_values": 24, "topology": "grid", "sync_every": 4}
+    batch = SC.ScenarioBatch(workload="broadcast",
+                             scenarios=tuple(cells), runner_kw=kw,
+                             max_recovery_rounds=32)
+    max_clear = max(sc.spec.clear_round for sc in cells)
+    tel = TM.TelemetrySpec("broadcast", rounds=max_clear + 32)
+    res = SC.run_scenario_batch(batch, mesh=mesh_1d(),
+                                telemetry_spec=tel, signatures=True)
+    assert res["n_scenarios"] == 64
+    bad = [i for i, row in enumerate(res["scenarios"])
+           if not row["ok"]]
+    # every membership-churn cell certifies ok — including the two
+    # hand-built resize blocks crossing live crash windows
+    churn_bad = [i for i in bad if churn[i] > 0]
+    assert churn_bad == [], [(i, res["scenarios"][i])
+                             for i in churn_bad]
+    assert res["scenarios"][62]["ok"] and res["scenarios"][63]["ok"]
+    # any failure is the pre-existing churn-free lossy class, with
+    # its evidence named
+    for i in bad:
+        assert not cells[i].spec.has_membership, i
+        row = res["scenarios"][i]
+        assert row["lost_writes"] or row["converged_round"] is None, i
+
+    sigs = np.asarray(res["signatures"])
+    assert sigs.shape == (64, 5)
+    for i, c in enumerate(churn):
+        want = int(TM.log2_bucket(jnp.int32(c)))
+        assert int(sigs[i, 4]) == want, (i, c)
+
+    # sequential parity: the batched driver is a bit-exact twin of
+    # run_broadcast_nemesis — pinned on a subset including BOTH
+    # resize-shaped cells and every failing cell
+    for i in sorted({0, 9, 30, 47, 62, 63} | set(bad)):
+        seq = FZ.run_sequential("broadcast", cells[i], kw, 32)
+        row = res["scenarios"][i]
+        assert row["converged_round"] == seq["converged_round"], i
+        assert row["recovery_rounds"] == seq["recovery_rounds"], i
+        assert row["msgs_total"] == seq["msgs_total"], i
+        assert row["ok"] == seq["ok"], i
+        assert row["lost_writes"] == seq["lost_writes"], i
+
+
+# -- fuzzer membership axis: sampler, weights, shrinker moves ------------
+
+
+def test_membership_sampler_is_seeded_and_bounded():
+    a = FZ.sample_scenarios("broadcast", 24, n_nodes=10, seed=11,
+                            horizon=6, membership_axis=True)
+    b = FZ.sample_scenarios("broadcast", 24, n_nodes=10, seed=11,
+                            horizon=6, membership_axis=True)
+    assert [sc.to_meta() for sc in a] == [sc.to_meta() for sc in b]
+    with_churn = [sc for sc in a if sc.spec.has_membership]
+    assert with_churn and len(with_churn) < len(a)
+    for sc in a:
+        crash_rows = {i for _s, _e, ns in sc.spec.crash for i in ns}
+        for _r, ns in sc.spec.join + sc.spec.leave:
+            assert not (set(ns) & crash_rows)
+    with pytest.raises(ValueError, match="txn"):
+        FZ.sample_scenarios("txn", 4, n_nodes=10, seed=1, horizon=6,
+                            membership_axis=True)
+
+
+def test_axis_key_has_membership_fields():
+    sc = SC.Scenario(spec=NemesisSpec(
+        n_nodes=10, seed=1, crash=((2, 5, (1,)),),
+        join=((3, (8, 9)),), leave=((9, (0,)),)))
+    key = FZ._axis_key(sc)
+    assert len(key) == 9
+    assert key[-2:] == (2, 1)
+    plain = SC.Scenario(spec=NemesisSpec(n_nodes=10, seed=1))
+    assert FZ._axis_key(plain)[-2:] == (0, 0)
+
+
+def test_shrinker_moves_drop_and_halve_membership_events():
+    sc = SC.Scenario(spec=NemesisSpec(
+        n_nodes=12, seed=1, crash=((2, 5, (1,)),),
+        join=((3, (8, 9, 10)),), leave=((20, (0, 4)),)))
+    moves = dict(FZ._shrink_moves(sc))
+    for want in ("drop join event 0", "drop leave event 0",
+                 "halve join event 0 block",
+                 "halve leave event 0 block"):
+        assert want in moves, sorted(moves)
+    w0 = FZ.scenario_weight(sc)
+    dropped = moves["drop join event 0"]
+    assert dropped.spec.join == ()
+    assert FZ.scenario_weight(dropped) < w0
+    halved = moves["halve join event 0 block"]
+    assert halved.spec.join == ((3, (8,)),)
+    assert FZ.scenario_weight(halved) < w0
+    # every move yields a valid (compilable) spec
+    for desc, red in moves.items():
+        red.spec.compile()
+        assert FZ.scenario_weight(red) < w0, desc
+
+
+# -- traffic: the resizing backpressure class ----------------------------
+
+
+def test_resizing_defer_is_counted_never_dropped():
+    tspec = T.TrafficSpec(n_nodes=4, n_clients=8, ops_per_client=2,
+                          until=4)
+    ts = T.init_state(tspec)
+    arr = jnp.ones((8,), bool)
+    ts, ok = T.resizing_defer(ts, arr, lambda x: x)
+    assert not bool(np.asarray(ok).any())
+    assert int(ts.arrived) == 8
+    assert int(ts.deferred) == 8
+    assert int(ts.deferred_resizing) == 8
+    # conservation: arrived == issued + deferred (nothing issued, no
+    # op slot consumed — the client re-offers after the boundary)
+    assert (np.asarray(ts.issued_k) == 0).all()
+    # the sub-class never exceeds its parent counter, even after
+    # ordinary issuance resumes past the boundary
+    ts2, ok2, _k = T.issue(ts, arr, jnp.ones((8,), bool), 1,
+                           lambda x: x)
+    assert bool(np.asarray(ok2).all())
+    assert int(ts2.deferred_resizing) <= int(ts2.deferred)
+
+
+# -- loud rejections on unsupported paths --------------------------------
+
+
+def test_membership_plans_rejected_loudly_everywhere():
+    mem = NemesisSpec(n_nodes=8, seed=1, join=((2, (6, 7)),))
+    with pytest.raises(ValueError, match="membership"):
+        structured.make_nemesis("grid", 8, mem)
+    tspec = T.TrafficSpec(n_nodes=8, n_clients=8, ops_per_client=2,
+                          until=4)
+    with pytest.raises(ValueError, match="membership"):
+        SV.run_serving("broadcast", tspec, nemesis=mem)
+    cell = SC.ServingCell(traffic=tspec, spec=mem)
+    sbatch = SC.ServingBatch(workload="broadcast", cells=(cell,),
+                             runner_kw={"n_values": 16,
+                                        "sync_every": 4})
+    with pytest.raises(ValueError,
+                       match="serving cell 0 carries membership"):
+        SC.run_serving_batch(sbatch)
+    tbatch = SC.ScenarioBatch(workload="txn",
+                              scenarios=(SC.Scenario(spec=mem),),
+                              runner_kw={})
+    with pytest.raises(ValueError,
+                       match="txn scenario 0 carries membership"):
+        SC.run_scenario_batch(tbatch)
+
+
+# -- pad/batch plan validation names the offending spec ------------------
+
+
+def test_pad_and_batch_plans_name_the_offender():
+    spec = NemesisSpec(n_nodes=8, seed=1,
+                       crash=((1, 3, (0,)), (4, 6, (1,))))
+    plan = spec.compile()
+    with pytest.raises(ValueError,
+                       match="spec 3 has 2 crash windows"):
+        F.pad_plan(plan, 1, where="spec 3")
+    broken = plan._replace(ends=plan.ends[:1])
+    with pytest.raises(ValueError,
+                       match="spec 7: window axes disagree"):
+        F.pad_plan(broken, 4, where="spec 7")
+    with pytest.raises(ValueError,
+                       match="n_windows=1 < the batch's widest"):
+        F.batch_plans([spec], n_windows=1)
+
+
+# -- lint / registry coverage --------------------------------------------
+
+
+@pytest.mark.parametrize("relpath,mod", [
+    (os.path.join("tpu_sim", "membership.py"), M),
+    (os.path.join("harness", "membership.py"), HM),
+])
+def test_membership_traced_host_split_is_total(relpath, mod):
+    import gossip_glomers_tpu
+    pkg = os.path.dirname(os.path.abspath(gossip_glomers_tpu.__file__))
+    src = open(os.path.join(pkg, relpath)).read()
+    tree_ = ast_mod.parse(src)
+    top_fns = {n.name for n in tree_.body
+               if isinstance(n, ast_mod.FunctionDef)}
+    declared = set(mod.TRACED_EVALUATORS) | set(mod.HOST_SIDE)
+    assert top_fns == declared, (
+        f"undeclared: {sorted(top_fns - declared)}, "
+        f"stale: {sorted(declared - top_fns)}")
+    pat = audit._root_pattern_for(relpath.replace(os.sep, "/"))
+    for name in mod.TRACED_EVALUATORS:
+        assert pat.match(name), name
+    for name in mod.HOST_SIDE:
+        assert not pat.match(name), name
+
+
+def test_membership_contracts_registered_and_audited():
+    registry = audit.default_registry()
+    names = [c.name for c in registry]
+    for expected in ("membership/sharded-census-run",
+                     "membership/membership-run-donated"):
+        assert expected in names, names
+    mesh = mesh_1d()
+    for c in registry:
+        if c.name.startswith("membership/"):
+            r = audit.audit_contract(c, mesh)
+            assert r["ok"], r

@@ -353,7 +353,7 @@ def test_latency_checker_bites_on_delayed_op():
         issued_k=np.ones((10,), np.int32), issue_round=issue,
         done_round=done, op_aux=np.full((10, 1), -1, np.int32),
         arrived=np.uint32(10), deferred=np.uint32(0),
-        completed=np.uint32(10))
+        completed=np.uint32(10), deferred_resizing=np.uint32(0))
     summ = T.latency_summary(ts)
     ok, details = check_op_latency(summ, p99_max_rounds=8)
     assert not ok
