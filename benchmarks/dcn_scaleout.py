@@ -29,6 +29,12 @@ collectives, shared physical cores (so measured speedups are lower
 bounds distorted by core contention; the analytic rows carry the
 memory-scaling claim, the measured rows carry correctness + the cost
 anchors).
+
+``--pr20`` -> BENCH_PR20.json instead: the DCN latency-hiding legs —
+the pipelined sims suite bit-exact on the real 2-process cluster, the
+``stale:k`` ladder certified by ``check_staleness_bound`` (k in
+{1, 2, 4}, every delta delivered, 1/k DCN exchanges per round), and
+the ``*/dcn-pipelined-*`` census rows.
 """
 
 from __future__ import annotations
@@ -42,6 +48,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from gossip_glomers_tpu.parallel.mesh import (  # noqa: E402
+    force_virtual_devices)
+
+force_virtual_devices(8)     # the in-process 2x4 hierarchy legs
 
 from gossip_glomers_tpu.parallel.dcn_worker import (  # noqa: E402
     spawn_local_cluster)
@@ -200,6 +211,166 @@ def measured_rows(tmp: str) -> dict:
     return out
 
 
+# -- PR 20: pipelined + stale-by-k legs -> BENCH_PR20.json ---------------
+
+
+def pipelined_cluster(tmp: str) -> dict:
+    """Measured: the REAL 2-process gloo cluster runs the sims suite
+    synchronous and under ``GG_DCN_PIPELINE=1`` — the double-buffered
+    half-block DCN circuits must stay BIT-EXACT (every digest equal)
+    while the wall clock records what loopback gloo charges for the
+    extra circuit count."""
+    sync = spawn_local_cluster("sims", tmp, n_procs=2,
+                               local_devices=2, timed=True)[0]
+    old = os.environ.get("GG_DCN_PIPELINE")
+    os.environ["GG_DCN_PIPELINE"] = "1"
+    try:
+        pipe = spawn_local_cluster("sims", tmp, n_procs=2,
+                                   local_devices=2, timed=True)[0]
+    finally:
+        if old is None:
+            del os.environ["GG_DCN_PIPELINE"]
+        else:
+            os.environ["GG_DCN_PIPELINE"] = old
+
+    def _strip(r):
+        return {k: v for k, v in r["tasks"]["sims"].items()
+                if k != "wall_s"}
+
+    return {
+        "tasks": "sims (broadcast + counter stepwise/fused/replay + "
+                 "kafka) on 2 procs x 2 devices",
+        "sync_wall_s": sync["tasks"]["sims"]["wall_s"],
+        "pipelined_wall_s": pipe["tasks"]["sims"]["wall_s"],
+        "bit_exact_across_modes": _strip(sync) == _strip(pipe),
+        "note": "the bit-exactness claim MEASURED on a real gloo "
+                "cluster: integer operands only take the half-block "
+                "decomposition, so every digest matches the fused "
+                "synchronous twin.  Wall clock on loopback gloo prices "
+                "circuit COUNT, not hidden latency — the overlap win "
+                "needs a real DCN hop (the 15.2x ICI-vs-DCN roundtime "
+                "anchor in BENCH_PR15.json is what each in-flight "
+                "half can hide behind); the audit rows "
+                "(*/dcn-pipelined-*) pin the census either way",
+    }
+
+
+def stale_ladder() -> dict:
+    """In-process k-ladder: the certified crash+loss counter campaign
+    (the smoke's seed-3 spec) at ``stale:k`` for k in {1, 2, 4} vs its
+    sync twin on the simulated 2-host hierarchy — convergence delay
+    stays within each k, no acked write is ever lost, and the
+    hosts-level exchange runs every k-th round only (a ``lax.cond``
+    branch, so skipped rounds pay ZERO DCN collectives)."""
+    import time as _time
+
+    from gossip_glomers_tpu.harness.checkers import (
+        check_staleness_bound)
+    from gossip_glomers_tpu.harness.nemesis import run_counter_nemesis
+    from gossip_glomers_tpu.parallel.mesh import pick_mesh_2d
+    from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec
+
+    mesh = pick_mesh_2d(hosts=2)
+    if mesh is None:
+        raise RuntimeError("stale ladder needs the 8-way virtual mesh")
+    spec = NemesisSpec(n_nodes=16, seed=3, crash=((1, 4, (2, 11)),),
+                       loss_rate=0.2, loss_until=5)
+
+    def run(mode):
+        t0 = _time.perf_counter()
+        res = run_counter_nemesis(spec, mode="allreduce", mesh=mesh,
+                                  max_recovery_rounds=32,
+                                  dcn_mode=mode)
+        return res, round(_time.perf_counter() - t0, 3)
+
+    sync, sync_wall = run("sync")
+    rows, all_ok = [], bool(sync["ok"])
+    for k in (1, 2, 4):
+        res, wall = run(f"stale:{k}")
+        ok, d = check_staleness_bound(
+            stale_k=k,
+            sync_converged_round=sync["converged_round"],
+            stale_converged_round=res["converged_round"],
+            lost_writes=res.get("lost_writes", []),
+            recovery=(res["ok"], {"converged_round":
+                                  res["converged_round"]}))
+        all_ok = all_ok and ok
+        rows.append({
+            "stale_k": k,
+            "converged_round": res["converged_round"],
+            "delay_rounds": d["delay_rounds"],
+            "bound_round": d["bound_round"],
+            "dcn_exchanges_per_round": round(1.0 / k, 3),
+            "kv": res["kv"], "acked_sum": res["acked_sum"],
+            "lost_writes": res["n_lost_writes"],
+            "certified": bool(ok),
+            "campaign_wall_s": wall,
+        })
+    return {
+        "spec": spec.to_meta(),
+        "sync": {"converged_round": sync["converged_round"],
+                 "kv": sync["kv"], "acked_sum": sync["acked_sum"],
+                 "campaign_wall_s": sync_wall},
+        "rows": rows,
+        "all_certified": all_ok,
+        "note": "simulated 2-host hierarchy in ONE process: the "
+                "hosts axis costs the same as ICI here, so "
+                "campaign_wall_s carries no DCN-latency signal — the "
+                "claim is structural (the stale exchange is a "
+                "lax.cond branch: k-1 of every k rounds run ZERO "
+                "hosts-level collectives) and priced by the PR-15 "
+                "15.2x DCN-vs-ICI roundtime anchor; k=1 is the "
+                "synchronous cadence twin (delay 0 by construction)",
+    }
+
+
+def pipelined_census() -> dict:
+    """Structural: the ``*/dcn-pipelined-*`` audit rows — same
+    collective census caps and donation as their sync siblings, the
+    host-crossing-gather gate still clean."""
+    from gossip_glomers_tpu.tpu_sim import audit as A
+    from gossip_glomers_tpu.tpu_sim import dcn
+
+    rows = {}
+    ok = True
+    for row in dcn.audit_contracts():
+        if "pipelined" not in row.name:
+            continue
+        res = A.audit_contract(row, mesh=None)
+        ok = ok and bool(res["ok"])
+        rows[row.name] = {
+            "ok": bool(res["ok"]),
+            "collectives": res["checks"]["collectives"]["counts"],
+            "dcn_gather_clean": bool(
+                res["checks"]["dcn"]["checked"]
+                and res["ok"]),
+        }
+    return {"rows": rows, "all_ok": ok,
+            "note": "the pipelined twins rebind their sync siblings' "
+                    "build closures under GG_DCN_PIPELINE=1 — caps, "
+                    "donation and the per-host memory band carry "
+                    "over, and no replica group crosses a host block"}
+
+
+def main_pr20() -> int:
+    report = {"benchmark": "dcn_latency_hiding_pr20", "backend": "cpu",
+              "pipelined_census": pipelined_census(),
+              "stale_ladder": stale_ladder()}
+    with tempfile.TemporaryDirectory() as tmp:
+        report["pipelined_cluster"] = pipelined_cluster(tmp)
+    ok = (report["pipelined_census"]["all_ok"]
+          and report["stale_ladder"]["all_certified"]
+          and report["pipelined_cluster"]["bit_exact_across_modes"])
+    report["ok"] = bool(ok)
+    path = os.path.join(REPO, "BENCH_PR20.json")
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    print(json.dumps(report, indent=1))
+    print(f"wrote {path}  ok={ok}")
+    return 0 if ok else 1
+
+
 def main() -> int:
     report = {"benchmark": "dcn_scaleout_pr15", "backend": "cpu",
               "broadcast_scale": broadcast_scale(),
@@ -225,4 +396,5 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    raise SystemExit(main_pr20() if "--pr20" in sys.argv[1:]
+                     else main())

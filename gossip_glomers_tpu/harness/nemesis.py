@@ -161,7 +161,8 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                           structured: "bool | str" = False,
                           traffic=None, telemetry=None,
                           provenance=None,
-                          observe_dir=None) -> dict:
+                          observe_dir=None,
+                          dcn_mode: str | None = None) -> dict:
     """Broadcast under the full nemesis (crash/loss/dup from ``spec``,
     plus an optional partition schedule): values injected round-robin
     at round 0, convergence = every node holds every value.  A lost
@@ -234,6 +235,8 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                 // 32) == "structured")
         sim_kw = dict(topology=topology, sync_every=sync_every,
                       structured=bool(structured))
+        if dcn_mode is not None:
+            sim_kw["dcn_mode"] = dcn_mode
         if delays is not None:
             # gather-path per-edge delays under open-loop traffic:
             # forwarded as JSON-able lists so a serving flight bundle
@@ -281,7 +284,7 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                        sync_every=sync_every, parts=parts,
                        delays=delays,
                        fault_plan=spec.compile(), srv_ledger=False,
-                       mesh=mesh, **kw)
+                       mesh=mesh, dcn_mode=dcn_mode, **kw)
     inject = make_inject(n, nv)
     if spec.has_membership:
         # a value is acked where it is INJECTED: pre-join rows stage
@@ -373,6 +376,10 @@ def run_broadcast_nemesis(spec: NemesisSpec, *, n_values: int | None = None,
                              else delays.tolist()),
                      dir_delays=(None if dir_delays is None
                                  else list(dir_delays)))
+    if dcn_mode is not None:
+        # only when set: older flight bundles stay byte-identical,
+        # and a replay re-runs the campaign under the SAME DCN mode
+        runner_kw["dcn_mode"] = dcn_mode
     ok = _finish_observed(
         ok, details, tel, tel_spec, msgs_total=int(state.msgs),
         observe_dir=observe_dir, workload="broadcast", spec=spec,
@@ -386,7 +393,8 @@ def run_counter_nemesis(spec: NemesisSpec, *,
                         max_recovery_rounds: int = 64,
                         union_block: "int | str | None" = None,
                         mesh=None, traffic=None, telemetry=None,
-                        provenance=None, observe_dir=None) -> dict:
+                        provenance=None, observe_dir=None,
+                        dcn_mode: str | None = None) -> dict:
     """G-counter under the nemesis: per-node deltas acked at round 0,
     convergence = pending fully drained AND every node's cached read
     equals the KV.  Lost acknowledged writes = the final shortfall
@@ -405,11 +413,14 @@ def run_counter_nemesis(spec: NemesisSpec, *,
     if traffic is not None:
         from . import serving
         _no_traffic_provenance(provenance)
+        sim_kw = dict(mode=mode, poll_every=poll_every,
+                      union_block=union_block)
+        if dcn_mode is not None:
+            sim_kw["dcn_mode"] = dcn_mode
         return serving.run_serving(
             "counter", traffic, nemesis=spec, mesh=mesh,
             max_recovery_rounds=max_recovery_rounds,
-            sim_kw=dict(mode=mode, poll_every=poll_every,
-                        union_block=union_block),
+            sim_kw=sim_kw,
             telemetry=telemetry, observe_dir=observe_dir)
     n = spec.n_nodes
     if deltas is None:
@@ -423,7 +434,8 @@ def run_counter_nemesis(spec: NemesisSpec, *,
     acked_sum = int(np.sum(deltas))
     sim = CounterSim(n, mode=mode, poll_every=poll_every,
                      fault_plan=spec.compile(),
-                     union_block=union_block, mesh=mesh)
+                     union_block=union_block, mesh=mesh,
+                     dcn_mode=dcn_mode)
     state = sim.add(sim.init_state(), deltas)
     clear = spec.clear_round
     members_c = spec.host_members(clear)
@@ -445,15 +457,24 @@ def run_counter_nemesis(spec: NemesisSpec, *,
                                  prov_spec=prov_spec), tel, prov)
     msgs_at_clear = int(state.msgs)
 
-    def converged(s) -> bool:
-        if int(np.sum(np.asarray(s.pending))) != 0:
-            return False
-        reads_ok = np.asarray(sim.reads(s)) == sim.kv_value(s)
+    # the (N,) rows may span processes on a REAL DCN cluster (the
+    # PR-20 worker's stale task): reduce to a replicated scalar ON
+    # DEVICE instead of fetching the global array to host — members_c
+    # is a host constant, so it inlines into the jitted predicate
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _conv_pred(pending, cached, kv):
+        reads_ok = (cached == kv) | ~jnp.asarray(members_c)
         # only MEMBER rows must re-poll to the KV value (the host
         # twin of counter._batch_converged's member mask); pending
         # stays summed over ALL rows — non-member residue would be a
         # real undrained delta
-        return bool(np.all(reads_ok | ~members_c))
+        return (jnp.sum(pending) == 0) & jnp.all(reads_ok)
+
+    def converged(s) -> bool:
+        return bool(_conv_pred(s.pending, s.cached, s.kv))
 
     converged_round = clear if converged(state) else None
     while converged_round is None \
@@ -467,7 +488,7 @@ def run_counter_nemesis(spec: NemesisSpec, *,
         if converged(state):
             converged_round = int(state.t)
     shortfall = acked_sum - sim.kv_value(state) \
-        - int(np.sum(np.asarray(state.pending)))
+        - int(jax.jit(jnp.sum)(state.pending))
     lost = ([{"lost_sum": shortfall}] if shortfall != 0 else [])
     ok, details = check_recovery(
         clear_round=clear, converged_round=converged_round,
@@ -486,6 +507,10 @@ def run_counter_nemesis(spec: NemesisSpec, *,
                      poll_every=poll_every,
                      max_recovery_rounds=max_recovery_rounds,
                      union_block=union_block)
+    if dcn_mode is not None:
+        # only when set: older flight bundles stay byte-identical,
+        # and a replay re-runs the campaign under the SAME DCN mode
+        runner_kw["dcn_mode"] = dcn_mode
     ok = _finish_observed(
         ok, details, tel, tel_spec, msgs_total=int(state.msgs),
         observe_dir=observe_dir, workload="counter", spec=spec,
@@ -557,7 +582,8 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
                       commits: bool = True,
                       send_prob: float = 0.7,
                       mesh=None, traffic=None, telemetry=None,
-                      provenance=None, observe_dir=None) -> dict:
+                      provenance=None, observe_dir=None,
+                      dcn_mode: str | None = None) -> dict:
     """Replicated log under the nemesis: seeded send/commit traffic at
     live nodes through the faulted phase, then quiescent recovery.
     Convergence = every node's presence bitset identical (the periodic
@@ -596,14 +622,17 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
     if traffic is not None:
         from . import serving
         _no_traffic_provenance(provenance)
+        sim_kw = dict(n_keys=n_keys, capacity=capacity,
+                      max_sends=max_sends,
+                      resync_every=resync_every,
+                      resync_mode=resync_mode,
+                      union_block=union_block)
+        if dcn_mode is not None:
+            sim_kw["dcn_mode"] = dcn_mode
         return serving.run_serving(
             "kafka", traffic, nemesis=spec, mesh=mesh,
             max_recovery_rounds=max_recovery_rounds,
-            sim_kw=dict(n_keys=n_keys, capacity=capacity,
-                        max_sends=max_sends,
-                        resync_every=resync_every,
-                        resync_mode=resync_mode,
-                        union_block=union_block),
+            sim_kw=sim_kw,
             telemetry=telemetry, observe_dir=observe_dir)
     n = spec.n_nodes
     clear = max(spec.clear_round, rounds or 0)
@@ -619,7 +648,8 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
     sim = KafkaSim(n, n_keys, capacity=capacity, max_sends=max_sends,
                    fault_plan=spec.compile(), resync_every=resync_every,
                    resync_mode=resync_mode, repl_fast=repl_fast,
-                   union_block=union_block, mesh=mesh)
+                   union_block=union_block, mesh=mesh,
+                   dcn_mode=dcn_mode)
     tel_spec = observe.telemetry_setup(
         telemetry, "kafka", clear + max_recovery_rounds)
     tel = (sim.telemetry_state(tel_spec) if tel_spec is not None
@@ -707,6 +737,10 @@ def run_kafka_nemesis(spec: NemesisSpec, *, n_keys: int = 4,
                      rounds=rounds, repl_fast=repl_fast,
                      union_block=union_block, commits=commits,
                      send_prob=send_prob)
+    if dcn_mode is not None:
+        # only when set: older flight bundles stay byte-identical,
+        # and a replay re-runs the campaign under the SAME DCN mode
+        runner_kw["dcn_mode"] = dcn_mode
     ok = _finish_observed(
         ok, details, tel, tel_spec, msgs_total=int(state.msgs),
         observe_dir=observe_dir, workload="kafka", spec=spec,

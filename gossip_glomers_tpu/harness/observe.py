@@ -665,7 +665,7 @@ def replay_divergence(bundle: dict, result: dict) -> int | None:
     return min(cands) if cands else None
 
 
-def replay_bundle(path_or_dict, *, telemetry=False) -> dict:
+def replay_bundle(path_or_dict, *, telemetry=False, mesh=None) -> dict:
     """Re-run a flight bundle's scenario from its own JSON alone and
     return the fresh verdict dict — the repro contract: every run is
     a pure function of its seeded specs (and sim results are pinned
@@ -678,7 +678,14 @@ def replay_bundle(path_or_dict, *, telemetry=False) -> dict:
     (:func:`replay_divergence`), and reports
     ``result['first_divergence_round']`` — None when the replay is
     bit-faithful (the deterministic-replay contract), else the
-    earliest diverging round (the shrinker signal)."""
+    earliest diverging round (the shrinker signal).
+
+    ``mesh`` (PR 20): the mesh to replay on.  Results are pinned
+    bit-exact across layouts so the default (unsharded) is normally
+    fine — but a bundle whose ``runner_kw`` carries a ``stale:<k>``
+    ``dcn_mode`` NEEDS a hierarchical mesh: bounded staleness only
+    exists across a DCN level, and the sims refuse it loudly
+    anywhere else, so pass ``pick_mesh_2d()`` to replay those."""
     from ..tpu_sim.faults import NemesisSpec
     from ..tpu_sim.traffic import TrafficSpec
     from . import nemesis as NM
@@ -700,7 +707,7 @@ def replay_bundle(path_or_dict, *, telemetry=False) -> dict:
         result = SV.run_serving(
             bundle["workload"], TrafficSpec.from_meta(bundle["traffic"]),
             nemesis=spec, sim_kw=bundle.get("sim_kw") or {},
-            telemetry=telemetry, **kw)
+            telemetry=telemetry, mesh=mesh, **kw)
     else:
         from . import txn as TXH
         runners = {"broadcast": NM.run_broadcast_nemesis,
@@ -716,7 +723,7 @@ def replay_bundle(path_or_dict, *, telemetry=False) -> dict:
             kw["provenance"] = PV.ProvenanceSpec.from_meta(
                 bundle["provenance_spec"])
         result = runners[bundle["workload"]](spec, telemetry=telemetry,
-                                             **kw)
+                                             mesh=mesh, **kw)
     if has_record:
         result["first_divergence_round"] = replay_divergence(bundle,
                                                              result)

@@ -31,6 +31,17 @@ Tasks:
                 flood at a serving-scale shape — the ICI-vs-DCN
                 cost-model anchor (timing is per-rank and NOT part of
                 the bit-exact surface; the state digest still is).
+                ``GG_DCN_RT_N`` / ``GG_DCN_RT_NV`` override the shape
+                (the PR-20 benchmark's w=128 leg).
+- ``pipelined`` the ``sims`` body re-run under ``GG_DCN_PIPELINE=1``
+                (PR 20): the cluster compiles the double-buffered
+                half-block DCN circuits and every digest must still
+                equal the synchronous flat twin's bit-for-bit.
+- ``stale``     counter allreduce crash+loss at ``stale:2`` vs its
+                sync twin, certified by ``check_staleness_bound``
+                (PR 20).  Needs the hierarchical mesh — the smoke's
+                twin runs THIS task on ``pick_mesh_2d``, not the flat
+                parity mesh.
 
 ``GG_DCN_TIME=1`` adds per-task ``wall_s`` to each report (for the
 throughput benchmark; timing differs across ranks, so the parity
@@ -227,7 +238,8 @@ def _task_roundtime(mesh) -> dict:
     from ..tpu_sim.timing import discover_rounds
     from .topology import to_padded_neighbors, tree
 
-    n, nv = 65536, 32
+    n = int(os.environ.get("GG_DCN_RT_N") or 65536)
+    nv = int(os.environ.get("GG_DCN_RT_NV") or 32)
     sharded = None
     if mesh is not None:
         sharded = S.make_sharded_exchange(
@@ -251,9 +263,67 @@ def _task_roundtime(mesh) -> dict:
             "state": state_digest(out)}
 
 
+def _task_pipelined(mesh) -> dict:
+    """The ``sims`` parity body with DCN round pipelining ON (PR 20):
+    the env contract is pinned in-process so every sim constructor
+    resolves the pipelined mode and the cluster compiles the
+    double-buffered half-block DCN circuits.  Integer operands make
+    pipelining bit-exact, and on the 1-host flat twin the mode is a
+    structural no-op — so cluster-vs-twin digest equality IS the
+    latency-hiding-without-semantic-drift claim."""
+    old = os.environ.get("GG_DCN_PIPELINE")
+    os.environ["GG_DCN_PIPELINE"] = "1"
+    try:
+        return _task_sims(mesh)
+    finally:
+        if old is None:
+            os.environ.pop("GG_DCN_PIPELINE", None)
+        else:
+            os.environ["GG_DCN_PIPELINE"] = old
+
+
+def _task_stale(mesh) -> dict:
+    """Bounded staleness on a REAL cluster (PR 20): the counter
+    allreduce crash+loss campaign runs once synchronous and once at
+    ``stale:4`` — cross-host partials ride the staleness carry, lag
+    at most 4 rounds (this seeded spec lands a REAL nonzero delay:
+    the last drained deltas wait for a refresh round), and every
+    acked delta still lands — certified by ``check_staleness_bound``
+    against the sync twin.  Every reported number is a replicated
+    scalar, so rank-vs-rank and cluster-vs-``pick_mesh_2d``-twin
+    equality is bit-exactness."""
+    from ..harness.checkers import check_staleness_bound
+    from ..harness.nemesis import run_counter_nemesis
+    from ..tpu_sim.faults import NemesisSpec
+
+    spec = NemesisSpec(n_nodes=16, seed=3, crash=((1, 4, (2, 11)),),
+                       loss_rate=0.2, loss_until=5)
+    runs = {}
+    for label, dcn in (("sync", "sync"), ("stale", "stale:4")):
+        runs[label] = run_counter_nemesis(
+            spec, mode="allreduce", mesh=mesh,
+            max_recovery_rounds=32, dcn_mode=dcn)
+    ok, details = check_staleness_bound(
+        stale_k=4,
+        sync_converged_round=runs["sync"]["converged_round"],
+        stale_converged_round=runs["stale"]["converged_round"],
+        lost_writes=runs["stale"]["lost_writes"],
+        recovery=(runs["stale"]["ok"],
+                  {"converged_round": runs["stale"]["converged_round"],
+                   "kv": int(runs["stale"]["kv"])}))
+    return {"ok": bool(ok),
+            "sync_round": runs["sync"]["converged_round"],
+            "stale_round": runs["stale"]["converged_round"],
+            "delay_rounds": details["delay_rounds"],
+            "bound_round": details["bound_round"],
+            "kv": int(runs["stale"]["kv"]),
+            "acked_sum": int(runs["stale"]["acked_sum"])}
+
+
 TASKS = {"sims": _task_sims, "batch": _task_batch,
          "certify": _task_certify, "takeover": _task_takeover,
-         "roundtime": _task_roundtime}
+         "roundtime": _task_roundtime, "pipelined": _task_pipelined,
+         "stale": _task_stale}
 
 
 def run_tasks(tasks, mesh) -> dict:
@@ -345,7 +415,8 @@ def main(argv=None) -> int:
     from .mesh import (force_virtual_devices, init_distributed,
                        pick_mesh_2d)
 
-    if not init_distributed():
+    distributed = init_distributed()
+    if not distributed:
         # single-process run (GG_NUM_PROCS absent or 1): the device
         # split still applies, so a 1-host twin can match a cluster's
         # per-host device count exactly
@@ -357,6 +428,15 @@ def main(argv=None) -> int:
     from ..utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
+    # NOTE the gloo transport pairs same-clique collectives in POSTING
+    # order with no tags, and parallel computations always dispatch
+    # asynchronously on the CPU client (jax_cpu_enable_async_dispatch
+    # governs non-parallel programs only — flipping it does NOT
+    # serialize these).  The one host-thread collective that used to
+    # race the in-flight round programs — device_put's hidden
+    # multi-host assert_equal broadcast — is gone: sims place host
+    # data via parallel.mesh.shard_put, which builds the addressable
+    # shards collective-free.
 
     tasks = [t for t in os.environ.get("GG_DCN_TASKS",
                                        "sims").split(",") if t]

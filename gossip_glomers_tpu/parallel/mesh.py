@@ -194,3 +194,38 @@ def pick_mesh_2d(hosts: int | None = None, max_axis: int | None = None,
     if hosts * per <= 1:
         return None
     return Mesh(np.array([r[:per] for r in rows]), axis_names)
+
+
+def shard_put(x, sharding=None):
+    """``jax.device_put`` of host data WITHOUT the hidden multi-host
+    collective.
+
+    On a multi-process backend, ``device_put`` of an uncommitted array
+    onto a non-fully-addressable sharding first runs
+    ``multihost_utils.assert_equal`` — a full-clique broadcast posted
+    from the HOST thread.  Parallel computations are always dispatched
+    asynchronously on the CPU client (the ``jax_cpu_enable_async_
+    dispatch`` knob applies to non-parallel programs only), so that
+    assert broadcast races whatever program collectives are still being
+    posted by the executor threads; the gloo transport pairs same-clique
+    ops in posting order with no tags, and a cross-paired pair of
+    different sizes aborts the run with a preamble-size mismatch
+    (observed nondeterministically on the 2-process CI cluster once the
+    PR-20 pipelined rows widened the in-flight window).
+
+    SPMD host code passes the same value on every process by
+    construction, so the assert buys nothing here: build the
+    addressable shards directly via ``make_array_from_callback`` — the
+    same committed result, zero collectives.  Single-process (or a
+    fully-addressable sharding, or traced values) defers to plain
+    ``device_put`` — tier-1 behavior is bit-identical."""
+    import jax
+
+    if (sharding is None or int(jax.process_count()) == 1
+            or getattr(sharding, "is_fully_addressable", True)
+            or isinstance(x, jax.core.Tracer)):
+        return (jax.device_put(x) if sharding is None
+                else jax.device_put(x, sharding))
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])

@@ -40,15 +40,17 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .engine import shard_put
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import faults, provenance, telemetry, traffic
-from .engine import (collectives, donate_argnums_for, fori_rounds,
-                     host_view, jit_program, node_axes, node_shards,
-                     resolve_block, scan_blocks, shard_map,
-                     stepwise_converge, unpack_bits, while_converge,
-                     windows_fold)
+from .engine import (collectives, dcn_psum, donate_argnums_for,
+                     fori_rounds, host_view, jit_program, node_axes,
+                     node_shards, resolve_block, resolve_dcn_mode,
+                     scan_blocks, shard_map, stepwise_converge,
+                     unpack_bits, while_converge, windows_fold)
 from .structured import _take_delayed
 
 WORD = 32
@@ -928,6 +930,7 @@ class BroadcastSim:
                  fault_plan: "faults.FaultPlan | None" = None,
                  nemesis=None,
                  union_block: "int | str | None" = None,
+                 dcn_mode: "str | None" = None,
                  ) -> None:
         """``srv_ledger``: keep the reference-accounted server-message
         ledger (default).  It costs a second adjacency pass per round
@@ -1035,6 +1038,21 @@ class BroadcastSim:
         self.n_words = num_words(n_values)
         self.sync_every = sync_every
         self.mesh = mesh
+        # -- DCN mode (PR 20): sync (default) or pipelined; broadcast's
+        # delivery plane is halo/widen exchange + srv-ledger
+        # calibration, so bounded staleness is undecided here — refuse.
+        self._dcn = resolve_dcn_mode(dcn_mode)
+        if self._dcn.stale_k:
+            raise ValueError(
+                f"dcn_mode={self._dcn.label()!r}: broadcast has no "
+                "certified staleness semantics — its delivery plane is "
+                "the halo/widen exchange and the srv ledger calibrates "
+                "against synchronous round accounting; run sync or "
+                "pipelined")
+        # mode-aware all-axes psum for the inline ledger/convergence
+        # reduce sites (the rounds that take a bare psum closure
+        # instead of a Collectives)
+        self._dcn_psum = dcn_psum(mesh, self._dcn)
         self.parts = parts if parts is not None else Partitions.none(n)
         self.exchange = exchange
         # halo path: local-block -> local-block delivery via ppermute
@@ -1361,7 +1379,7 @@ class BroadcastSim:
             # keep it host-side (at 1M nodes it is ~6x the bitset state)
             self.nbrs = None
             self.nbr_mask = None
-            self.deg = (jax.device_put(jnp.asarray(deg),
+            self.deg = (shard_put(jnp.asarray(deg),
                                        NamedSharding(mesh, P(na)))
                         if mesh is not None else jnp.asarray(deg))
             if self._edge is not None:
@@ -1371,7 +1389,7 @@ class BroadcastSim:
                 rows = jnp.asarray(self._edge.delay_rows, jnp.int32)
                 if mesh is not None:
                     self._ed_spec = P(None, na)
-                    rows = jax.device_put(
+                    rows = shard_put(
                         rows, NamedSharding(mesh, self._ed_spec))
                 self._ed_rows = rows
                 if self._ef:
@@ -1384,11 +1402,11 @@ class BroadcastSim:
                     if mesh is not None:
                         e_spec = P(None, na)
                         s_spec = P(None, None, na)
-                        e2 = jax.device_put(
+                        e2 = shard_put(
                             e2, NamedSharding(mesh, e_spec))
-                        s2 = jax.device_put(
+                        s2 = shard_put(
                             s2, NamedSharding(mesh, s_spec))
-                        d2 = jax.device_put(
+                        d2 = shard_put(
                             d2, NamedSharding(mesh, s_spec))
                         self._ef_specs = (e_spec, s_spec, s_spec)
                     self._ef_arrs = (e2, s2, d2)
@@ -1401,7 +1419,7 @@ class BroadcastSim:
                     self._nem_specs = faults.wm_specs(
                         self._nem.sharded_exchange is not None, na)
                     arrs = faults.WMNemesisArrays(
-                        *(jax.device_put(a, NamedSharding(mesh, s))
+                        *(shard_put(a, NamedSharding(mesh, s))
                           for a, s in zip(arrs, self._nem_specs)))
                 self._nem_arrs = arrs
             masked_src = (self._faulted if self._faulted is not None
@@ -1419,18 +1437,18 @@ class BroadcastSim:
                     else:
                         e_spec = P(None, None)
                         s_spec = P(None, None, None)
-                    ex = jax.device_put(ex, NamedSharding(mesh, e_spec))
-                    sm = jax.device_put(sm, NamedSharding(mesh, s_spec))
+                    ex = shard_put(ex, NamedSharding(mesh, e_spec))
+                    sm = shard_put(sm, NamedSharding(mesh, s_spec))
                     self._f_specs = (e_spec, s_spec)
                 self._f_exists, self._f_same = ex, sm
         elif mesh is not None:
             node_sh = NamedSharding(mesh, P(na, None))
-            self.nbrs = jax.device_put(jnp.asarray(nbrs, jnp.int32), node_sh)
-            self.nbr_mask = jax.device_put(jnp.asarray(nbr_mask), node_sh)
-            self.deg = jax.device_put(jnp.asarray(deg),
+            self.nbrs = shard_put(jnp.asarray(nbrs, jnp.int32), node_sh)
+            self.nbr_mask = shard_put(jnp.asarray(nbr_mask), node_sh)
+            self.deg = shard_put(jnp.asarray(deg),
                                       NamedSharding(mesh, P(na)))
             if self.delays is not None:
-                self.delays = jax.device_put(self.delays, node_sh)
+                self.delays = shard_put(self.delays, node_sh)
         else:
             self.nbrs = jnp.asarray(nbrs, jnp.int32)
             self.nbr_mask = jnp.asarray(nbr_mask)
@@ -1445,7 +1463,7 @@ class BroadcastSim:
             arr = np.ascontiguousarray(arr.T)
         received = jnp.asarray(arr)
         if self.mesh is not None:
-            received = jax.device_put(
+            received = shard_put(
                 received, NamedSharding(self.mesh, self._state_spec))
         # frontier starts equal to received but must be a DISTINCT
         # buffer: the donation-first drivers (engine.py) donate the
@@ -1461,7 +1479,7 @@ class BroadcastSim:
             history = jnp.zeros(
                 (self.ring, self.n_words, self.n_nodes), jnp.uint32)
             if self.mesh is not None:
-                history = jax.device_put(
+                history = shard_put(
                     history,
                     NamedSharding(self.mesh,
                                   P(None, *self._state_spec)))
@@ -1474,7 +1492,7 @@ class BroadcastSim:
             history = jnp.zeros(
                 (self.ring, self.n_nodes, self.n_words), jnp.uint32)
             if self.mesh is not None:
-                history = jax.device_put(
+                history = shard_put(
                     history,
                     NamedSharding(self.mesh,
                                   P(None, *self._state_spec)))
@@ -1517,7 +1535,7 @@ class BroadcastSim:
             state, row_ids=row_ids, nbrs=nbrs, nbr_mask=nbr_mask,
             parts=parts, sync_every=self.sync_every,
             widen=lambda p: lax.all_gather(p, self._na, axis=0, tiled=True),
-            reduce_sum=lambda s: lax.psum(s, mesh_axes),
+            reduce_sum=self._dcn_psum,
             delays=delays, delay_set=self._delay_set,
             sync_base_once=sync_base_once, plan=plan,
             dup_on=self._fp_dup,
@@ -1568,7 +1586,7 @@ class BroadcastSim:
         f = self._faulted
         if self._nem is not None:
             arrs, pstarts, pends, plan = masks
-            psum = lambda s: lax.psum(s, mesh_axes)  # noqa: E731
+            psum = self._dcn_psum
             if self._nem.sharded_exchange is not None:
                 # halo path: masks arrive node-sharded, every mask
                 # application is local, delivery is O(block) ppermutes
@@ -1603,7 +1621,7 @@ class BroadcastSim:
             return _round_wm(
                 state, deg=deg, sync_every=self.sync_every,
                 exchange=self.exchange,
-                reduce_sum=lambda s: lax.psum(s, mesh_axes),
+                reduce_sum=self._dcn_psum,
                 live_rows=self._live_rows(e2, s2, ps, pe),
                 sync_diff=self._edge.sharded_sync_diff,
                 sync_base_once=sync_base_once,
@@ -1617,7 +1635,7 @@ class BroadcastSim:
             return _round_wm(
                 state, deg=deg, sync_every=self.sync_every,
                 exchange=self.exchange,
-                reduce_sum=lambda s: lax.psum(s, mesh_axes),
+                reduce_sum=self._dcn_psum,
                 sync_diff=self.sharded_sync_diff,
                 sync_base_once=sync_base_once,
                 delayed_exchange=lambda h, t: eex(h, t, rows))
@@ -1629,7 +1647,7 @@ class BroadcastSim:
                 return _round_wm(
                     state, deg=deg, sync_every=self.sync_every,
                     exchange=self.exchange,
-                    reduce_sum=lambda s: lax.psum(s, mesh_axes),
+                    reduce_sum=self._dcn_psum,
                     live_rows=lr,
                     sync_diff=self._delayed.sharded_sync_diff,
                     sync_base_once=sync_base_once,
@@ -1637,7 +1655,7 @@ class BroadcastSim:
             return _round_wm(
                 state, deg=deg, sync_every=self.sync_every,
                 exchange=self.exchange,
-                reduce_sum=lambda s: lax.psum(s, mesh_axes),
+                reduce_sum=self._dcn_psum,
                 sync_diff=self.sharded_sync_diff,
                 sync_base_once=sync_base_once,
                 delayed_exchange=self._delayed.sharded_exchange)
@@ -1653,7 +1671,7 @@ class BroadcastSim:
                 state, deg=deg, sync_every=self.sync_every,
                 exchange=(f.sharded_exchange if masks is not None
                           else self.sharded_exchange),
-                reduce_sum=lambda s: lax.psum(s, mesh_axes),
+                reduce_sum=self._dcn_psum,
                 sync_diff=(f.sharded_sync_diff if masks is not None
                            else self.sharded_sync_diff),
                 sync_base_once=sync_base_once, live_rows=live_rows)
@@ -1664,7 +1682,7 @@ class BroadcastSim:
             exchange=(f.exchange if masks is not None
                       else self.exchange),
             widen=lambda p: lax.all_gather(p, self._na, axis=1, tiled=True),
-            reduce_sum=lambda s: lax.psum(s, mesh_axes),
+            reduce_sum=self._dcn_psum,
             local_slice=lambda x: lax.dynamic_slice_in_dim(
                 x, start, block, axis=1),
             live_rows=live_rows,
@@ -1953,13 +1971,12 @@ class BroadcastSim:
         mesh = self.mesh
         state_spec, node_spec, part_spec = self._specs()
         target_spec = (P("words") if "words" in mesh.axis_names else P())
-        axes = tuple(mesh.axis_names)
         n_shards = int(np.prod(mesh.devices.shape))
 
         def converge(state, target, one_round):
             def all_converged(s: BroadcastState) -> jnp.ndarray:
                 ok_local = eq_target(s, target)
-                return (lax.psum(ok_local.astype(jnp.int32), axes)
+                return (self._dcn_psum(ok_local.astype(jnp.int32))
                         == n_shards)
 
             return while_converge(one_round, all_converged, state,
@@ -2069,7 +2086,7 @@ class BroadcastSim:
             # degrees come from the host copy: a device readback here
             # would flip the tunnel session (see timing.py)
             degs, mask_arrays = _degree_masks(self._host_deg)
-            masks = [jax.device_put(m) for m in mask_arrays]
+            masks = [shard_put(m) for m in mask_arrays]
             loop_fn = jax.jit(_flood_loop(self.exchange, rounds),
                               donate_argnums=dn2)
 
@@ -2113,10 +2130,9 @@ class BroadcastSim:
             # popcounts; frontier ⊆ received bitwise, so per-shard
             # partial sums subtract safely in uint32)
             st_spec = self._state_spec
-            axes = tuple(mesh.axis_names)
             degs, mask_arrays = _degree_masks(self._host_deg)
             mask_spec = P(None, self._na)
-            masks = [jax.device_put(m, NamedSharding(mesh, mask_spec))
+            masks = [shard_put(m, NamedSharding(mesh, mask_spec))
                      for m in mask_arrays]
 
             loop_fn = jax.jit(functools.partial(
@@ -2135,7 +2151,7 @@ class BroadcastSim:
             )
             def ledger_fn(state: BroadcastState, rec, fr, *ms):
                 return _flood_ledger(state, rec, fr, degs, ms, rounds,
-                                     lambda s: lax.psum(s, axes))
+                                     self._dcn_psum)
 
             return self._wire_flood_parts(loop_fn, ledger_fn, masks)
 
@@ -2334,7 +2350,6 @@ class BroadcastSim:
         tel_in = (telemetry.state_specs(),) if tl else ()
         prov_in = ((provenance.broadcast_specs(self._na),)
                    if pv else ())
-        axes = tuple(mesh.axis_names)
 
         if wm:
             extra_specs, extra_args = self._wm_mesh_extra()
@@ -2348,7 +2363,7 @@ class BroadcastSim:
             )
             def run_wm(state: BroadcastState, tel, n, deg, *masks):
                 plan = masks[3] if has_nem else None
-                rs = lambda s: lax.psum(s, axes)   # noqa: E731
+                rs = self._dcn_psum
                 one = mk_one(
                     lambda s, p: self._sharded_round_wm(
                         s, deg, masks or None), plan, rs)
@@ -2383,7 +2398,7 @@ class BroadcastSim:
             a = a[4:]
             delays_ = a.pop(0) if self.delays is not None else None
             plan = a[0] if a else None
-            rs = lambda s: lax.psum(s, axes)       # noqa: E731
+            rs = self._dcn_psum
             one = mk_one(
                 lambda s, p: self._sharded_round(
                     s, nbrs, nbr_mask, parts_, delays_, plan,
@@ -2413,7 +2428,7 @@ class BroadcastSim:
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(self._na, None))
             prov = provenance.BroadcastProv(
-                *(jax.device_put(a, sh) for a in prov))
+                *(shard_put(a, sh) for a in prov))
         return prov
 
     def run_observed(self, state: BroadcastState, tel, tspec,
@@ -2666,7 +2681,8 @@ class BroadcastSim:
                 ts, n, tplan, deg = (rest[0], rest[1], rest[2],
                                      rest[3])
                 masks = tuple(rest[4:])
-                coll = collectives(state.received.shape[1], mesh)
+                coll = collectives(state.received.shape[1], mesh,
+                                   dcn=self._dcn)
                 plan = masks[3] if has_nem else None
                 body = mk_body(
                     lambda s: self._sharded_round_wm(
@@ -2700,7 +2716,8 @@ class BroadcastSim:
                 delays_ = (rest.pop(0) if self.delays is not None
                            else None)
                 fp = tuple(rest)
-                coll = collectives(nbrs.shape[0], mesh)
+                coll = collectives(nbrs.shape[0], mesh,
+                                   dcn=self._dcn)
                 plan = fp[0] if fp else None
                 body = mk_body(
                     lambda s: self._sharded_round(
@@ -2799,7 +2816,7 @@ class BroadcastSim:
         public :meth:`run_staged`."""
         target = self.target_bits(inject)
         if self.mesh is not None and "words" in self.mesh.axis_names:
-            target = jax.device_put(
+            target = shard_put(
                 target, NamedSharding(self.mesh, P("words")))
         return self.init_state(inject), target
 

@@ -39,13 +39,17 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .engine import shard_put
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import faults, kvstore, provenance, telemetry, traffic
-from .engine import (Collectives, collectives, donate_argnums_for,
-                     fori_rounds, jit_program, node_axes, node_shards,
-                     resolve_block, scan_blocks)
+from .engine import (Collectives, DcnRound, HOSTS_AXIS, collectives,
+                     dcn_carry_init, dcn_carry_specs,
+                     donate_argnums_for, fori_rounds, jit_program,
+                     node_axes, node_shards, resolve_block,
+                     resolve_dcn_mode, scan_blocks)
 
 
 class KVReach(NamedTuple):
@@ -111,7 +115,8 @@ class CounterSim:
                  kv_amnesia: bool = False,
                  stale_prob: float = 0.0,
                  stale_until: int = 0,
-                 stale_seed: int | None = None) -> None:
+                 stale_seed: int | None = None,
+                 dcn_mode=None) -> None:
         """``fault_plan`` (tpu_sim/faults.py): the crash/loss nemesis.
         A down node cannot flush, poll, or win the CAS; on restart its
         AMNESIA row loses ``pending`` (acked-but-unflushed deltas die
@@ -149,9 +154,35 @@ class CounterSim:
         — the same coins the harness KVService draws via
         ``stale_coin_fn`` (the wire-count calibration satellite).
         Dup streams are REJECTED loudly on the device backend
-        (:func:`~.kvstore.reject_dup_stream`, ROADMAP item 6)."""
+        (:func:`~.kvstore.reject_dup_stream`, ROADMAP item 6).
+
+        ``dcn_mode`` (PR 20): the DCN latency-hiding engine mode —
+        None defers to the ``GG_DCN_PIPELINE``/``GG_DCN_STALE_K`` env
+        knobs, else a :class:`~.engine.DcnMode` or canonical mode
+        string.  ``pipelined`` is bit-exact on every driver; a
+        ``stale_k`` mode is certified ONLY for the allreduce host-KV
+        data plane (the entire exchange is ``reduce_sum``) on a
+        hierarchical mesh — the cas winner fold, device-KV reads, and
+        the observed/traffic calibration paths refuse loudly."""
         if mode not in ("cas", "allreduce"):
             raise ValueError(f"unknown mode {mode!r}")
+        self._dcn = resolve_dcn_mode(dcn_mode)
+        if self._dcn.stale_k:
+            if mode != "allreduce":
+                raise ValueError(
+                    f"dcn_mode {self._dcn.label()!r} needs "
+                    "mode='allreduce': the cas winner's reduce_min "
+                    "fold has no certified staleness semantics")
+            if kv_backend != "host":
+                raise ValueError(
+                    f"dcn_mode {self._dcn.label()!r} needs "
+                    "kv_backend='host': device-KV reads have no "
+                    "certified staleness semantics")
+            if mesh is None or HOSTS_AXIS not in mesh.axis_names:
+                raise ValueError(
+                    f"dcn_mode {self._dcn.label()!r} needs a "
+                    "hierarchical (hosts x nodes) mesh: a flat mesh "
+                    "has no DCN level to lag")
         if winner_key not in ("auto", "packed", "wide"):
             raise ValueError(f"unknown winner_key {winner_key!r}")
         if kv_backend not in ("host", "device"):
@@ -236,11 +267,44 @@ class CounterSim:
         # telemetry-on observed drivers, keyed by (TelemetrySpec,
         # donate) — PR 8
         self._obs_progs: dict = {}
+        # DCN staleness carry (PR 20): the (age, outbox-slots) pair
+        # the stale drivers thread as explicit donated I/O — layout
+        # discovered once by a probing eval_shape of the round, held
+        # on the instance between program calls, reset by init_state
+        self._dcn_shapes = None
+        self._dcn_carry = None
+        if self._dcn.stale_k:
+            self._dcn_shapes = self._probe_dcn()
+            self._dcn_carry = dcn_carry_init(self._dcn_shapes, mesh)
         self._step = self._build_step()
         self._run_n = self._build_run_n(donate=False)
         # the donated twin: same traced rounds, state buffers consumed
         # and reused in place (engine.py module docstring)
         self._run_n_donated = self._build_run_n(donate=True)
+
+    def _probe_dcn(self) -> list:
+        """The staleness carry layout: eval_shape a PROBING twin of
+        the round (collectives record each outbox slot's per-shard
+        shape instead of consuming a carry)."""
+        mesh = self.mesh
+        probe = DcnRound.probing(self._dcn)
+        sched_spec = KVReach(P(), P(), P(None, None))
+        fp_specs, fp_args = self._fp_extra()
+
+        def step(state: CounterState, sched: KVReach,
+                 *fp) -> CounterState:
+            coll = collectives(state.pending.shape[0], mesh,
+                               dcn=probe)
+            return self._round(state, coll, sched,
+                               fp[0] if fp else None)
+
+        prog = jit_program(step, mesh=mesh,
+                           in_specs=(self._state_spec(), sched_spec)
+                           + fp_specs,
+                           out_specs=self._state_spec())
+        jax.eval_shape(prog, self.init_state(), self.kv_sched,
+                       *fp_args)
+        return list(probe.shapes)
 
     def init_state(self) -> CounterState:
         # pending and cached start equal but must be DISTINCT buffers:
@@ -249,12 +313,18 @@ class CounterSim:
         def z():
             arr = jnp.zeros((self.n_nodes,), jnp.int32)
             if self.mesh is not None:
-                arr = jax.device_put(
+                arr = shard_put(
                     arr, NamedSharding(self.mesh, self._node_spec))
             return arr
 
         rows = (kvstore.init_rows(self._kv_layout, self.mesh)
                 if self._device_kv else None)
+        if getattr(self, "_dcn_shapes", None) is not None:
+            # a fresh run starts with empty outboxes and age 0 (the
+            # first round refreshes) — the staleness carry is run
+            # state, not program state
+            self._dcn_carry = dcn_carry_init(self._dcn_shapes,
+                                             self.mesh)
         return CounterState(pending=z(), cached=z(), kv=jnp.int32(0),
                             t=jnp.int32(0), msgs=jnp.uint32(0),
                             rows=rows)
@@ -268,7 +338,7 @@ class CounterSim:
         add.go:33-41)."""
         d = jnp.asarray(deltas, jnp.int32)
         if self.mesh is not None:
-            d = jax.device_put(d, NamedSharding(self.mesh, self._node_spec))
+            d = shard_put(d, NamedSharding(self.mesh, self._node_spec))
         return state._replace(pending=state.pending + d)
 
     # -- round -------------------------------------------------------------
@@ -471,9 +541,39 @@ class CounterSim:
         sched_spec = KVReach(P(), P(), P(None, None))
         fp_specs, fp_args = self._fp_extra()
 
+        if self._dcn.stale_k:
+            # staleness carry as EXPLICIT donated I/O on the step
+            # program: a stepwise run sees the same refresh cadence as
+            # the fused driver (the carried age decides)
+            cspecs = dcn_carry_specs(self._dcn_shapes, mesh)
+
+            def step_st(state: CounterState, dcnc, sched: KVReach,
+                        *fp):
+                age, slots = dcnc
+                ctx = DcnRound(self._dcn, age=age, carry=slots)
+                coll = collectives(state.pending.shape[0], mesh,
+                                   dcn=ctx)
+                out = self._round(state, coll, sched,
+                                  fp[0] if fp else None)
+                return out, (age + 1, ctx.carry_out())
+
+            prog_st = jit_program(
+                step_st, mesh=mesh,
+                in_specs=(self._state_spec(), cspecs, sched_spec)
+                + fp_specs,
+                out_specs=(self._state_spec(), cspecs),
+                donate_argnums=(1,))
+
+            def run_step(state):
+                out, self._dcn_carry = prog_st(
+                    state, self._dcn_carry, self.kv_sched, *fp_args)
+                return out
+            return run_step
+
         def step(state: CounterState, sched: KVReach,
                  *fp) -> CounterState:
-            coll = collectives(state.pending.shape[0], mesh)
+            coll = collectives(state.pending.shape[0], mesh,
+                               dcn=self._dcn)
             return self._round(state, coll, sched,
                                fp[0] if fp else None)
 
@@ -518,9 +618,51 @@ class CounterSim:
 
         sched_spec = KVReach(P(), P(), P(None, None))
 
+        if self._dcn.stale_k:
+            cspecs = dcn_carry_specs(self._dcn_shapes, mesh)
+            dn_st = (0, 1) if donate else ()
+
+            def run_n_st(state: CounterState, dcnc,
+                         sched: KVReach, n, *fp):
+                def rnd(carry, p=None):
+                    s, a, sl = carry
+                    ctx = DcnRound(self._dcn, age=a, carry=sl)
+                    coll = collectives(s.pending.shape[0], mesh,
+                                       dcn=ctx)
+                    s2 = self._round(s, coll, sched, p)
+                    return (s2, a + 1, ctx.carry_out())
+
+                age, slots = dcnc
+                if fp:
+                    s, a, sl = fori_rounds(rnd, (state, age, slots),
+                                           n, operand=fp[0])
+                else:
+                    s, a, sl = fori_rounds(lambda c: rnd(c),
+                                           (state, age, slots), n)
+                return s, (a, sl)
+
+            prog_st = jit_program(
+                run_n_st, mesh=mesh,
+                in_specs=(self._state_spec(), cspecs, sched_spec,
+                          P()) + fp_specs,
+                out_specs=(self._state_spec(), cspecs),
+                donate_argnums=dn_st)
+            self._run_progs[donate] = (
+                prog_st,
+                lambda state, n: (state, self._dcn_carry,
+                                  self.kv_sched, n) + fp_args)
+
+            def run_st(state, n):
+                out, self._dcn_carry = prog_st(
+                    state, self._dcn_carry, self.kv_sched, n,
+                    *fp_args)
+                return out
+            return run_st
+
         def run_n(state: CounterState, sched: KVReach,
                   n, *fp) -> CounterState:
-            coll = collectives(state.pending.shape[0], mesh)
+            coll = collectives(state.pending.shape[0], mesh,
+                               dcn=self._dcn)
             if fp:
                 return fori_rounds(
                     lambda s, p: self._round(s, coll, sched, p),
@@ -638,6 +780,12 @@ class CounterSim:
                 "run_observed needs a TelemetrySpec(workload="
                 "'counter', traffic=False); open-loop runs record "
                 "through run_traffic(tel=...)")
+        if self._dcn.stale_k:
+            raise ValueError(
+                f"dcn_mode {self._dcn.label()!r}: the observed "
+                "drivers do not thread the DCN staleness carry — "
+                "telemetry/provenance calibration under staleness is "
+                "undecided; run sync or pipelined")
         mesh = self.mesh
         n_carry = 1 + int(tl) + int(pv)
         dn = donate_argnums_for(donate, *range(n_carry))
@@ -693,7 +841,8 @@ class CounterSim:
                 prov0 = a.pop(0) if pv else None
                 sched, n = a.pop(0), a.pop(0)
                 fp = tuple(a)
-                coll = collectives(state.pending.shape[0], mesh)
+                coll = collectives(state.pending.shape[0], mesh,
+                                   dcn=self._dcn)
                 plan = fp[0] if fp else None
                 return fori_rounds(lambda c: one(c, sched, coll, plan),
                                    carry_of(state, tel, prov0), n)
@@ -721,7 +870,7 @@ class CounterSim:
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, self._node_spec)
             prov = provenance.CounterProv(
-                *(jax.device_put(a, sh) for a in prov))
+                *(shard_put(a, sh) for a in prov))
         return prov
 
     def run_observed(self, state: CounterState, tel, tspec,
@@ -854,6 +1003,12 @@ class CounterSim:
                 f"TrafficSpec is for {tspec.n_nodes} nodes, sim has "
                 f"{self.n_nodes}")
         mesh = self.mesh
+        if self._dcn.stale_k:
+            raise ValueError(
+                f"dcn_mode {self._dcn.label()!r}: the open-loop "
+                "traffic driver does not thread the DCN staleness "
+                "carry — per-op latency tracking under staleness is "
+                "undecided; run sync or pipelined")
         n_sh = node_shards(mesh)
         if tspec.n_clients % n_sh != 0:
             raise ValueError(
@@ -896,7 +1051,8 @@ class CounterSim:
                 tel = rest.pop(0) if tl else None
                 ts, n, tplan, sched = rest[0], rest[1], rest[2], rest[3]
                 fp = rest[4:]
-                coll = collectives(state.pending.shape[0], mesh)
+                coll = collectives(state.pending.shape[0], mesh,
+                                   dcn=self._dcn)
                 plan = fp[0] if fp else None
                 carry = (state, ts, tel) if tl else (state, ts)
                 return fori_rounds(
@@ -1036,7 +1192,11 @@ def audit_contracts():
         sched_spec = KVReach(P(), P(), P(None, None))
 
         def step(state, sched):
-            coll = collectives(state.pending.shape[0], mesh)
+            # sim._dcn resolved from the env at construction — the
+            # */dcn-pipelined-* rebinds re-issue this row under
+            # GG_DCN_PIPELINE=1
+            coll = collectives(state.pending.shape[0], mesh,
+                               dcn=sim._dcn)
             return sim._round(state, coll, sched)
 
         prog = jit_program(step, mesh=mesh,

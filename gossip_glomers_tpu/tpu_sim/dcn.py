@@ -25,10 +25,50 @@ threads ``node_shards``/``node_axes`` like the harness does.
 
 from __future__ import annotations
 
+import contextlib
+import os
+
 from . import faults
 
 HOSTS = 2          # CI hierarchy: 2 "hosts" x 4 devices
 PER_HOST = 4
+
+
+@contextlib.contextmanager
+def _pipelined_env():
+    """Rebuild a sibling contract under ``GG_DCN_PIPELINE=1`` (PR 20):
+    the sims resolve the env contract in their constructors, so the
+    SAME build closure compiles the pipelined twin of its round — the
+    audit then pins the double-buffered DCN circuit under the same
+    gather gate and memory band as the synchronous row."""
+    old = os.environ.get("GG_DCN_PIPELINE")
+    os.environ["GG_DCN_PIPELINE"] = "1"
+    try:
+        yield
+    finally:
+        if old is None:
+            del os.environ["GG_DCN_PIPELINE"]
+        else:
+            os.environ["GG_DCN_PIPELINE"] = old
+
+
+def _pipelined(row, dcn_name, notes):
+    """A ``*/dcn-*`` row re-issued with round pipelining ON: same
+    build closure, env-pinned mode, caps/donation/memory band carried
+    over — the two in-flight half-block partials are per-level psum/
+    ppermute circuits over the SAME collective families, and the extra
+    in-flight partial is at most one per-shard operand copy, priced
+    inside the sibling's analytic band."""
+    from .audit import ProgramContract
+
+    def build(mesh, _build=row.build):
+        with _pipelined_env():
+            return _build(mesh)
+
+    return ProgramContract(
+        name=dcn_name, build=build, collectives=row.collectives,
+        donation=row.donation, mem_lo=row.mem_lo, mem_hi=row.mem_hi,
+        needs_mesh=row.needs_mesh, dcn_per_host=PER_HOST, notes=notes)
 
 
 def _mesh2d():
@@ -93,37 +133,38 @@ def audit_contracts():
         state, _ = sim.stage(make_inject(n, nv))
         return AuditProgram(prog, args_fn(state))
 
+    bcast_row = ProgramContract(
+        name="broadcast/dcn-halo-wm-nem",
+        build=structured_nem,
+        collectives={"all-reduce": None,
+                     "collective-permute": None},
+        needs_mesh=False,
+        dcn_per_host=PER_HOST,
+        notes="structured words-major nemesis round on the "
+              "hierarchical mesh: the per-axis ppermute halo + "
+              "mask decomposition stays gather-free, and no "
+              "replica group crosses a host block")
+    wide_row = _rebind(
+        counter.audit_contracts(),
+        "counter/sharded-step-wide", "counter/dcn-wide-round",
+        notes="wide two-pmin winner on the hierarchical mesh: "
+              "psum/pmin reduce over BOTH axes (partial-per-host "
+              "then DCN) — still no gather anywhere")
+    traffic_row = _rebind(
+        counter.audit_contracts(),
+        "counter/sharded-traffic-run", "counter/dcn-traffic-run",
+        notes="open-loop traffic driver on the hierarchical "
+              "mesh: donation survives the 2-D resharding (the "
+              "state aliases in place) and the compiled peak "
+              "stays in the per-host analytic memory band")
+    union_row = _rebind(
+        kafka.audit_contracts(),
+        "kafka/sharded-step-union", "kafka/dcn-union-round",
+        notes="blocked psum-of-OR + ppermute prefix scan on the "
+              "hierarchical mesh: presence unions decompose "
+              "per axis, no host-crossing gather")
     return [
-        ProgramContract(
-            name="broadcast/dcn-halo-wm-nem",
-            build=structured_nem,
-            collectives={"all-reduce": None,
-                         "collective-permute": None},
-            needs_mesh=False,
-            dcn_per_host=PER_HOST,
-            notes="structured words-major nemesis round on the "
-                  "hierarchical mesh: the per-axis ppermute halo + "
-                  "mask decomposition stays gather-free, and no "
-                  "replica group crosses a host block"),
-        _rebind(
-            counter.audit_contracts(),
-            "counter/sharded-step-wide", "counter/dcn-wide-round",
-            notes="wide two-pmin winner on the hierarchical mesh: "
-                  "psum/pmin reduce over BOTH axes (partial-per-host "
-                  "then DCN) — still no gather anywhere"),
-        _rebind(
-            counter.audit_contracts(),
-            "counter/sharded-traffic-run", "counter/dcn-traffic-run",
-            notes="open-loop traffic driver on the hierarchical "
-                  "mesh: donation survives the 2-D resharding (the "
-                  "state aliases in place) and the compiled peak "
-                  "stays in the per-host analytic memory band"),
-        _rebind(
-            kafka.audit_contracts(),
-            "kafka/sharded-step-union", "kafka/dcn-union-round",
-            notes="blocked psum-of-OR + ppermute prefix scan on the "
-                  "hierarchical mesh: presence unions decompose "
-                  "per axis, no host-crossing gather"),
+        bcast_row, wide_row, traffic_row, union_row,
         _rebind(
             scenario.audit_contracts(),
             "counter/scenario-batch-run", "counter/dcn-scenario-batch",
@@ -131,4 +172,31 @@ def audit_contracts():
                   "axis splits over DCN, every node axis runs "
                   "locally — cap-0 census, donation and the "
                   "per-host memory band intact on the 2-D mesh"),
+        # -- pipelined twins (PR 20 tentpole): the same builds under
+        # GG_DCN_PIPELINE=1 — bit-exact by the integer-operand
+        # restriction, same caps/donation/memory band, DCN gate on
+        _pipelined(
+            bcast_row, "broadcast/dcn-pipelined-halo-wm-nem",
+            notes="pipelined structured nemesis round: the ledger "
+                  "psums split their hosts level into two in-flight "
+                  "half-block all-reduces; the halo ppermutes are "
+                  "per-level already — still gather-free"),
+        _pipelined(
+            wide_row, "counter/dcn-pipelined-wide-round",
+            notes="pipelined wide round: the per-host psum/pmin "
+                  "partials double-buffer over the hosts axis as two "
+                  "half-block all-reduces — integer operands, "
+                  "bit-exact vs the sync row, still no gather"),
+        _pipelined(
+            traffic_row, "counter/dcn-pipelined-traffic-run",
+            notes="pipelined open-loop traffic driver: donation "
+                  "survives with the double-buffered DCN partials in "
+                  "flight and the compiled peak stays inside the "
+                  "sync row's analytic band"),
+        _pipelined(
+            union_row, "kafka/dcn-pipelined-union-round",
+            notes="pipelined union round: presence-union psums and "
+                  "the offset prefix scan split their hosts level "
+                  "into two in-flight half-block circuits — no "
+                  "host-crossing gather appears"),
     ]

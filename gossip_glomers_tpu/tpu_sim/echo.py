@@ -15,6 +15,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .engine import shard_put
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -57,5 +59,5 @@ class EchoSim:
         v = jnp.asarray(valid)
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P("nodes", None))
-            p, v = jax.device_put(p, sh), jax.device_put(v, sh)
+            p, v = shard_put(p, sh), shard_put(v, sh)
         return self._step(state, p, v)

@@ -48,6 +48,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..parallel.mesh import shard_put
+
 # -- shard_map entry-point compat ---------------------------------------
 
 if hasattr(jax, "shard_map"):                    # JAX >= 0.6 spelling
@@ -271,8 +273,277 @@ def _excl_level(x, ax, k: int):
     return acc - x
 
 
+# -- DCN latency-hiding modes (PR 20) -----------------------------------
+
+
+class DcnMode(NamedTuple):
+    """Engine mode for the DCN hosts level of the two-level collective
+    circuits (ROADMAP item 4): how the one per-host partial block that
+    crosses the slow cross-host links is scheduled.
+
+    - ``pipeline``: split the per-host partial into two half-blocks
+      exchanged as INDEPENDENT hosts-level circuits — the double
+      buffer.  The two in-flight halves carry no data dependency, so
+      an async-collective scheduler (XLA's collective pipeliner on
+      real DCN) overlaps round t's second half with round t+1's ICI
+      compute; the combined value is unchanged, so every integer/bool
+      reduce stays **bit-exact** vs the synchronous twin.  Floating
+      operands keep the fused synchronous all-reduce (half-block
+      reassociation would drift ULPs; the payloads worth pipelining —
+      presence bitmaps, counters, packed words — are integral).
+    - ``stale_k``: cross-host partials in ``reduce_sum``/``reduce_or``/
+      ``reduce_and`` consumers may lag up to k rounds — each shard
+      accumulates its per-round operand into an outbox slot riding
+      the donated carry and only every k-th round pays the DCN
+      exchange, which delivers the ACCUMULATED backlog (every delta
+      counted exactly once, so zero acked writes are lost; k=1 is the
+      synchronous twin).  Members whose staleness semantics are
+      undecided (``exclusive_sum`` offset allocation, ``reduce_min``/
+      ``reduce_max`` winner folds, ``widen`` delivery) refuse loudly.
+
+    Both compose: ``pipelined+stale:k`` chunks the every-k-th-round
+    exchange too.  Off by default (``DCN_SYNC``)."""
+
+    pipeline: bool = False
+    stale_k: int = 0
+
+    def label(self) -> str:
+        """Canonical mode string (the ``resolve_dcn_mode`` grammar) —
+        what nemesis runner_kw records so flight bundles replay the
+        mode."""
+        parts = []
+        if self.pipeline:
+            parts.append("pipelined")
+        if self.stale_k:
+            parts.append(f"stale:{self.stale_k}")
+        return "+".join(parts) if parts else "sync"
+
+
+#: the synchronous default: one fused exchange per reduce, no lag
+DCN_SYNC = DcnMode()
+
+
+def dcn_mode_from_env() -> DcnMode:
+    """The env-selected :class:`DcnMode`: ``GG_DCN_PIPELINE`` (0/1)
+    and ``GG_DCN_STALE_K`` (rounds of allowed lag), both following the
+    loud :func:`_env_int` contract — a non-integer value raises naming
+    the variable, and out-of-range values refuse instead of clamping.
+    Off (synchronous) by default."""
+    pipe = _env_int("GG_DCN_PIPELINE", os.environ.get("GG_DCN_PIPELINE", "0"))
+    if pipe not in (0, 1):
+        raise ValueError(f"GG_DCN_PIPELINE={pipe} must be 0 or 1")
+    k = _env_int("GG_DCN_STALE_K", os.environ.get("GG_DCN_STALE_K", "0"))
+    if k < 0:
+        raise ValueError(f"GG_DCN_STALE_K={k} must be >= 0")
+    return DcnMode(pipeline=bool(pipe), stale_k=k)
+
+
+def resolve_dcn_mode(setting=None) -> DcnMode:
+    """Resolve a sim constructor's ``dcn_mode`` argument: ``None``
+    defers to the env knobs (:func:`dcn_mode_from_env`), a
+    :class:`DcnMode` passes through, and a string is parsed from the
+    canonical grammar ``"sync" | "pipelined" | "stale:<k>" |
+    "pipelined+stale:<k>"`` (the JSON-safe spelling runner_kw records,
+    so flight bundles replay the mode).  Anything else refuses
+    loudly."""
+    if setting is None:
+        return dcn_mode_from_env()
+    if isinstance(setting, DcnMode):
+        if setting.stale_k < 0:
+            raise ValueError(
+                f"dcn_mode stale_k={setting.stale_k} must be >= 0")
+        return setting
+    if isinstance(setting, str):
+        pipeline, stale_k = False, 0
+        for part in setting.split("+"):
+            if part == "sync":
+                continue
+            if part == "pipelined":
+                pipeline = True
+            elif part.startswith("stale:"):
+                stale_k = _env_int(f"dcn_mode {setting!r}", part[6:])
+                if stale_k < 0:
+                    raise ValueError(
+                        f"dcn_mode {setting!r}: stale k must be >= 0")
+            else:
+                raise ValueError(
+                    f"dcn_mode {setting!r}: unknown part {part!r} "
+                    "(expected 'sync', 'pipelined', 'stale:<k>', or "
+                    "'pipelined+stale:<k>')")
+        return DcnMode(pipeline=pipeline, stale_k=stale_k)
+    raise ValueError(
+        "dcn_mode must be None, a DcnMode, or a mode string — got "
+        f"{type(setting).__name__}")
+
+
+class DcnRound:
+    """The per-round bounded-staleness context a ``stale_k`` driver
+    threads into :func:`collectives`.
+
+    Lifecycle: the driver's traced round body builds ONE ``DcnRound``
+    from the carried ``(age, slots)`` pair, hands it to
+    ``collectives(..., dcn=ctx)``, and threads ``(age + 1,
+    ctx.carry_out())`` back into the loop carry.  The carry is
+    EXPLICIT jitted I/O — donated alongside the state, held on the sim
+    instance between program invocations, and reset to zeros (age 0 =
+    the next round refreshes) by ``init_state``; staleness therefore
+    survives program boundaries, so a stepwise run and the donated
+    fused run see the same refresh cadence.
+
+    Each stale member allocates its outbox slot in trace order via the
+    private take/put pair; the slot layout is discovered once by a
+    PROBE context (:meth:`probing`), under which members record each
+    slot's per-shard shape and return the synchronous value — so
+    ``jax.eval_shape`` over a probing twin of the round program yields
+    the carry layout without allocating real buffers."""
+
+    def __init__(self, mode, *, age=None, carry=(), _probe=False):
+        self.mode = resolve_dcn_mode(mode)
+        self.is_probe = _probe
+        self.age = age
+        self._carry_in = tuple(carry)
+        self._take_i = 0
+        self._out = []
+        self.shapes = []
+        if not _probe and self.mode.stale_k:
+            if age is None:
+                raise ValueError(
+                    "DcnRound needs the carried round age (int32 "
+                    "scalar) to derive the refresh cadence")
+            #: traced bool: this round pays the DCN exchange (every
+            #: k-th round; age 0 refreshes, so k=1 is the sync twin)
+            self.refresh = (age % jnp.int32(self.mode.stale_k)) == 0
+
+    @classmethod
+    def probing(cls, mode) -> "DcnRound":
+        """A probe context: records slot shapes, consumes no carry."""
+        return cls(mode, _probe=True)
+
+    def _take(self, like):
+        """The next carry slot, shaped like the per-host partial
+        ``like`` (probe: record the shape, return None)."""
+        if self.is_probe:
+            self.shapes.append(jax.ShapeDtypeStruct(like.shape,
+                                                    like.dtype))
+            return None
+        if self._take_i >= len(self._carry_in):
+            raise ValueError(
+                f"DCN staleness carry exhausted: round consumed slot "
+                f"{self._take_i} but the carry holds "
+                f"{len(self._carry_in)} — the round's collective "
+                "structure changed without re-probing")
+        x = self._carry_in[self._take_i]
+        self._take_i += 1
+        return x[0]                 # strip the leading local-shard dim
+
+    def _put(self, v):
+        if self.is_probe:
+            return
+        self._out.append(v[None])
+
+    def carry_out(self) -> tuple:
+        """The updated slots, in take order — thread back as the next
+        round's carry."""
+        if self._take_i != len(self._carry_in) or \
+                len(self._out) != len(self._carry_in):
+            raise ValueError(
+                f"DCN staleness carry mismatch: {self._take_i} taken / "
+                f"{len(self._out)} updated vs {len(self._carry_in)} "
+                "carried — the round's collective structure changed "
+                "without re-probing")
+        return tuple(self._out)
+
+
+def dcn_carry_shapes(probe_prog, *probe_args, ctx: DcnRound) -> list:
+    """Run ``jax.eval_shape`` over a PROBING twin of the round program
+    (built with ``dcn=ctx`` where ``ctx = DcnRound.probing(mode)``) and
+    return the recorded per-shard slot shapes — the carry layout."""
+    jax.eval_shape(probe_prog, *probe_args)
+    return list(ctx.shapes)
+
+
+def dcn_carry_init(shapes, mesh, *, axis: str = "nodes"):
+    """The zeroed ``(age, slots)`` staleness carry as GLOBAL arrays:
+    ``age`` a replicated int32 scalar (0 = the next round refreshes),
+    each slot a ``(n_shards, *per_shard_shape)`` zeros array sharded
+    over the node axes — every shard owns row 0 of its local block
+    (the outbox)."""
+    from jax.sharding import NamedSharding
+
+    na = node_axes(mesh, axis)
+    n = node_shards(mesh, axis)
+    age = shard_put(jnp.zeros((), jnp.int32),
+                         NamedSharding(mesh, P()))
+    slots = tuple(
+        shard_put(jnp.zeros((n,) + s.shape, s.dtype),
+                       NamedSharding(mesh, P(na)))
+        for s in shapes)
+    return age, slots
+
+
+def dcn_carry_specs(shapes, mesh, *, axis: str = "nodes"):
+    """``in_specs``/``out_specs`` entry for the ``(age, slots)``
+    carry of :func:`dcn_carry_init`."""
+    na = node_axes(mesh, axis)
+    return (P(), tuple(P(na) for _ in shapes))
+
+
+def _dcn_chunks(x):
+    # the two half-blocks of a per-host partial (the double buffer),
+    # or None when the operand is too small to split
+    if x.ndim == 0 or x.size < 2:
+        return None
+    flat = x.reshape((-1,))
+    h = flat.shape[0] // 2
+    def join(ys, shape=x.shape):
+        return jnp.concatenate(ys, axis=0).reshape(shape)
+    return (flat[:h], flat[h:]), join
+
+
+def _dcn_level(x, op_level, *, pipeline: bool, ax, k: int):
+    # the DCN hosts-level exchange of one per-host partial: fused in
+    # sync mode; in pipelined mode split into two independent
+    # half-block circuits an async scheduler can keep in flight
+    # concurrently (element-wise ops — halves combine to the same
+    # value, bit-exact for the integer/bool operands that take this
+    # path)
+    if pipeline:
+        split = _dcn_chunks(x)
+        if split is not None:
+            parts, join = split
+            first = op_level(parts[0], ax, k)
+            second_in = parts[1]
+            if jax.default_backend() == "cpu":
+                # the gloo transport pairs point-to-point buffers in
+                # posting order with no per-circuit tag, so two
+                # in-flight half exchanges race across ranks (observed
+                # preamble-size mismatches on the 2-process CI
+                # cluster): chain the second half on the first's
+                # result.  The HLO keeps both half all-reduces — the
+                # audit census and bit-exactness are identical — and
+                # on TPU the halves stay independent so the async
+                # collective scheduler can overlap them.
+                second_in, first = lax.optimization_barrier(
+                    (second_in, first))
+            return join([first, op_level(second_in, ax, k)])
+    return op_level(x, ax, k)
+
+
+def _psum_level(x, ax, k: int):
+    del k
+    return lax.psum(x, ax)
+
+
+def _dcn_pipelineable(x) -> bool:
+    # only integer/bool operands may decompose the fused all-reduce:
+    # float reassociation across the per-level split would drift ULPs
+    # against the synchronous twin the parity suite pins
+    return (jnp.issubdtype(x.dtype, jnp.integer)
+            or jnp.issubdtype(x.dtype, jnp.bool_))
+
+
 def collectives(block: int, mesh=None, *, axis: str = "nodes",
-                gather_axis: int = 0) -> Collectives:
+                gather_axis: int = 0, dcn=None) -> Collectives:
     """Build the :class:`Collectives` for a round over ``block`` local
     rows.  With a mesh this MUST be called from inside the shard_map'd
     function (it reads ``lax.axis_index``); off-mesh it is pure.
@@ -286,7 +557,23 @@ def collectives(block: int, mesh=None, *, axis: str = "nodes",
     axis indices hosts-major (the tuple-axis linearization of the 2-D
     mesh layout), so global row ids, gathers, and column slices are
     identical to the flat 1-D mesh's — that identity is what the
-    2-proc x 4-dev == 1-proc x 8-dev parity suite pins."""
+    2-proc x 4-dev == 1-proc x 8-dev parity suite pins.
+
+    ``dcn`` selects the DCN hosts-level schedule: ``None`` /
+    :data:`DCN_SYNC` (fused synchronous exchange), a :class:`DcnMode`
+    (``pipeline`` double-buffers the per-host partial into two
+    in-flight half-block circuits, value unchanged), or a
+    :class:`DcnRound` (the driver-threaded staleness carry a
+    ``stale_k`` mode REQUIRES on a hierarchical mesh — a bare stale
+    :class:`DcnMode` refuses, because lagging without a carry is
+    impossible and silently compiling the synchronous circuit would
+    misreport the mode).  Stale semantics are certified for
+    ``reduce_sum``/``reduce_or`` (accumulate-outbox: every delta
+    delivered exactly once, lag < k) and ``reduce_and`` (conservative
+    last-refresh snapshot); ``exclusive_sum``, ``reduce_min``/``max``,
+    and ``widen`` refuse under staleness — their consumers (offset
+    allocation, CAS winner folds, delivery) have undecided
+    semantics."""
     if mesh is None:
         ident = lambda x: x                              # noqa: E731
         return Collectives(
@@ -295,46 +582,241 @@ def collectives(block: int, mesh=None, *, axis: str = "nodes",
             reduce_min=ident, reduce_or=ident, reduce_and=ident,
             exclusive_sum=jnp.zeros_like,
             local_cols=ident, axis_name=None)
+    ctx = None
+    if isinstance(dcn, DcnRound):
+        mode, ctx = dcn.mode, dcn
+    elif isinstance(dcn, DcnMode):
+        mode = dcn
+    elif dcn is None:
+        mode = DCN_SYNC
+    else:
+        raise ValueError(
+            "collectives dcn= must be None, a DcnMode, or a DcnRound "
+            f"— got {type(dcn).__name__}")
     axes = tuple(mesh.axis_names)
     na = node_axes(mesh, axis)
     hier = na != axis
     n_inner = int(mesh.shape[axis])
     n_hosts = int(mesh.shape[HOSTS_AXIS]) if hier else 1
-    # innermost level first: ICI circuits complete before any DCN hop
-    levels = [(axis, n_inner)] + ([(HOSTS_AXIS, n_hosts)] if hier else [])
+    if mode.stale_k:
+        if not hier:
+            raise ValueError(
+                f"stale_k={mode.stale_k} needs a hierarchical "
+                "(hosts x nodes) mesh: a flat mesh has no DCN level "
+                "to lag — refuse instead of silently running sync")
+        if ctx is None:
+            raise ValueError(
+                f"stale_k={mode.stale_k} reached collectives() as a "
+                "bare DcnMode: this driver does not thread the DCN "
+                "staleness carry (DcnRound) — refuse instead of "
+                "silently compiling the synchronous circuit")
+    pipeline = mode.pipeline and hier
+    stale = bool(mode.stale_k) and hier
+    inner_axes = tuple(a for a in axes if a != HOSTS_AXIS)
     row_ids = (lax.axis_index(na) * block
                + jnp.arange(block, dtype=jnp.int32))
 
-    def reduce_or(x):
-        for ax, k in levels:
-            if k > 1:
-                x = _or_level(x, ax, k)
-        return x
+    def _or_inner(x):
+        # the intra-host (ICI) OR ladder — everything below the DCN hop
+        return _or_level(x, axis, n_inner) if n_inner > 1 else x
 
-    def exclusive_sum(x):
-        # global exclusive prefix for shard (h, i), hosts-major: the
-        # intra-host exclusive scan plus, over DCN, the exclusive scan
-        # of each host's full partial (one psum-reduced block per host
-        # crosses DCN — not the per-shard operands)
-        out = _excl_level(x, axis, n_inner)
-        if hier and n_hosts > 1:
-            out = out + _excl_level(lax.psum(x, axis), HOSTS_AXIS,
-                                    n_hosts)
-        return out
+    def _or_dcn(p):
+        # the hosts-level OR exchange of one per-host partial
+        return _dcn_level(p, _or_level, pipeline=pipeline,
+                          ax=HOSTS_AXIS, k=n_hosts)
+
+    def _sum_dcn(p):
+        return _dcn_level(p, _psum_level, pipeline=pipeline,
+                          ax=HOSTS_AXIS, k=n_hosts)
+
+    def reduce_or(x):
+        part = _or_inner(x)
+        if not hier or n_hosts < 2:
+            return part
+        if not stale:
+            return _or_dcn(part)
+        # accumulate-outbox staleness: the slot ORs up this shard's
+        # per-round operands; the intra-host ladder still runs EVERY
+        # round (only the cross-host hop lags), and every k-th round
+        # the DCN exchange unions the ACCUMULATED backlog (idempotent
+        # — nothing double-counts, no bit lags more than k-1 rounds),
+        # then clears the outbox
+        slot = ctx._take(x)
+        if ctx.is_probe:
+            return _or_dcn(part)
+        acc = slot | x
+
+        def fresh(a):
+            return _or_dcn(_or_level(a, axis, n_inner)
+                           if n_inner > 1 else a), jnp.zeros(
+                               a.shape, a.dtype)
+
+        def lag(a):
+            return part, a
+
+        val, nxt = lax.cond(ctx.refresh, fresh, lag, acc)
+        ctx._put(nxt)
+        return val
+
+    def reduce_and(x):
+        if not stale:
+            return ~reduce_or(~x)
+        # snapshot staleness: the slot carries the last refresh's
+        # GLOBAL AND; stale rounds serve the conservative meet of that
+        # snapshot with the CURRENT intra-host partial (the monotone
+        # visibility predicates under-report — safe)
+        part = ~_or_inner(~x)
+        slot = ctx._take(part)
+        if ctx.is_probe:
+            return ~_or_dcn(~part)
+
+        def fresh(sl):
+            del sl
+            glob = ~_or_dcn(~part)
+            return glob, glob
+
+        def lag(sl):
+            return part & sl, sl
+
+        val, nxt = lax.cond(ctx.refresh, fresh, lag, slot)
+        ctx._put(nxt)
+        return val
+
+    def _sum_all(x):
+        # sum over EVERY mesh axis, with the DCN hosts level split out
+        # (and half-blocked) in pipelined mode — integer operands only
+        # take the decomposed path (bit-exact); floats keep the fused
+        # synchronous all-reduce
+        if not hier or not pipeline or not _dcn_pipelineable(x):
+            return lax.psum(x, axes)
+        return _sum_dcn(lax.psum(x, inner_axes))
+
+    def reduce_sum(x):
+        if not hier or not stale:
+            return _sum_all(x)
+        if not _dcn_pipelineable(x):
+            raise ValueError(
+                "stale_k reduce_sum on a floating operand refuses: "
+                "deferred-delivery reassociation has no bit-exactness "
+                "story for floats (integer/bool deltas only)")
+        # deferred-delivery staleness: the slot accumulates this
+        # shard's per-round operands; stale rounds serve ZERO (a
+        # replicated constant — consumers fold per-round totals into
+        # replicated scalars, which must stay replicated), and every
+        # k-th round the exchange delivers the accumulated global
+        # backlog in one all-axes psum.  Each delta is counted exactly
+        # once and lags < k rounds; with quiescent convergence, zero
+        # acked writes are ever lost (the outbox models the KV-side
+        # transport batch — a flushed delta is already durable in it).
+        slot = ctx._take(x)
+        if ctx.is_probe:
+            return lax.psum(x, axes)
+        acc = slot + x
+
+        def fresh(a):
+            return _sum_all(a), jnp.zeros(a.shape, a.dtype)
+
+        def lag(a):
+            return jnp.zeros(a.shape, a.dtype), a
+
+        val, nxt = lax.cond(ctx.refresh, fresh, lag, acc)
+        ctx._put(nxt)
+        return val
+
+    def _stale_refusal(member: str, why: str):
+        def refuse(x):
+            raise ValueError(
+                f"{member} has no certified staleness semantics "
+                f"({why}) — stale_k engine mode refuses; run sync or "
+                "pipelined")
+        return refuse
+
+    if stale and not ctx.is_probe:
+        reduce_max = _stale_refusal(
+            "reduce_max", "extremum folds must see every shard")
+        reduce_min = _stale_refusal(
+            "reduce_min", "CAS winner folds must see every shard")
+        widen = _stale_refusal(
+            "widen", "operand delivery must be exact")
+        exclusive_sum = _stale_refusal(
+            "exclusive_sum",
+            "global rank/offset allocation must be exact")
+    else:
+        if pipeline:
+            # per-level decomposition of the extremum folds: exact for
+            # every dtype (min/max are order-insensitive), and the DCN
+            # level again carries one per-host partial
+            reduce_max = lambda x: lax.pmax(                # noqa: E731
+                lax.pmax(x, axis) if n_inner > 1 else x, HOSTS_AXIS)
+            reduce_min = lambda x: lax.pmin(                # noqa: E731
+                lax.pmin(x, axis) if n_inner > 1 else x, HOSTS_AXIS)
+        else:
+            reduce_max = lambda x: lax.pmax(x, na)          # noqa: E731
+            reduce_min = lambda x: lax.pmin(x, na)          # noqa: E731
+        widen = lambda x: lax.all_gather(                   # noqa: E731
+            x, na, axis=gather_axis, tiled=True)
+
+        def exclusive_sum(x):
+            # global exclusive prefix for shard (h, i), hosts-major:
+            # the intra-host exclusive scan plus, over DCN, the
+            # exclusive scan of each host's full partial (one
+            # psum-reduced block per host crosses DCN — not the
+            # per-shard operands); pipelined mode half-blocks the
+            # hosts-level scan (element-wise — value unchanged)
+            out = _excl_level(x, axis, n_inner)
+            if hier and n_hosts > 1:
+                out = out + _dcn_level(
+                    lax.psum(x, axis), _excl_level,
+                    pipeline=pipeline and _dcn_pipelineable(x),
+                    ax=HOSTS_AXIS, k=n_hosts)
+            return out
 
     return Collectives(
         row_ids=row_ids,
-        widen=lambda x: lax.all_gather(x, na, axis=gather_axis,
-                                       tiled=True),
-        reduce_sum=lambda x: lax.psum(x, axes),
-        reduce_max=lambda x: lax.pmax(x, na),
-        reduce_min=lambda x: lax.pmin(x, na),
+        widen=widen,
+        reduce_sum=reduce_sum,
+        reduce_max=reduce_max,
+        reduce_min=reduce_min,
         reduce_or=reduce_or,
-        reduce_and=lambda x: ~reduce_or(~x),
+        reduce_and=reduce_and,
         exclusive_sum=exclusive_sum,
         local_cols=lambda m: lax.dynamic_slice_in_dim(
             m, lax.axis_index(na) * block, block, axis=1),
         axis_name=na)
+
+
+def dcn_psum(mesh, mode, *, axis: str = "nodes") -> Callable:
+    """Mode-aware ``psum`` over ALL mesh axes for rounds that consume
+    a bare ``lax.psum(x, mesh.axis_names)`` closure instead of a full
+    :class:`Collectives` (broadcast's words-major rounds): identical
+    value, with the DCN hosts level split out per level and
+    double-buffered into two in-flight half-block circuits in
+    pipelined mode (integer/bool operands only — floats keep the
+    fused synchronous all-reduce, see :func:`_dcn_pipelineable`).
+    Stale modes refuse — these sites feed delivery and ledger
+    calibration, where staleness semantics are undecided."""
+    mode = resolve_dcn_mode(mode)
+    if mode.stale_k:
+        raise ValueError(
+            f"stale_k={mode.stale_k} has no certified semantics for "
+            "this round's bare psum sites (delivery masks / ledger "
+            "calibration) — refuse instead of silently running sync")
+    if mesh is None:
+        return lambda x: x
+    axes = tuple(mesh.axis_names)
+    if HOSTS_AXIS not in axes or not mode.pipeline:
+        return lambda x: lax.psum(x, axes)
+    inner_axes = tuple(a for a in axes if a != HOSTS_AXIS)
+    n_hosts = int(mesh.shape[HOSTS_AXIS])
+
+    def f(x):
+        if not _dcn_pipelineable(x):
+            return lax.psum(x, axes)
+        part = lax.psum(x, inner_axes) if inner_axes else x
+        return _dcn_level(part, _psum_level, pipeline=True,
+                          ax=HOSTS_AXIS, k=n_hosts)
+
+    return f
 
 
 # -- round-fused drivers (traced-side combinators) ----------------------

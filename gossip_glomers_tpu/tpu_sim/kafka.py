@@ -85,6 +85,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .engine import shard_put
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -93,8 +95,8 @@ from .counter import KVReach, _reach
 from .engine import (analytic_peak_bytes, collectives,
                      donate_argnums_for, fori_rounds, jit_program,
                      node_axes, node_shards, operand_bytes,
-                     resolve_block, scan_blocks, scan_rounds,
-                     unpack_bits)
+                     resolve_block, resolve_dcn_mode, scan_blocks,
+                     scan_rounds, unpack_bits)
 
 
 class KafkaState(NamedTuple):
@@ -186,7 +188,8 @@ class KafkaSim:
                  resync_mode: str = "pull",
                  union_block: "int | str | None" = None,
                  kv_backend: str = "host",
-                 kv_amnesia: bool = False) -> None:
+                 kv_amnesia: bool = False,
+                 dcn_mode: "str | None" = None) -> None:
         """``kv_sched``: lin-kv reachability windows (counter.KVReach —
         the same nemesis shape the counter's flush is gated by).  A
         node partitioned from lin-kv at round t:
@@ -298,6 +301,19 @@ class KafkaSim:
         self.n_pwords = (capacity + 31) // 32   # presence words per key
         self.max_sends = max_sends
         self.mesh = mesh
+        # -- DCN mode (PR 20): sync (default) or pipelined; kafka's
+        # offset allocation (exclusive_sum over the hosts ring) and
+        # the lin-kv send path have no certified staleness semantics
+        # — a lagged offset base would double-allocate — so refuse.
+        self._dcn = resolve_dcn_mode(dcn_mode)
+        if self._dcn.stale_k:
+            raise ValueError(
+                f"dcn_mode={self._dcn.label()!r}: kafka has no "
+                "certified staleness semantics — offset allocation is "
+                "an exclusive prefix sum over the composed axes (a "
+                "k-round-stale base double-allocates offsets) and the "
+                "lin-kv commit dance needs the current cell; run sync "
+                "or pipelined")
         # allocation-attempt cap for the contention-aware ledger
         # (defaultKVRetries, logmap.go:19)
         self.kv_retries = kv_retries
@@ -354,9 +370,9 @@ class KafkaSim:
         if self.mesh is not None:
             node3 = NamedSharding(self.mesh, P(self._na, None, None))
             state = state._replace(
-                present=jax.device_put(state.present, node3),
-                origin_bits=jax.device_put(state.origin_bits, node3),
-                local_committed=jax.device_put(
+                present=shard_put(state.present, node3),
+                origin_bits=shard_put(state.origin_bits, node3),
+                local_committed=shard_put(
                     state.local_committed,
                     NamedSharding(self.mesh, P(self._na, None))))
         return state
@@ -892,7 +908,8 @@ class KafkaSim:
                 plan = rest.pop() if fp else None
                 sched = rest.pop()
                 repl = rest.pop() if matmul else None
-                coll = collectives(send_key.shape[0], mesh)
+                coll = collectives(send_key.shape[0], mesh,
+                                   dcn=self._dcn)
                 return self._round(state, send_key, send_val,
                                    commit_req, repl, sched, coll,
                                    repl_mode=repl_mode, plan=plan)
@@ -931,7 +948,8 @@ class KafkaSim:
                 plan = rest.pop() if fp else None
                 sched = rest.pop()
                 repl = rest.pop() if matmul else None
-                coll = collectives(sks.shape[1], mesh)
+                coll = collectives(sks.shape[1], mesh,
+                                   dcn=self._dcn)
 
                 def body(s, xs):
                     sk, sv = xs[0], xs[1]
@@ -995,7 +1013,7 @@ class KafkaSim:
             args.append(jnp.asarray(commit_req, jnp.int32))
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(None, self._na, None))
-            args = [jax.device_put(a, sh) for a in args]
+            args = [shard_put(a, sh) for a in args]
         if matmul:
             args.append(jnp.asarray(repl_ok))
         args.append(self.kv_sched)
@@ -1162,7 +1180,8 @@ class KafkaSim:
             rest = a
             plan = rest.pop() if fp else None
             sched = rest.pop()
-            coll = collectives(sks.shape[1], mesh)
+            coll = collectives(sks.shape[1], mesh,
+                               dcn=self._dcn)
 
             def body(c, xs):
                 s = c[0]
@@ -1237,7 +1256,7 @@ class KafkaSim:
             args.append(jnp.asarray(commit_req, jnp.int32))
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(None, self._na, None))
-            args = [jax.device_put(a, sh) for a in args]
+            args = [shard_put(a, sh) for a in args]
         args.append(self.kv_sched)
         if self._fp_active:
             args.append(self.fault_plan)
@@ -1259,7 +1278,7 @@ class KafkaSim:
         args = [jnp.asarray(sks), jnp.asarray(svs)]
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(None, self._na, None))
-            args = [jax.device_put(a, sh) for a in args]
+            args = [shard_put(a, sh) for a in args]
         args.append(self.kv_sched)
         if self._fp_active:
             args.append(self.fault_plan)
@@ -1290,7 +1309,7 @@ class KafkaSim:
                 jnp.asarray(commit_req, jnp.int32)]
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(self._na, None))
-            args = [jax.device_put(a, sh) for a in args]
+            args = [shard_put(a, sh) for a in args]
         if matmul:
             args.append(jnp.asarray(repl_ok))
         args.append(self.kv_sched)
@@ -1437,7 +1456,7 @@ class KafkaSim:
             plan = rest[4] if fp else None
             coll = collectives(
                 state.present.shape[0],
-                mesh)
+                mesh, dcn=self._dcn)
 
             def body(c, op):
                 if tl:
@@ -1691,7 +1710,7 @@ def _step_args(sim):
             jnp.full((n, k), -1, jnp.int32)]
     if sim.mesh is not None:
         sh = NamedSharding(sim.mesh, P(sim._na, None))
-        args = [jax.device_put(a, sh) for a in args]
+        args = [shard_put(a, sh) for a in args]
     return args
 
 

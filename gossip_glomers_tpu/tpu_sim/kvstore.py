@@ -65,6 +65,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .engine import shard_put
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import faults
@@ -161,7 +163,7 @@ def init_rows(layout: KVLayout, mesh=None) -> KVRows:
         if mesh is not None:
             from .engine import node_axes
 
-            arr = jax.device_put(
+            arr = shard_put(
                 arr, NamedSharding(mesh, P(node_axes(mesh), None)))
         return arr
 

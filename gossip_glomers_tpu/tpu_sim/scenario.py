@@ -45,6 +45,8 @@ from dataclasses import dataclass, field
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .engine import shard_put
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -53,7 +55,28 @@ from . import counter as CT
 from . import faults, kafka as KF, telemetry, traffic
 from . import txn as TX
 from .engine import (host_view, node_axes, node_shards,
-                     scenario_placement, scenario_program)
+                     resolve_dcn_mode, scenario_placement,
+                     scenario_program)
+
+
+def _refuse_stale_dcn(where: str, runner_kw: dict | None = None):
+    """PR-20 staleness gate for the batch dispatchers: every scenario
+    /serving cell runs its node axis LOCALLY under scenario sharding
+    (identity collectives — pipelining is inert here and sync rows
+    stay bit-identical), but a bounded-staleness request has no
+    per-scenario carry to ride, so it must refuse loudly instead of
+    silently running sync.  Checks the explicit ``runner_kw`` mode
+    first, then the ``GG_DCN_STALE_K`` environment contract."""
+    setting = (runner_kw or {}).get("dcn_mode")
+    mode = resolve_dcn_mode(setting)
+    if mode.stale_k:
+        raise ValueError(
+            f"dcn_mode={mode.label()!r}: {where} runs every "
+            "scenario's node axis locally under scenario sharding — "
+            "there is no DCN level inside a cell and no staleness "
+            "carry threaded through the batch program, so bounded "
+            "staleness is undecided here; run the batch sync or "
+            "pipelined (or unset GG_DCN_STALE_K)")
 
 # The module's host/device split, DECLARED (the PR-6 faults.py
 # pattern): the determinism lint (tpu_sim/audit.py) treats exactly
@@ -77,7 +100,7 @@ HOST_SIDE = (
     "dispatch_serving_batch", "collect_serving_batch",
     "run_serving_batch", "serving_state_bytes",
     "pad_serving_batch", "_serving_common", "_serving_sig",
-    "_sig_setup", "_replicated_out")
+    "_sig_setup", "_replicated_out", "_refuse_stale_dcn")
 
 
 # -- scenario cases ------------------------------------------------------
@@ -353,7 +376,7 @@ def _place(args, mesh):
         return args
     sh = NamedSharding(mesh, P(node_axes(mesh)))
     return tuple(
-        jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), a)
+        jax.tree_util.tree_map(lambda x: shard_put(x, sh), a)
         for a in args)
 
 
@@ -1191,6 +1214,7 @@ def dispatch_scenario_batch(batch: ScenarioBatch, *, mesh=None,
     knob: a ragged tail batch padded to the same power-of-two count
     reuses the full batch's compiled program instead of paying a
     fresh XLA compile)."""
+    _refuse_stale_dcn("a scenario batch")
     n_real = len(batch.scenarios)
     mult = 1
     if mesh is not None and pad_to_mesh:
@@ -1497,6 +1521,7 @@ def dispatch_serving_batch(batch: ServingBatch, *, mesh=None,
     sized to the horizon (what ``signatures`` needs)."""
     from .engine import collectives
 
+    _refuse_stale_dcn("a serving batch", batch.runner_kw)
     n_real = len(batch.cells)
     if mesh is not None and pad_to_mesh:
         batch, n_real = pad_serving_batch(

@@ -66,6 +66,8 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .engine import shard_put
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -465,10 +467,10 @@ def init_state(spec: TrafficSpec, mesh=None) -> TrafficState:
         s1 = NamedSharding(mesh, P(na))
         s2 = NamedSharding(mesh, P(na, None))
         ts = ts._replace(
-            issued_k=jax.device_put(ts.issued_k, s1),
-            issue_round=jax.device_put(ts.issue_round, s2),
-            done_round=jax.device_put(ts.done_round, s2),
-            op_aux=jax.device_put(ts.op_aux, s2))
+            issued_k=shard_put(ts.issued_k, s1),
+            issue_round=shard_put(ts.issue_round, s2),
+            done_round=shard_put(ts.done_round, s2),
+            op_aux=shard_put(ts.op_aux, s2))
     return ts
 
 

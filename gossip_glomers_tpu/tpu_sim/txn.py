@@ -55,11 +55,14 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .engine import shard_put
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import faults, kvstore, traffic
 from .engine import (Collectives, collectives, donate_argnums_for,
-                     fori_rounds, jit_program, node_axes)
+                     fori_rounds, jit_program, node_axes,
+                     resolve_dcn_mode)
 
 # Host/device split, DECLARED (PR 6): tests/test_txn.py pins it total.
 # The round body itself is the TxnSim._round method plus the nested
@@ -145,7 +148,8 @@ class TxnSim:
                  mesh: Mesh | None = None, seed: int = 0,
                  workload_seed: int = 0,
                  fault_plan: "faults.FaultPlan | None" = None,
-                 kv_amnesia: bool = False) -> None:
+                 kv_amnesia: bool = False,
+                 dcn_mode: "str | None" = None) -> None:
         """``tspec``: the arrival driver — one client per node,
         ``ops_per_client == txns_per_node`` (each arrival opens the
         node's next transaction slot).  None builds a Poisson spec
@@ -178,6 +182,18 @@ class TxnSim:
         self.ops_per_txn = ops_per_txn
         self.tspec = tspec
         self.mesh = mesh
+        # -- DCN mode (PR 20): sync (default) or pipelined; the
+        # wound-or-die version-CAS winner fold is a reduce_min over
+        # live claimants — a k-round-stale winner set would commit
+        # wounded transactions, so staleness refuses here.
+        self._dcn = resolve_dcn_mode(dcn_mode)
+        if self._dcn.stale_k:
+            raise ValueError(
+                f"dcn_mode={self._dcn.label()!r}: txn has no "
+                "certified staleness semantics — the wound-or-die "
+                "version-CAS fold (reduce_min over claimant stamps) "
+                "must see the current round's claims or wounded "
+                "transactions commit; run sync or pipelined")
         self.seed = seed
         self.workload_seed = workload_seed
         self.fault_plan = fault_plan
@@ -200,7 +216,7 @@ class TxnSim:
             arr = jnp.zeros(shape, jnp.int32)
             if self.mesh is not None:
                 spec = P(self._na, *([None] * (len(shape) - 1)))
-                arr = jax.device_put(
+                arr = shard_put(
                     arr, NamedSharding(self.mesh, spec))
             return arr
 
@@ -343,7 +359,8 @@ class TxnSim:
 
         def step(state, ops, tplan, *fp):
             coll = (collectives(self.n_nodes) if mesh is None
-                    else collectives(state.arrived.shape[0], mesh))
+                    else collectives(state.arrived.shape[0], mesh,
+                                     dcn=self._dcn))
             return self._round(state, ops, tplan, coll,
                                fp[0] if fp else None)
 
@@ -364,7 +381,8 @@ class TxnSim:
 
         def run_n(state, ops, tplan, n_rounds, *fp):
             coll = (collectives(self.n_nodes) if mesh is None
-                    else collectives(state.arrived.shape[0], mesh))
+                    else collectives(state.arrived.shape[0], mesh,
+                                     dcn=self._dcn))
             plan = fp[0] if fp else None
             return fori_rounds(
                 lambda s, op: self._round(s, op[0], op[1], coll,

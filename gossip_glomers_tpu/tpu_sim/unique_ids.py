@@ -26,6 +26,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .engine import shard_put
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -51,7 +53,7 @@ class UniqueIdsSim:
         if self.mesh is not None:
             from .engine import node_axes
 
-            minted = jax.device_put(
+            minted = shard_put(
                 minted,
                 NamedSharding(self.mesh, P(node_axes(self.mesh))))
         return UniqueIdsState(t=jnp.int32(0), minted=minted)
@@ -100,7 +102,7 @@ class UniqueIdsSim:
         if self.mesh is not None:
             from .engine import node_axes
 
-            c = jax.device_put(
+            c = shard_put(
                 c, NamedSharding(self.mesh, P(node_axes(self.mesh))))
         return self._step(state, c)
 

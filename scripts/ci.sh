@@ -83,12 +83,17 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu \
 # ids.  (CPU, seconds.)
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/txn_smoke.py || rc=1
-# DCN smoke (PR 15): a REAL 2-process jax.distributed CPU cluster
-# (gloo, 2 virtual devices per process) runs the shared dcn_worker
-# tasks — all three sims stepwise + donated-fused, one certified
-# crash+loss structured broadcast, and the host-loss takeover drill —
-# and the parent pins every digest bit-exact against its own
-# 1-process x 4-device twin.  (CPU, seconds warm / ~2 min cold.)
+# DCN smoke (PR 15 + PR 20): a REAL 2-process jax.distributed CPU
+# cluster (gloo, 2 virtual devices per process) runs the shared
+# dcn_worker tasks — all three sims stepwise + donated-fused, one
+# certified crash+loss structured broadcast, the host-loss takeover
+# drill, the sims re-run under GG_DCN_PIPELINE=1 (the double-buffered
+# half-block DCN circuits must stay bit-exact vs the flat twin), and
+# a stale:4 counter campaign certified by check_staleness_bound
+# against its sync twin — then the parent pins every digest bit-exact
+# against its own 1-process twin, falsifies a planted k=1 staleness
+# claim, and replays a failing stale run's flight bundle
+# mode-faithfully (artifacts/dcn_smoke/).  (CPU, ~1 min warm.)
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python scripts/dcn_smoke.py || rc=1
 # Membership smoke (PR 17): one certified join+leave churn campaign

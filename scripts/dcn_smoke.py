@@ -9,7 +9,27 @@ bit-exact against its own 1-process x 4-device twin:
                 structured words-major path;
 - ``takeover``  the HOST-loss drill: one DCN host's entire node block
                 crashes for a window, the survivors' flood stalls and
-                re-converges after restart.
+                re-converges after restart;
+- ``pipelined`` (PR 20) the ``sims`` body under ``GG_DCN_PIPELINE=1``:
+                the cluster compiles the double-buffered half-block
+                DCN circuits and every digest must STILL equal the
+                synchronous flat twin's — latency hiding with zero
+                semantic drift, proven bit-exact;
+- ``stale``     (PR 20) counter allreduce crash+loss at ``stale:4``
+                vs its sync twin, certified by
+                ``check_staleness_bound`` with a REAL nonzero
+                convergence delay — compared against a 1-process
+                ``pick_mesh_2d`` twin (staleness needs the hierarchy,
+                so the flat parity mesh refuses it).
+
+Parent-side staleness legs (PR 20) ride after the parity sweep: the
+falsifiability plant (the same stale:4 run certified against a
+claimed k=1 bound MUST fail naming the violating round) and the
+flight-recorder loop (a stale run failed by an impossible recovery
+budget writes a bundle whose ``runner_kw`` records the DCN mode, and
+``replay_bundle(..., mesh=pick_mesh_2d())`` reproduces the same
+failure under the same mode).  Artifacts land in
+``artifacts/dcn_smoke/``.
 
 Every compared number is a replicated ledger scalar or an on-device
 position-weighted checksum, so rank-vs-rank and cluster-vs-twin
@@ -40,12 +60,18 @@ force_virtual_devices(4)
 
 from gossip_glomers_tpu.parallel.dcn_worker import (  # noqa: E402
     run_tasks)
-from gossip_glomers_tpu.parallel.mesh import pick_mesh  # noqa: E402
+from gossip_glomers_tpu.parallel.mesh import (  # noqa: E402
+    pick_mesh, pick_mesh_2d)
 from gossip_glomers_tpu.utils.compile_cache import (  # noqa: E402
     enable_compile_cache)
 
-TASKS = "sims,certify,takeover"
+TASKS = "sims,certify,takeover,pipelined,stale"
+# tasks the FLAT 1x4 twin can replay (pipelined mode is a structural
+# no-op on one host, which is exactly the bit-exactness claim); the
+# stale task needs the hierarchy and gets its own pick_mesh_2d twin
+FLAT_TASKS = ("sims", "certify", "takeover", "pipelined")
 N_PROCS, LOCAL_DEVICES = 2, 2
+ART_DIR = os.path.join(REPO, "artifacts", "dcn_smoke")
 
 
 def _free_port() -> int:
@@ -109,8 +135,89 @@ def _spawn_cluster(tmp: str, timeout: float = 480.0):
     return None
 
 
+def _stale_legs(stale_report: dict) -> tuple[int, dict]:
+    """The PR-20 parent-side staleness legs on the ``pick_mesh_2d``
+    hierarchy: (1) falsifiability — the cluster's REAL stale:4 run,
+    re-certified against a claimed k=1 bound, must FAIL naming its
+    violating round; (2) the flight-recorder loop — a stale run
+    failed by an impossible recovery budget (the sync twin passes the
+    same budget, so staleness IS the failure) writes a bundle whose
+    ``runner_kw`` records the DCN mode, and the replay on a fresh
+    hierarchical mesh reproduces the same verdict."""
+    from gossip_glomers_tpu.harness.checkers import (
+        check_staleness_bound)
+    from gossip_glomers_tpu.harness.nemesis import run_counter_nemesis
+    from gossip_glomers_tpu.harness.observe import (
+        load_bundle, replay_bundle)
+    from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec
+
+    rc = 0
+    legs: dict = {}
+
+    # -- planted k-violation: the stale:4 run's observed delay is
+    # real (>= 1 round), so a claimed stale:1 bound must be violated
+    ok, details = check_staleness_bound(
+        stale_k=1,
+        sync_converged_round=stale_report["sync_round"],
+        stale_converged_round=stale_report["stale_round"],
+        lost_writes=[])
+    planted_ok = (not ok
+                  and details.get("violating_round")
+                  == stale_report["stale_round"])
+    legs["planted_k_violation"] = {
+        "ok": planted_ok, "claimed_k": 1,
+        "violating_round": details.get("violating_round"),
+        "bound_round": details["bound_round"]}
+    print(f"dcn-smoke stale-plant "
+          f"{'falsified-ok' if planted_ok else 'FAIL'} "
+          f"(claimed k=1, violating round "
+          f"{details.get('violating_round')})")
+    if not planted_ok:
+        rc = 1
+
+    # -- flight-recorder loop: same seeded spec as the stale task,
+    # recovery budget 1 — the sync run converges AT the clear round
+    # and passes; the stale:4 run needs 2 more rounds and fails,
+    # writing the bundle with dcn_mode in runner_kw
+    hier = pick_mesh_2d(hosts=N_PROCS)
+    spec = NemesisSpec(n_nodes=16, seed=3, crash=((1, 4, (2, 11)),),
+                       loss_rate=0.2, loss_until=5)
+    sync = run_counter_nemesis(spec, mode="allreduce", mesh=hier,
+                               max_recovery_rounds=1, dcn_mode="sync")
+    failed = run_counter_nemesis(spec, mode="allreduce", mesh=hier,
+                                 max_recovery_rounds=1,
+                                 dcn_mode="stale:4",
+                                 observe_dir=ART_DIR)
+    bundle_path = failed.get("flight_bundle")
+    leg_ok = bool(sync["ok"]) and not failed["ok"] \
+        and bundle_path is not None
+    replayed = mode_ok = None
+    if bundle_path:
+        mode_ok = (load_bundle(bundle_path)["runner_kw"]
+                   .get("dcn_mode") == "stale:4")
+        replayed = replay_bundle(bundle_path, mesh=hier)
+        leg_ok = (leg_ok and mode_ok and not replayed["ok"]
+                  and replayed["converged_round"]
+                  == failed["converged_round"])
+    legs["flight_replay"] = {
+        "ok": bool(leg_ok),
+        "sync_ok_same_budget": bool(sync["ok"]),
+        "bundle": bundle_path,
+        "bundle_records_mode": mode_ok,
+        "failed_converged_round": failed["converged_round"],
+        "replay_converged_round": (None if replayed is None
+                                   else replayed["converged_round"])}
+    print(f"dcn-smoke stale-replay "
+          f"{'replayed-ok' if leg_ok else 'FAIL'} "
+          f"(bundle {os.path.basename(bundle_path or '<none>')})")
+    if not leg_ok:
+        rc = 1
+    return rc, legs
+
+
 def main() -> int:
     enable_compile_cache()
+    os.makedirs(ART_DIR, exist_ok=True)
     with tempfile.TemporaryDirectory() as tmp:
         reports = _spawn_cluster(tmp)
     if reports is None:
@@ -127,7 +234,11 @@ def main() -> int:
         rc = 1
 
     flat = json.loads(json.dumps(
-        run_tasks(TASKS.split(","), pick_mesh())))
+        run_tasks(list(FLAT_TASKS), pick_mesh())))
+    # the stale twin folds THIS process's 4 virtual devices into the
+    # same 2x2 global hierarchy the cluster runs
+    flat["stale"] = json.loads(json.dumps(run_tasks(
+        ["stale"], pick_mesh_2d(hosts=N_PROCS))["stale"]))
     for task in TASKS.split(","):
         same = flat[task] == r0["tasks"][task]
         print(f"dcn-smoke {task:9s} "
@@ -140,17 +251,40 @@ def main() -> int:
 
     cert = r0["tasks"]["certify"]
     take = r0["tasks"]["takeover"]
+    stale = r0["tasks"]["stale"]
     if not cert["ok"]:
         print(f"dcn-smoke: FAIL certify {cert}", file=sys.stderr)
         rc = 1
     if not take["converged"]:
         print(f"dcn-smoke: FAIL takeover {take}", file=sys.stderr)
         rc = 1
+    # the certified stale run must show a REAL bounded lag: within
+    # k=4 of the sync twin but not free (the spec is seeded so the
+    # last drained deltas wait for a refresh round)
+    if not (stale["ok"] and stale["delay_rounds"] is not None
+            and 1 <= stale["delay_rounds"] <= 4):
+        print(f"dcn-smoke: FAIL stale certification {stale}",
+              file=sys.stderr)
+        rc = 1
+
+    stale_rc, stale_legs = _stale_legs(stale)
+    rc = rc or stale_rc
+
+    with open(os.path.join(ART_DIR, "dcn_smoke_report.json"),
+              "w") as fh:
+        json.dump({"ok": rc == 0, "tasks": r0["tasks"],
+                   "mesh_shape": r0["mesh_shape"],
+                   "stale_legs": stale_legs},
+                  fh, indent=1, sort_keys=True)
+        fh.write("\n")
     if rc == 0:
-        print("dcn-smoke: 2-proc cluster == 1-proc twin (bit-exact); "
-              f"certified nemesis ok (round "
+        print("dcn-smoke: 2-proc cluster == 1-proc twin (bit-exact, "
+              "sync AND pipelined); certified nemesis ok (round "
               f"{cert['converged_round']}), host-loss takeover "
-              f"converged in {take['rounds']} rounds")
+              f"converged in {take['rounds']} rounds; stale:4 "
+              f"certified with delay {stale['delay_rounds']} <= 4, "
+              "k-violation falsified, failing bundle replayed "
+              "mode-faithfully")
     return rc
 
 

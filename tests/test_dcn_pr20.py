@@ -1,0 +1,395 @@
+"""DCN latency hiding (PR 20): pipelined + stale-by-k mode pins.
+
+Three layers, all single-process (the real 2-process cluster legs
+live in ``tests/test_dcn.py`` and ``scripts/dcn_smoke.py``):
+
+- **Bit-exact pipelining**: every sim's round under
+  ``dcn_mode="pipelined"`` on the hierarchical mesh produces the
+  IDENTICAL state checksums as (a) its own synchronous twin and (b)
+  the flat-mesh run, where pipelining is a structural no-op — so the
+  equality is a bit-exactness claim about the double-buffered
+  half-block DCN circuits, not a tolerance.  Includes the H=3
+  NON-power-of-two host count (ring fallback on the hosts axis) the
+  2-host CI cluster cannot cover.
+- **Certified staleness**: a ``stale:4`` counter allreduce crash+loss
+  campaign converges within k rounds of its sync twin with zero lost
+  acked writes (``check_staleness_bound``), the planted k-violation
+  FAILS naming the violating round, and a failing stale run's flight
+  bundle records the mode and replays it (``replay_bundle(...,
+  mesh=)``).
+- **The refusal matrix**: every surface whose staleness semantics are
+  undecided refuses loudly at construction — kafka offset allocation,
+  txn wound-or-die, broadcast delivery, counter cas / device-KV /
+  observed+traffic calibration, scenario/serving batches, flat
+  meshes, carry-less bare modes — plus the env-knob and mode-grammar
+  error contracts and the ``check_staleness_bound`` falsifiability
+  units.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from gossip_glomers_tpu.harness.checkers import check_staleness_bound
+from gossip_glomers_tpu.harness.nemesis import run_counter_nemesis
+from gossip_glomers_tpu.parallel.dcn_worker import state_digest
+from gossip_glomers_tpu.parallel.mesh import pick_mesh, pick_mesh_2d
+from gossip_glomers_tpu.parallel.topology import grid, to_padded_neighbors
+from gossip_glomers_tpu.tpu_sim import engine
+from gossip_glomers_tpu.tpu_sim.engine import (DCN_SYNC, DcnMode, DcnRound,
+                                               dcn_mode_from_env,
+                                               resolve_dcn_mode)
+from gossip_glomers_tpu.tpu_sim.faults import NemesisSpec
+
+# the certified staleness spec (scripts/dcn_smoke.py uses the same
+# one): crash+loss through round 5; under stale:4 the last drained
+# deltas wait for a refresh round, so the run converges EXACTLY 2
+# rounds after the sync twin — inside the bound, but measurably late
+STALE_SPEC = NemesisSpec(n_nodes=16, seed=3, crash=((1, 4, (2, 11)),),
+                         loss_rate=0.2, loss_until=5)
+
+
+# -- mode grammar / env knobs -------------------------------------------
+
+
+def test_resolve_dcn_mode_grammar():
+    assert resolve_dcn_mode("sync") == DCN_SYNC
+    assert resolve_dcn_mode("pipelined") == DcnMode(pipeline=True)
+    assert resolve_dcn_mode("stale:3") == DcnMode(stale_k=3)
+    both = resolve_dcn_mode("pipelined+stale:2")
+    assert both == DcnMode(pipeline=True, stale_k=2)
+    # label round-trips through the same grammar (what runner_kw and
+    # flight bundles record)
+    for s in ("sync", "pipelined", "stale:3", "pipelined+stale:2"):
+        assert resolve_dcn_mode(resolve_dcn_mode(s).label()).label() \
+            == s
+    assert DCN_SYNC.label() == "sync"
+    with pytest.raises(ValueError, match="unknown part"):
+        resolve_dcn_mode("fast")
+    with pytest.raises(ValueError, match="stale"):
+        resolve_dcn_mode("stale:x")
+    with pytest.raises(ValueError, match=">= 0"):
+        resolve_dcn_mode(DcnMode(stale_k=-1))
+    with pytest.raises(ValueError, match="DcnMode"):
+        resolve_dcn_mode(3)
+
+
+def test_env_knobs_loud(monkeypatch):
+    monkeypatch.delenv("GG_DCN_PIPELINE", raising=False)
+    monkeypatch.delenv("GG_DCN_STALE_K", raising=False)
+    assert dcn_mode_from_env() == DCN_SYNC
+    monkeypatch.setenv("GG_DCN_PIPELINE", "1")
+    monkeypatch.setenv("GG_DCN_STALE_K", "3")
+    assert dcn_mode_from_env() == DcnMode(pipeline=True, stale_k=3)
+    # non-integers and out-of-range values refuse NAMING the variable
+    monkeypatch.setenv("GG_DCN_PIPELINE", "yes")
+    with pytest.raises(ValueError, match="GG_DCN_PIPELINE"):
+        dcn_mode_from_env()
+    monkeypatch.setenv("GG_DCN_PIPELINE", "2")
+    with pytest.raises(ValueError, match="GG_DCN_PIPELINE"):
+        dcn_mode_from_env()
+    monkeypatch.setenv("GG_DCN_PIPELINE", "0")
+    monkeypatch.setenv("GG_DCN_STALE_K", "-1")
+    with pytest.raises(ValueError, match="GG_DCN_STALE_K"):
+        dcn_mode_from_env()
+
+
+def test_dcn_chunks_round_trip():
+    # the double buffer: two half blocks that join back losslessly,
+    # odd sizes included; scalars and singletons decline the split
+    for shape in ((8,), (7,), (3, 5), (2, 2, 2)):
+        x = jnp.arange(int(np.prod(shape)), dtype=jnp.int32
+                       ).reshape(shape)
+        split = engine._dcn_chunks(x)
+        assert split is not None
+        (a, b), join = split
+        assert a.shape[0] + b.shape[0] == x.size
+        assert jnp.array_equal(join([a, b]), x)
+    assert engine._dcn_chunks(jnp.int32(3)) is None
+    assert engine._dcn_chunks(jnp.zeros((1,), jnp.int32)) is None
+
+
+def test_dcn_round_carry_contracts():
+    # probe mode records slot shapes without consuming a carry
+    probe = DcnRound.probing("stale:2")
+    assert probe._take(jnp.zeros((4,), jnp.int32)) is None
+    assert [tuple(s.shape) for s in probe.shapes] == [(4,)]
+    # a live stale context without the carried age refuses
+    with pytest.raises(ValueError, match="age"):
+        DcnRound("stale:2")
+    # carry exhaustion and take/put mismatch both refuse loudly (the
+    # round's collective structure changed without re-probing)
+    ctx = DcnRound("stale:2", age=jnp.int32(0), carry=())
+    with pytest.raises(ValueError, match="carry exhausted"):
+        ctx._take(jnp.zeros((4,), jnp.int32))
+    ctx2 = DcnRound("stale:2", age=jnp.int32(0),
+                    carry=(jnp.zeros((1, 4), jnp.int32),))
+    with pytest.raises(ValueError, match="carry mismatch"):
+        ctx2.carry_out()
+
+
+# -- check_staleness_bound falsifiability --------------------------------
+
+
+def test_staleness_bound_certifies_within_k():
+    ok, d = check_staleness_bound(
+        stale_k=4, sync_converged_round=5, stale_converged_round=7,
+        lost_writes=[])
+    assert ok
+    assert d["bound_round"] == 9 and d["delay_rounds"] == 2
+    assert "violating_round" not in d
+
+
+def test_staleness_bound_fails_past_k_naming_round():
+    ok, d = check_staleness_bound(
+        stale_k=1, sync_converged_round=5, stale_converged_round=7,
+        lost_writes=[])
+    assert not ok
+    assert d["bound_round"] == 6 and d["violating_round"] == 7
+    # never-converged is an unbounded violation, not a tie
+    ok, d = check_staleness_bound(
+        stale_k=4, sync_converged_round=5, stale_converged_round=None,
+        lost_writes=[])
+    assert not ok and d["violating_round"] == -1
+
+
+def test_staleness_bound_lost_writes_and_recovery():
+    # a lost acked write falsifies even inside the round bound
+    ok, d = check_staleness_bound(
+        stale_k=4, sync_converged_round=5, stale_converged_round=6,
+        lost_writes=[{"lost_sum": 3}])
+    assert not ok and d["n_lost_writes"] == 1
+    # without a sync baseline only the lost-writes half is decidable
+    ok, d = check_staleness_bound(
+        stale_k=4, sync_converged_round=None,
+        stale_converged_round=9, lost_writes=[])
+    assert ok and d["bound_round"] is None
+    # a failing composed recovery verdict fails the certification
+    ok, d = check_staleness_bound(
+        stale_k=4, sync_converged_round=5, stale_converged_round=6,
+        lost_writes=[], recovery=(False, {"why": "x"}))
+    assert not ok and d["recovery_ok"] is False
+    with pytest.raises(ValueError, match=">= 0"):
+        check_staleness_bound(stale_k=-1, sync_converged_round=1,
+                              stale_converged_round=1, lost_writes=[])
+
+
+# -- the refusal matrix --------------------------------------------------
+
+
+def test_stale_refusals_undecided_surfaces():
+    from gossip_glomers_tpu.tpu_sim import scenario
+    from gossip_glomers_tpu.tpu_sim.broadcast import BroadcastSim
+    from gossip_glomers_tpu.tpu_sim.counter import CounterSim
+    from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim
+    from gossip_glomers_tpu.tpu_sim.txn import TxnSim
+
+    hier = pick_mesh_2d(hosts=2)
+    flat = pick_mesh()
+    assert hier is not None and flat is not None
+    nbrs = to_padded_neighbors(grid(16))
+    with pytest.raises(ValueError, match="kafka has no"):
+        KafkaSim(8, 4, capacity=32, mesh=hier, dcn_mode="stale:2")
+    with pytest.raises(ValueError, match="txn has no"):
+        TxnSim(8, 4, mesh=hier, dcn_mode="stale:2")
+    with pytest.raises(ValueError, match="broadcast has no"):
+        BroadcastSim(nbrs, n_values=16, mesh=hier, dcn_mode="stale:2")
+    # counter: only the allreduce host-KV data plane is certified
+    with pytest.raises(ValueError, match="allreduce"):
+        CounterSim(16, mode="cas", mesh=hier, dcn_mode="stale:2")
+    with pytest.raises(ValueError, match="host"):
+        CounterSim(16, mode="allreduce", kv_backend="device",
+                   mesh=hier, dcn_mode="stale:2")
+    # a flat mesh has no DCN level to lag
+    with pytest.raises(ValueError, match="hierarchical"):
+        CounterSim(16, mode="allreduce", mesh=flat,
+                   dcn_mode="stale:2")
+    # scenario/serving batch dispatchers: no carry inside a cell
+    with pytest.raises(ValueError, match="scenario batch"):
+        scenario._refuse_stale_dcn("a scenario batch",
+                                   {"dcn_mode": "stale:2"})
+    # ... and the env contract is checked too
+    os.environ["GG_DCN_STALE_K"] = "2"
+    try:
+        with pytest.raises(ValueError, match="GG_DCN_STALE_K"):
+            scenario._refuse_stale_dcn("a serving batch")
+    finally:
+        del os.environ["GG_DCN_STALE_K"]
+
+
+def test_engine_collectives_stale_refusals():
+    hier = pick_mesh_2d(hosts=2)
+    flat = pick_mesh()
+    # a bare stale DcnMode without the driver-threaded carry refuses
+    # (silently compiling the sync circuit would misreport the mode)
+    with pytest.raises(ValueError, match="DcnRound"):
+        engine.collectives(2, hier, dcn=DcnMode(stale_k=2))
+    with pytest.raises(ValueError, match="hierarchical"):
+        engine.collectives(2, flat, dcn=DcnMode(stale_k=2))
+    with pytest.raises(ValueError, match="dcn="):
+        engine.collectives(2, hier, dcn="stale:2")
+
+
+# -- pipelined bit-exactness ---------------------------------------------
+
+
+def _sims_digests(mesh, dcn_mode):
+    """Checksummed end states of all three sims on ``mesh`` — the
+    flat-vs-hier comparison surface (mirrors the DCN worker's sims
+    task at a test-budget shape)."""
+    from gossip_glomers_tpu.tpu_sim.broadcast import (BroadcastSim,
+                                                      make_inject)
+    from gossip_glomers_tpu.tpu_sim.counter import CounterSim
+    from gossip_glomers_tpu.tpu_sim.kafka import KafkaSim
+
+    out = {}
+    n, nv = 16, 16
+    sim = BroadcastSim(to_padded_neighbors(grid(n)), n_values=nv,
+                       mesh=mesh, dcn_mode=dcn_mode)
+    state, rounds = sim.run(make_inject(n, nv))
+    out["broadcast"] = {"rounds": int(rounds),
+                        "msgs": int(state.msgs),
+                        "state": state_digest(state)}
+
+    nc = 8
+    deltas = np.arange(1, nc + 1, dtype=np.int32)
+    for runner in ("run", "run_fused"):
+        sim = CounterSim(nc, mode="cas", seed=7, mesh=mesh,
+                         dcn_mode=dcn_mode)
+        state = getattr(sim, runner)(
+            sim.add(sim.init_state(), deltas), 12)
+        out[f"counter_{runner}"] = {"msgs": int(state.msgs),
+                                    "state": state_digest(state)}
+
+    rng = np.random.default_rng(0)
+    sim = KafkaSim(nc, 4, capacity=32, mesh=mesh, dcn_mode=dcn_mode)
+    state = sim.init_state()
+    for _ in range(4):
+        sk = rng.integers(-1, 4, size=(nc, sim.max_sends)
+                          ).astype(np.int32)
+        sv = rng.integers(0, 100, size=(nc, sim.max_sends)
+                          ).astype(np.int32)
+        state = sim.step(state, sk, sv)
+    out["kafka"] = {"msgs": int(state.msgs),
+                    "state": state_digest(state)}
+    return out
+
+
+def test_pipelined_bit_exact_vs_sync_and_flat():
+    hier = pick_mesh_2d(hosts=2)
+    flat = pick_mesh()
+    assert hier is not None and flat is not None
+    hier_pipe = _sims_digests(hier, "pipelined")
+    # vs the synchronous twin on the SAME mesh: the half-block
+    # decomposition reassociates only integer operands — bit-exact
+    assert hier_pipe == _sims_digests(hier, "sync")
+    # vs the flat mesh where pipelining is a structural no-op: the
+    # hierarchy itself changes no bit either
+    assert hier_pipe == _sims_digests(flat, "pipelined")
+
+
+def test_pipelined_parity_three_hosts():
+    # H=3: a NON-power-of-two hosts axis (the OR exchange falls back
+    # to the ring schedule; 2 devices per host) vs the flat 6-device
+    # mesh — the host-count blindness pin the 2-host CI cluster and
+    # the 2-D pick_mesh_2d default cannot cover
+    devices = jax.devices()
+    assert len(devices) >= 6
+    hier3 = Mesh(np.array(devices[:6]).reshape(3, 2),
+                 ("hosts", "nodes"))
+    flat6 = Mesh(np.array(devices[:6]), ("nodes",))
+    res = {}
+    for name, mesh, mode in (("h3_sync", hier3, "sync"),
+                             ("h3_pipe", hier3, "pipelined"),
+                             ("flat", flat6, "pipelined")):
+        from gossip_glomers_tpu.tpu_sim.broadcast import (
+            BroadcastSim, make_inject)
+        from gossip_glomers_tpu.tpu_sim.counter import CounterSim
+
+        n, nv = 12, 8
+        sim = BroadcastSim(to_padded_neighbors(grid(n)), n_values=nv,
+                           mesh=mesh, dcn_mode=mode)
+        state, rounds = sim.run(make_inject(n, nv))
+        bd = {"rounds": int(rounds), "msgs": int(state.msgs),
+              "state": state_digest(state)}
+        csim = CounterSim(n, mode="cas", seed=7, mesh=mesh,
+                          dcn_mode=mode)
+        cstate = csim.run(
+            csim.add(csim.init_state(),
+                     np.arange(1, n + 1, dtype=np.int32)), 10)
+        res[name] = {"broadcast": bd,
+                     "counter": {"msgs": int(cstate.msgs),
+                                 "state": state_digest(cstate)}}
+    assert res["h3_pipe"] == res["h3_sync"]
+    assert res["h3_pipe"] == res["flat"]
+
+
+# -- certified bounded staleness -----------------------------------------
+
+
+def test_stale_counter_bounded_delay_zero_loss():
+    mesh = pick_mesh_2d(hosts=2)
+    assert mesh is not None
+    runs = {}
+    for label, mode in (("sync", "sync"), ("stale", "stale:4")):
+        runs[label] = run_counter_nemesis(
+            STALE_SPEC, mode="allreduce", mesh=mesh,
+            max_recovery_rounds=32, dcn_mode=mode)
+        assert runs[label]["ok"], runs[label]
+        assert runs[label]["n_lost_writes"] == 0
+        assert runs[label]["kv"] == runs[label]["acked_sum"]
+    delay = (runs["stale"]["converged_round"]
+             - runs["sync"]["converged_round"])
+    # the deferred-delivery carry is REAL (delay >= 1) and bounded
+    assert 1 <= delay <= 4, runs
+    ok, d = check_staleness_bound(
+        stale_k=4,
+        sync_converged_round=runs["sync"]["converged_round"],
+        stale_converged_round=runs["stale"]["converged_round"],
+        lost_writes=[],
+        recovery=(runs["stale"]["ok"],
+                  {"converged_round": runs["stale"]["converged_round"]}))
+    assert ok, d
+    # the planted violation: the SAME measured rounds against k=1
+    # must fail and name the violating round
+    ok, d = check_staleness_bound(
+        stale_k=1,
+        sync_converged_round=runs["sync"]["converged_round"],
+        stale_converged_round=runs["stale"]["converged_round"],
+        lost_writes=[])
+    assert not ok
+    assert d["violating_round"] == runs["stale"]["converged_round"]
+
+
+def test_stale_flight_bundle_replays_mode(tmp_path):
+    from gossip_glomers_tpu.harness.observe import (load_bundle,
+                                                    replay_bundle)
+
+    mesh = pick_mesh_2d(hosts=2)
+    # a 1-round recovery budget the stale run cannot meet (its carry
+    # needs the refresh rounds the sync twin doesn't): the failure
+    # writes a flight bundle recording the mode
+    res = run_counter_nemesis(
+        STALE_SPEC, mode="allreduce", mesh=mesh,
+        max_recovery_rounds=1, dcn_mode="stale:4",
+        observe_dir=str(tmp_path))
+    assert not res["ok"]
+    bundles = sorted(tmp_path.glob("*.json"))
+    assert bundles, "failing run must write a flight bundle"
+    bundle = load_bundle(str(bundles[0]))
+    assert bundle["runner_kw"]["dcn_mode"] == "stale:4"
+    # replay needs the hierarchical mesh threaded back in — and must
+    # reproduce the identical verdict
+    replayed = replay_bundle(str(bundles[0]), mesh=mesh)
+    assert replayed["ok"] == res["ok"]
+    assert replayed["converged_round"] == res["converged_round"]
+    # the sync twin PASSES the same 1-round budget: the bundle's
+    # failure is the staleness lag itself, not the spec
+    sync = run_counter_nemesis(STALE_SPEC, mode="allreduce",
+                               mesh=mesh, max_recovery_rounds=1,
+                               dcn_mode="sync")
+    assert sync["ok"], sync
